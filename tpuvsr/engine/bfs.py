@@ -1,0 +1,131 @@
+"""Breadth-first exhaustive model checking (interpreter backend).
+
+The reference's runtime is TLC's BFS worker loop (SURVEY.md §3.1):
+dequeue -> enumerate successors over every Next disjunct -> invariant
+check -> VIEW projection -> symmetry canonicalization -> fingerprint
+dedup -> enqueue, with parent pointers for trace reconstruction.  This
+module is the faithful single-host implementation used as the oracle for
+the TPU engine; states are deduplicated on the exact canonical view value
+(collision-free, unlike TLC's 64-bit fingerprints).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..core.values import TLAError
+from .spec import SpecModel
+from .trace import TraceEntry, reconstruct_trace
+
+
+@dataclass
+class CheckResult:
+    ok: bool = True
+    distinct_states: int = 0
+    states_generated: int = 0
+    diameter: int = 0
+    violated_invariant: str = None
+    deadlock_state: dict = None
+    trace: list = field(default_factory=list)
+    elapsed: float = 0.0
+    error: str = None
+
+    @property
+    def states_per_sec(self):
+        return self.states_generated / self.elapsed if self.elapsed > 0 else 0.0
+
+
+def bfs_check(spec: SpecModel, check_deadlock: bool = False,
+              max_states: int = None, progress_every: float = 10.0,
+              log=None) -> CheckResult:
+    res = CheckResult()
+    t0 = time.time()
+    seen = {}           # canonical view value -> state id
+    parents = {}        # state id -> (parent id, action name, action location)
+    states = []         # state id -> state dict (kept for trace replay)
+    frontier = []
+
+    def emit(msg):
+        if log:
+            log(msg)
+
+    def register(state, parent_id, action):
+        key = spec.view_value(state)
+        sid = seen.get(key)
+        if sid is None:
+            sid = len(states)
+            seen[key] = sid
+            states.append(state)
+            parents[sid] = (parent_id, action.name if action else None,
+                            action.location if action else None)
+            return sid, True
+        return sid, False
+
+    try:
+        for st in spec.init_states():
+            res.states_generated += 1
+            sid, fresh = register(st, None, None)
+            if fresh:
+                bad = spec.check_invariants(st)
+                if bad:
+                    res.ok = False
+                    res.violated_invariant = bad
+                    res.trace = reconstruct_trace(sid, parents, states)
+                    res.distinct_states = len(states)
+                    res.elapsed = time.time() - t0
+                    return res
+                frontier.append(sid)
+
+        depth = 0
+        last_progress = t0
+        while frontier:
+            depth += 1
+            next_frontier = []
+            for sid in frontier:
+                state = states[sid]
+                n_succ = 0
+                for action, succ in spec.successors(state):
+                    n_succ += 1
+                    res.states_generated += 1
+                    tid, fresh = register(succ, sid, action)
+                    if fresh:
+                        bad = spec.check_invariants(succ)
+                        if bad:
+                            res.ok = False
+                            res.violated_invariant = bad
+                            res.trace = reconstruct_trace(tid, parents, states)
+                            res.distinct_states = len(states)
+                            res.diameter = depth
+                            res.elapsed = time.time() - t0
+                            return res
+                        next_frontier.append(tid)
+                if n_succ == 0 and check_deadlock:
+                    res.ok = False
+                    res.error = "deadlock"
+                    res.deadlock_state = state
+                    res.trace = reconstruct_trace(sid, parents, states)
+                    res.distinct_states = len(states)
+                    res.diameter = depth
+                    res.elapsed = time.time() - t0
+                    return res
+                if max_states and len(states) >= max_states:
+                    res.error = f"state limit {max_states} reached"
+                    res.distinct_states = len(states)
+                    res.diameter = depth
+                    res.elapsed = time.time() - t0
+                    return res
+                now = time.time()
+                if now - last_progress >= progress_every:
+                    last_progress = now
+                    emit(f"depth {depth}: {len(states)} distinct, "
+                         f"{res.states_generated} generated, "
+                         f"{res.states_generated / (now - t0):.0f} states/s")
+            frontier = next_frontier
+        res.diameter = depth
+    except TLAError as e:
+        res.ok = False
+        res.error = str(e)
+    res.distinct_states = len(states)
+    res.elapsed = time.time() - t0
+    return res

@@ -1,0 +1,53 @@
+"""Counterexample trace reconstruction and TLC-compatible printing.
+
+Reproduces the artifact format of the recorded violation trace
+(state_transfer_violation_trace.txt): per-state ``_TEAction`` records
+with position / action name / source location, followed by the full
+variable assignment in TLC syntax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.values import fmt
+
+
+@dataclass
+class TraceEntry:
+    position: int          # 1-based
+    action_name: str       # None for the initial state
+    location: str
+    state: dict
+
+
+def reconstruct_trace(sid, parents, states):
+    chain = []
+    cur = sid
+    while cur is not None:
+        parent, aname, aloc = parents[cur]
+        chain.append((cur, aname, aloc))
+        cur = parent
+    chain.reverse()
+    out = []
+    for i, (s, aname, aloc) in enumerate(chain):
+        out.append(TraceEntry(position=i + 1, action_name=aname,
+                              location=aloc, state=states[s]))
+    return out
+
+
+def format_trace(trace, varnames=None) -> str:
+    lines = []
+    for e in trace:
+        if e.action_name is None:
+            header = f"State {e.position}: <Initial predicate>"
+        else:
+            header = (f"State {e.position}: "
+                      f"<{e.action_name} {e.location or ''}>".rstrip() + ">")
+            header = header.replace(">>", ">")
+        lines.append(header)
+        names = varnames or sorted(e.state)
+        lines.append("/\\ " + "\n/\\ ".join(
+            f"{n} = {fmt(e.state[n])}" for n in names))
+        lines.append("")
+    return "\n".join(lines)
