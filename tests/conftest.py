@@ -1,0 +1,25 @@
+import os
+import sys
+
+# Tests run on a virtual 8-device CPU mesh so sharded code paths are
+# exercised without TPU hardware (the driver separately dry-runs the
+# multi-chip path). Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+REFERENCE = "/root/reference/vsr-revisited/paper"
+
+
+def reference_available():
+    return os.path.isdir(REFERENCE)
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(), reason="reference corpus not mounted")

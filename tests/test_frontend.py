@@ -1,0 +1,102 @@
+import glob
+
+import pytest
+
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.frontend.cfg import parse_cfg_text, parse_cfg_file
+from tpuvsr.frontend.parser import parse_expr_text, parse_module_file, parse_module_text
+from tpuvsr.core.values import ModelValue
+
+
+@requires_reference
+def test_parse_all_reference_modules():
+    files = [f"{REFERENCE}/VSR.tla"] + sorted(
+        glob.glob(f"{REFERENCE}/analysis/*/*.tla"))
+    assert len(files) == 8
+    for f in files:
+        m = parse_module_file(f)
+        assert m.defs and m.variables
+
+
+@requires_reference
+def test_parse_all_reference_cfgs():
+    files = [f"{REFERENCE}/VSR.cfg"] + sorted(
+        glob.glob(f"{REFERENCE}/analysis/*/*.cfg"))
+    assert len(files) == 5
+    for f in files:
+        cfg = parse_cfg_file(f)
+        assert cfg.constants
+        assert cfg.init or cfg.specification
+
+
+def test_junction_list_alignment():
+    e = parse_expr_text("""
+    /\\ a = 1
+    /\\ \\/ b = 2
+       \\/ c = 3
+    /\\ d = 4
+""".strip("\n"))
+    assert e[0] == "and" and len(e[1]) == 3
+    assert e[1][1][0] == "or" and len(e[1][1][1]) == 2
+
+
+def test_infix_precedence():
+    # = (5) looser than @@ (6) and :> (7)
+    e = parse_expr_text("x = y @@ (v :> FALSE)")
+    assert e[1] == "eq" and e[3][1] == "merge"
+    # + (10) tighter than .. (9)
+    e = parse_expr_text("a+1..b")
+    assert e[1] == "range" and e[2][1] == "plus"
+    # \div (13) tighter than >= (5)
+    e = parse_expr_text("c >= n \\div 2")
+    assert e[1] == "ge" and e[3][1] == "div"
+
+
+def test_except_paths():
+    e = parse_expr_text("[f EXCEPT ![r][c].executed = TRUE, ![x] = @ + 1]")
+    assert e[0] == "except"
+    (p1, _), (p2, v2) = e[2]
+    assert [k for k, _ in p1] == ["idx", "idx", "fld"]
+    assert v2[0] == "binop" and v2[2][0] == "at"
+
+
+def test_boxaction_and_wf():
+    e = parse_expr_text("Init /\\ [][Next]_vars /\\ WF_vars(Next)")
+    assert e[0] == "and"
+    tags = [x[0] for x in e[1]]
+    assert tags == ["id", "boxaction", "wf"]
+
+
+def test_quantifier_groups():
+    e = parse_expr_text("\\E r, rDest \\in replicas, m \\in DOMAIN messages : TRUE")
+    assert e[0] == "exists"
+    assert [names for names, _ in e[1]] == [["r", "rDest"], ["m"]]
+
+
+def test_cfg_model_values():
+    cfg = parse_cfg_text("""
+CONSTANTS
+    ReplicaCount = 3
+    Values = {v1, v2}
+    Nil = Nil
+INIT Init
+NEXT Next
+INVARIANT
+Inv1
+Inv2
+""")
+    assert cfg.constants["ReplicaCount"] == 3
+    assert cfg.constants["Values"] == frozenset({ModelValue("v1"), ModelValue("v2")})
+    assert cfg.constants["Nil"] is ModelValue("Nil")
+    assert cfg.invariants == ["Inv1", "Inv2"]
+
+
+def test_nested_block_comments():
+    m = parse_module_text("""---- MODULE T ----
+(* outer (* inner *) still comment *)
+VARIABLES x
+Init == x = 0
+Next == x' = x
+====
+""")
+    assert list(m.defs) == ["Init", "Next"]

@@ -1,0 +1,125 @@
+from collections import Counter
+
+import pytest
+
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.core.values import FnVal, ModelValue, TLAError, mk_seq
+from tpuvsr.engine.spec import SpecModel, load_spec
+from tpuvsr.frontend.cfg import parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_text
+
+
+def _mini(defs: str, variables="x", constants=""):
+    src = f"""---- MODULE M ----
+EXTENDS Naturals, FiniteSets, FiniteSetsExt, Sequences, TLC
+{('CONSTANTS ' + constants) if constants else ''}
+VARIABLES {variables}
+{defs}
+====
+"""
+    return parse_module_text(src)
+
+
+def _eval(expr_defs, name="E", **kw):
+    from tpuvsr.interp.evalr import EMPTY_ENV, EvalCtx, Evaluator
+    m = _mini(expr_defs, **kw)
+    ev = Evaluator(m, {})
+    return ev.eval(m.defs[name].body, EMPTY_ENV, EvalCtx({}))
+
+
+def test_eval_basics():
+    assert _eval("E == 2 + 3 * 4") == 14
+    assert _eval("E == 7 \\div 2") == 3
+    assert _eval("E == Cardinality({1, 2, 2})") == 2
+    assert _eval("E == 3..1") == frozenset()  # empty range, CP06:799 idiom
+    assert _eval("E == Len(Append(<<1, 2>>, 3))") == 3
+
+
+def test_eval_choose_deterministic():
+    v = _eval("E == CHOOSE z \\in {3, 1, 2} : z > 1")
+    assert v == 2  # least satisfying element under canonical order
+
+
+def test_eval_quantify_lambda():
+    assert _eval("E == Quantify(1..10, LAMBDA z : z % 2 = 0)") == 5
+
+
+def test_eval_except_nested():
+    v = _eval(
+        "E == [f EXCEPT ![1][2] = @ + 10]\n"
+        "f == [a \\in 1..2 |-> [b \\in 1..2 |-> a * b]]")
+    assert v.apply(1).apply(2) == 12
+
+
+def test_eval_record_merge_point():
+    v = _eval('E == [a |-> 1] @@ ("b" :> 2)')
+    assert v.apply("a") == 1 and v.apply("b") == 2
+
+
+def test_lazy_conjunction_masks_faults():
+    # SURVEY.md §2.7.1: a fault in an unreached branch must not raise
+    v = _eval("E == IF TRUE THEN 1 ELSE [x |-> 1].missing_field")
+    assert v == 1
+    with pytest.raises(TLAError):
+        _eval("E == IF FALSE THEN 1 ELSE [x |-> 1].missing_field")
+
+
+def test_fnctor_over_range():
+    v = _eval("E == [on \\in 2..4 |-> on * on]")
+    assert v.domain() == frozenset({2, 3, 4}) and v.apply(3) == 9
+
+
+def test_powerset():
+    v = _eval("E == SUBSET {1, 2}")
+    assert v == frozenset({frozenset(), frozenset({1}), frozenset({2}),
+                           frozenset({1, 2})})
+
+
+def test_recursive_operator():
+    v = _eval(
+        "E == Fact(5)\n"
+        "RECURSIVE Fact(_)\n"
+        "Fact(n) == IF n = 0 THEN 1 ELSE n * Fact(n - 1)")
+    assert v == 120
+
+
+@requires_reference
+def test_vsr_init_and_successors():
+    spec = load_spec(f"{REFERENCE}/VSR.tla", f"{REFERENCE}/VSR.cfg")
+    inits = list(spec.init_states())
+    assert len(inits) == 1
+    st = inits[0]
+    assert st["rep_view_number"].apply(1) == 1
+    assert st["messages"] == FnVal(())
+    succs = list(spec.successors(st))
+    counts = Counter(a.name for a, _ in succs)
+    # primary=1: 2 client requests (v1, v2); non-primaries 2,3: TimerSendSVC
+    assert counts == {"ReceiveClientRequest": 2, "TimerSendSVC": 2}
+
+
+@requires_reference
+def test_vsr_broadcast_bag_semantics():
+    spec = load_spec(f"{REFERENCE}/VSR.tla", f"{REFERENCE}/VSR.cfg")
+    st = next(iter(spec.init_states()))
+    for a, s in spec.successors(st):
+        if a.name == "ReceiveClientRequest":
+            msgs = s["messages"]
+            assert len(msgs) == 2          # Prepare to replicas 2 and 3
+            assert all(c == 1 for _, c in msgs.items)
+            for m, _ in msgs.items:
+                assert m.apply("type") is ModelValue("PrepareMsg")
+            break
+
+
+@requires_reference
+def test_vsr_discard_keeps_tombstone():
+    # SURVEY.md §2.7.4: delivery decrements to 0 but the domain entry stays
+    spec = load_spec(f"{REFERENCE}/VSR.tla", f"{REFERENCE}/VSR.cfg")
+    st = next(iter(spec.init_states()))
+    succ1 = next(s for a, s in spec.successors(st)
+                 if a.name == "ReceiveClientRequest")
+    succ2 = next(s for a, s in spec.successors(succ1)
+                 if a.name == "ReceivePrepareMsg")
+    msgs = succ2["messages"]
+    counts = sorted(c for _, c in msgs.items)
+    assert counts == [0, 1, 1]  # consumed Prepare stays at 0; PrepareOk added

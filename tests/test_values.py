@@ -1,0 +1,56 @@
+from tpuvsr.core.values import (FnVal, ModelValue, fmt, mk_record, mk_seq,
+                                permute_value, tla_eq, value_key)
+
+
+def test_fnval_canonical_equality():
+    a = FnVal([(1, "a"), (2, "b")])
+    b = FnVal([(2, "b"), (1, "a")])
+    assert a == b and hash(a) == hash(b)
+
+
+def test_sequence_view():
+    s = mk_seq(["a", "b", "c"])
+    assert s.is_sequence() and s.seq_len() == 3
+    assert s.seq_append("d").seq_elems() == ["a", "b", "c", "d"]
+    assert fmt(s) == '<<"a", "b", "c">>'
+    assert fmt(FnVal(())) == "<<>>"
+
+
+def test_merge_left_biased():
+    # f @@ g keeps f's value on common keys (TLC semantics, VSR.tla:231)
+    f = FnVal([(1, "f")])
+    g = FnVal([(1, "g"), (2, "g2")])
+    m = f.merge_left(g)
+    assert m.apply(1) == "f" and m.apply(2) == "g2"
+
+
+def test_non_one_based_domain_is_not_sequence():
+    # the NewState log slice idiom [on \in 2..3 |-> ...] (VSR.tla:535)
+    f = FnVal([(2, "x"), (3, "y")])
+    assert not f.is_sequence()
+    assert f.domain() == frozenset({2, 3})
+
+
+def test_model_value_identity():
+    assert ModelValue("Nil") is ModelValue("Nil")
+    assert not tla_eq(ModelValue("Nil"), ModelValue("Normal"))
+    assert not tla_eq(ModelValue("v1"), 1)
+
+
+def test_value_key_total_order():
+    vals = [True, 3, "s", ModelValue("a"), frozenset([1]), mk_record(x=1)]
+    keys = [value_key(v) for v in vals]
+    assert sorted(keys) == keys  # rank order bool < int < str < mv < set < fn
+
+
+def test_permute_recursive():
+    v1, v2 = ModelValue("v1"), ModelValue("v2")
+    st = FnVal([(v1, True), ("log", mk_seq([v1, v2]))])
+    p = permute_value(st, {v1: v2, v2: v1})
+    assert p.apply(v2) is True
+    assert p.apply("log").seq_elems() == [v2, v1]
+
+
+def test_cross_type_eq_false():
+    assert not tla_eq(mk_seq([]), ModelValue("Nil"))  # m.log # Nil, VSR:882
+    assert not tla_eq(True, 1)

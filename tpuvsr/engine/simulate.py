@@ -1,0 +1,79 @@
+"""Simulation mode: depth-bounded random walks (interpreter backend).
+
+The reference prescribes simulation as the practical route to the deep
+state-transfer violation (README:22, SURVEY.md §3.5): random walks of
+TLC-default depth 100, evaluating invariants at every visited state, no
+fingerprint set or queue.  The TPU engine vectorizes this embarrassingly
+parallel loop; this host implementation is its semantic oracle and the
+fallback for specs not yet lowered.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+
+from ..core.values import TLAError
+from .spec import SpecModel
+from .trace import TraceEntry
+
+
+@dataclass
+class SimResult:
+    ok: bool = True
+    walks: int = 0
+    steps: int = 0
+    violated_invariant: str = None
+    trace: list = field(default_factory=list)
+    elapsed: float = 0.0
+    deadlocks: int = 0
+
+    @property
+    def steps_per_sec(self):
+        return self.steps / self.elapsed if self.elapsed > 0 else 0.0
+
+
+def simulate(spec: SpecModel, num: int = 100, depth: int = 100,
+             seed: int = 0, check_deadlock: bool = False,
+             log=None, time_budget: float = None) -> SimResult:
+    rng = random.Random(seed)
+    res = SimResult()
+    t0 = time.time()
+    inits = list(spec.init_states())
+    for w in range(num):
+        res.walks = w + 1
+        state = rng.choice(inits)
+        walk = [(None, state)]
+        bad = spec.check_invariants(state)
+        for _d in range(depth):
+            succs = list(spec.successors(state))
+            if not succs:
+                if check_deadlock:
+                    res.ok = False
+                res.deadlocks += 1
+                break
+            action, state = rng.choice(succs)
+            walk.append((action, state))
+            res.steps += 1
+            bad = spec.check_invariants(state)
+            if bad:
+                break
+        if bad:
+            res.ok = False
+            res.violated_invariant = bad
+            res.trace = [
+                TraceEntry(position=i + 1,
+                           action_name=a.name if a else None,
+                           location=a.location if a else None,
+                           state=s)
+                for i, (a, s) in enumerate(walk)]
+            break
+        if log and (w + 1) % 10 == 0:
+            el = time.time() - t0
+            log(f"{w + 1}/{num} walks, {res.steps} steps, "
+                f"{res.steps / el:.0f} steps/s")
+        if time_budget and time.time() - t0 > time_budget:
+            break
+    res.elapsed = time.time() - t0
+    return res

@@ -238,9 +238,11 @@ class Parser:
             self.next()
             right = self.parse_expr(min_col, lbp)
             if tag == "and":
-                left = ("and", [left, right])
+                items = left[1] if left[0] == "and" else [left]
+                left = ("and", items + [right])
             elif tag == "or":
-                left = ("or", [left, right])
+                items = left[1] if left[0] == "or" else [left]
+                left = ("or", items + [right])
             else:
                 left = ("binop", tag, left, right)
         return left
