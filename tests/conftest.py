@@ -3,18 +3,61 @@ import sys
 
 # Tests run on a virtual 8-device CPU mesh so sharded code paths are
 # exercised without TPU hardware (the driver separately dry-runs the
-# multi-chip path). Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# multi-chip path).  Must be set before jax is imported anywhere, and
+# must OVERRIDE the session env: the image bakes JAX_PLATFORMS=axon and
+# a sitecustomize that registers the tunneled-TPU plugin, whose backend
+# init hangs every process when the tunnel is down — force pure CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize may have imported jax already (to register the plugin),
+# in which case the env var was captured before we set it — override the
+# live config too.
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
 REFERENCE = "/root/reference/vsr-revisited/paper"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running differential tests")
+
+
+def state_key(st):
+    """Hashable identity of a full interpreter state dict."""
+    return frozenset(st.items())
+
+
+def explore_states(spec, limit):
+    """Collect up to `limit` distinct reachable states in BFS order."""
+    seen = {}
+    frontier = []
+    for st in spec.init_states():
+        k = state_key(st)
+        if k not in seen:
+            seen[k] = st
+            frontier.append(st)
+    while frontier and len(seen) < limit:
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                k = state_key(succ)
+                if k not in seen:
+                    seen[k] = succ
+                    nxt.append(succ)
+                    if len(seen) >= limit:
+                        return list(seen.values())
+        frontier = nxt
+    return list(seen.values())
 
 
 def reference_available():

@@ -8,16 +8,12 @@ the message bag's tombstones and the implied-field-compressed recv sets
 
 import pytest
 
-from tests.conftest import REFERENCE, requires_reference
-from tpuvsr.core.values import value_key
+from tests.conftest import (REFERENCE, explore_states, requires_reference,
+                            state_key)
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
 from tpuvsr.models.vsr import VSRCodec
-
-
-def state_key(st):
-    return tuple(sorted((name, value_key(v)) for name, v in st.items()))
 
 
 def _vsr_spec(values=("v1",), timer=1, restarts=0):
@@ -31,27 +27,6 @@ def _vsr_spec(values=("v1",), timer=1, restarts=0):
     return SpecModel(mod, cfg)
 
 
-def _explore(spec, n):
-    """BFS-order list of the first n reachable states."""
-    seen = set()
-    out = []
-    frontier = list(spec.init_states())
-    while frontier and len(out) < n:
-        nxt = []
-        for st in frontier:
-            k = state_key(st)
-            if k in seen:
-                continue
-            seen.add(k)
-            out.append(st)
-            if len(out) >= n:
-                break
-            for _a, s2 in spec.successors(st):
-                nxt.append(s2)
-        frontier = nxt
-    return out
-
-
 @requires_reference
 @pytest.mark.parametrize("values,timer,restarts,n", [
     (("v1",), 1, 0, 250),
@@ -61,7 +36,7 @@ def _explore(spec, n):
 def test_roundtrip_reachable_states(values, timer, restarts, n):
     spec = _vsr_spec(values, timer, restarts)
     codec = VSRCodec(spec.cfg.constants)
-    states = _explore(spec, n)
+    states = explore_states(spec, n)
     assert len(states) > 50
     for st in states:
         dense = codec.encode(st)

@@ -116,6 +116,13 @@ def shape_from_cfg(constants, max_msgs=None):
         raise TLAError(
             "dense layout requires ClientCount = 1: the reference spec "
             "faults for C > 1 (dead m.commit field, VSR.tla:421)")
+    # Field-width bounds of the packed log-entry sort key used for the
+    # kernel's deterministic CHOOSE (vsr_kernel._entry_sort_key): client
+    # 4 bits, operation 4 bits, request_number 8 bits, view 8 bits.
+    if V >= 16 or 1 + T + restarts >= 256:
+        raise TLAError(
+            f"config exceeds packed sort-key field widths (V={V} < 16, "
+            f"max view {1 + T + restarts} < 256 required)")
     if max_msgs is None:
         # Broadcasts insert <= R-1 distinct rows; the distinct-message
         # universe is bounded but loose — start generous, the kernel
@@ -275,11 +282,12 @@ class VSRCodec:
                                      row.apply("op_number"),
                                      1 if row.apply("executed") else 0]
             for m in st["rep_svc_recv"].apply(r):
-                assert m.apply("view_number") == d["view"][i] and m.apply("dest") == r, \
-                    "svc_recv implied-field invariant violated"
+                if m.apply("view_number") != d["view"][i] or m.apply("dest") != r:
+                    raise TLAError("svc_recv implied-field invariant violated")
                 d["svc"][i][m.apply("source") - 1] = 1
             for m in st["rep_dvc_recv"].apply(r):
-                assert m.apply("view_number") == d["view"][i] and m.apply("dest") == r
+                if m.apply("view_number") != d["view"][i] or m.apply("dest") != r:
+                    raise TLAError("dvc_recv implied-field invariant violated")
                 j = m.apply("source") - 1
                 if d["dvc"][i][j]:
                     raise TLAError("DVC slot collision: restart-era spec "
@@ -294,7 +302,8 @@ class VSRCodec:
             d["sent_sv"][i] = 1 if st["rep_sent_sv"].apply(r) else 0
             d["rec_number"][i] = st["rep_rec_number"].apply(r)
             for m in st["rep_rec_recv"].apply(r):
-                assert m.apply("x") == d["rec_number"][i] and m.apply("dest") == r
+                if m.apply("x") != d["rec_number"][i] or m.apply("dest") != r:
+                    raise TLAError("rec_recv implied-field invariant violated")
                 j = m.apply("source") - 1
                 if d["rec"][i][j]:
                     raise TLAError("recovery-response slot collision")
