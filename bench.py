@@ -1,0 +1,107 @@
+"""Headline benchmark: distinct states/sec of the device BFS engine on
+the shrunken flagship config (BASELINE.json configs[0]: VSR.tla with
+R=3, C=1, Values={v1}, StartViewOnTimerLimit=1 — 43,941 distinct
+states, diameter 24).
+
+Prints ONE JSON line {metric, value, unit, vs_baseline}.
+vs_baseline = device states/sec over the single-thread interpreter
+oracle's states/sec on the same spec (the stand-in for the reference's
+explicit-state checker until a TLC number is recorded; the reference
+publishes no throughput figures — SURVEY.md §6).
+
+Robustness: the session TPU is reached through a tunnel that can hang
+backend init; the platform is probed in a subprocess with a timeout and
+the bench falls back to CPU if the tunnel is down.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+REFERENCE = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+INTERP_STATES = int(os.environ.get("BENCH_INTERP_STATES", "4000"))
+
+
+def _probe_default_backend(timeout=180):
+    """Can the session's default JAX platform initialize?  Run the probe
+    in a subprocess: a dead TPU tunnel hangs backend init forever."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout)
+        if r.returncode == 0:
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def main():
+    backend = _probe_default_backend()
+    if backend is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        backend = "cpu (tpu tunnel unavailable)"
+    import jax
+    if backend.startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    print(f"bench: backend = {backend}", file=sys.stderr)
+
+    from tpuvsr.core.values import ModelValue
+    from tpuvsr.engine.bfs import bfs_check
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.engine.spec import SpecModel
+    from tpuvsr.frontend.cfg import parse_cfg_file
+    from tpuvsr.frontend.parser import parse_module_file
+
+    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
+    cfg.constants["Values"] = frozenset({ModelValue("v1")})
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    cfg.constants["RestartEmptyLimit"] = 0
+    cfg.symmetry = None
+
+    # baseline: single-thread interpreter (exact TLC-style enumeration)
+    spec = SpecModel(mod, cfg)
+    base = bfs_check(spec, max_states=INTERP_STATES)
+    base_sps = base.states_generated / base.elapsed
+    print(f"bench: interpreter baseline {base_sps:.0f} generated/s",
+          file=sys.stderr)
+
+    # device engine: warm up compile on a depth-limited run, then measure
+    tile = int(os.environ.get("BENCH_TILE", "64"))
+    eng = DeviceBFS(spec, tile_size=tile)
+    t0 = time.time()
+    eng.run(max_depth=1)
+    print(f"bench: compile+warmup {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    eng2 = DeviceBFS(spec, tile_size=tile)
+    res = eng2.run(max_seconds=BUDGET_S,
+                   log=lambda m: print(f"bench: {m}", file=sys.stderr))
+    dev_sps = res.states_generated / res.elapsed
+    distinct_sps = res.distinct_states / res.elapsed
+    print(f"bench: device {res.distinct_states} distinct "
+          f"({res.error or 'fixpoint'}), {dev_sps:.0f} generated/s, "
+          f"{distinct_sps:.0f} distinct/s, diameter {res.diameter}",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "VSR.tla BFS distinct states/sec "
+                  "(R=3, |Values|=1, timer=1)",
+        "value": round(distinct_sps, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(dev_sps / base_sps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,209 @@
+"""Differential tests: device BFS engine vs the interpreter oracle.
+
+Distinct-state counts, per-level frontier sizes, diameters, and
+invariant verdicts must agree between the TPU pipeline (dense kernel +
+128-bit FPSet dedup) and the exact interpreter BFS (canonical-value
+dedup) on small configs — the framework's analog of matching TLC's
+distinct-state counts (SURVEY.md §4.7).
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE, explore_states, requires_reference
+from tpuvsr.core.values import ModelValue
+from tpuvsr.engine.device_bfs import DeviceBFS, device_bfs_check
+from tpuvsr.engine.fpset import dedup_batch, empty_table, insert_batch
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+
+
+# ---------------------------------------------------------------------
+# FPSet unit tests
+# ---------------------------------------------------------------------
+def test_fpset_insert_and_dup():
+    rng = np.random.default_rng(7)
+    fps = rng.integers(0, 2**32, size=(512, 4), dtype=np.uint64).astype(
+        np.uint32)
+    table = empty_table(1 << 12)
+    mask = np.ones((512,), bool)
+    table, fresh, ovf = insert_batch(table, fps, mask)
+    assert not bool(ovf) and np.asarray(fresh).all()
+    # same batch again: nothing fresh
+    table, fresh2, _ = insert_batch(table, fps.copy(), mask)
+    assert not np.asarray(fresh2).any()
+    # half old, half new
+    fps3 = np.concatenate([fps[:256], rng.integers(
+        0, 2**32, size=(256, 4), dtype=np.uint64).astype(np.uint32)])
+    table, fresh3, _ = insert_batch(table, fps3, mask)
+    f3 = np.asarray(fresh3)
+    assert not f3[:256].any() and f3[256:].all()
+
+
+def test_fpset_grow_preserves_membership_with_zero_word0():
+    # a fingerprint whose word 0 is 0 is claim-tag-remapped to 1; the
+    # probe chain must be derived from the remapped key so a table
+    # rebuilt by grow() still recognizes it as a duplicate
+    from tpuvsr.engine.fpset import grow
+    fps = np.array([[0, 11, 22, 33], [7, 1, 2, 3]], dtype=np.uint32)
+    mask = np.ones((2,), bool)
+    table = empty_table(1 << 8)
+    table, fresh, _ = insert_batch(table, fps, mask)
+    assert np.asarray(fresh).all()
+    table = grow(table)
+    table, fresh2, _ = insert_batch(table, fps.copy(), mask)
+    assert not np.asarray(fresh2).any()
+
+
+def test_fpset_overflow_reports_unresolved():
+    # over-full table: insert reports ovf and the unresolved lanes are
+    # NOT marked fresh (the engine grows the table and re-inserts)
+    rng = np.random.default_rng(3)
+    fps = rng.integers(0, 2**32, size=(128, 4), dtype=np.uint64).astype(
+        np.uint32)
+    mask = np.ones((128,), bool)
+    table = empty_table(64)
+    table, fresh, ovf = insert_batch(table, fps, mask)
+    assert bool(ovf)
+    n1 = int(np.asarray(fresh).sum())
+    assert n1 < 128
+    # grow + re-insert resolves the rest exactly once
+    from tpuvsr.engine.fpset import grow
+    table = grow(table)
+    table, fresh2, ovf2 = insert_batch(table, fps.copy(), mask)
+    assert not bool(ovf2)
+    assert int(np.asarray(fresh2).sum()) == 128 - n1
+    assert not (np.asarray(fresh) & np.asarray(fresh2)).any()
+
+
+def test_fpset_dedup_batch():
+    fps = np.array([[1, 2, 3, 4], [5, 6, 7, 8], [1, 2, 3, 4], [9, 9, 9, 9],
+                    [5, 6, 7, 8]], dtype=np.uint32)
+    mask = np.array([True, True, True, False, True])
+    perm, keep = dedup_batch(fps, mask)
+    kept = set(map(tuple, np.asarray(fps)[np.asarray(perm)][np.asarray(keep)]))
+    assert kept == {(1, 2, 3, 4), (5, 6, 7, 8)}
+    assert int(np.asarray(keep).sum()) == 2
+
+
+# ---------------------------------------------------------------------
+# engine differential tests
+# ---------------------------------------------------------------------
+def _vsr_spec(values=("v1",), timer=1, restarts=0, symmetry=False):
+    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
+    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
+    cfg.constants["StartViewOnTimerLimit"] = timer
+    cfg.constants["RestartEmptyLimit"] = restarts
+    if not symmetry:
+        cfg.symmetry = None
+    return SpecModel(mod, cfg)
+
+
+def _interp_levels(spec, max_depth=None):
+    """Exact per-level BFS frontier sizes via the interpreter."""
+    seen = set()
+    frontier = []
+    for st in spec.init_states():
+        k = spec.view_value(st)
+        if k not in seen:
+            seen.add(k)
+            frontier.append(st)
+    sizes = [len(frontier)]
+    depth = 0
+    while frontier and (max_depth is None or depth < max_depth):
+        depth += 1
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                k = spec.view_value(succ)
+                if k not in seen:
+                    seen.add(k)
+                    nxt.append(succ)
+        frontier = nxt
+        if nxt:
+            sizes.append(len(nxt))
+    return sizes, len(seen), depth
+
+
+@requires_reference
+def test_device_bfs_fixpoint_no_viewchange():
+    # timer=0: only the normal-op sub-protocol is reachable
+    spec = _vsr_spec(values=("v1",), timer=0)
+    sizes, total, diameter = _interp_levels(spec)
+    eng = DeviceBFS(spec, tile_size=8)
+    res = eng.run()
+    assert res.ok and res.error is None
+    assert res.distinct_states == total
+    assert eng.level_sizes == sizes
+    assert res.diameter == diameter
+
+
+@requires_reference
+def test_device_bfs_with_tiny_fpset_grows():
+    # force FPSet growth mid-run; counts must be unaffected
+    spec = _vsr_spec(values=("v1",), timer=0)
+    sizes, total, _ = _interp_levels(spec)
+    eng = DeviceBFS(spec, tile_size=8, fpset_capacity=16)
+    res = eng.run()
+    assert res.ok and res.distinct_states == total
+    assert eng.level_sizes == sizes
+
+
+@requires_reference
+@pytest.mark.slow
+def test_device_bfs_levels_with_viewchange():
+    spec = _vsr_spec(values=("v1",), timer=1)
+    sizes, total, _ = _interp_levels(spec, max_depth=5)
+    eng = DeviceBFS(spec, tile_size=32)
+    res = eng.run(max_depth=5)
+    assert res.ok
+    assert eng.level_sizes[:6] == sizes[:6]
+    assert res.distinct_states == total
+
+
+@requires_reference
+@pytest.mark.slow
+def test_device_bfs_recovery_fixpoint():
+    # exercises RestartEmpty/Recovery*/CompleteRecovery and tombstone
+    # revival on device to fixpoint
+    spec = _vsr_spec(values=("v1",), timer=0, restarts=1)
+    sizes, total, _ = _interp_levels(spec)
+    eng = DeviceBFS(spec, tile_size=32)
+    res = eng.run()
+    assert res.ok and res.error is None
+    assert res.distinct_states == total
+    assert eng.level_sizes == sizes
+
+
+@requires_reference
+@pytest.mark.slow
+def test_device_bfs_symmetry_levels():
+    # |Values|=2 with Permutations symmetry: device min-over-perm
+    # fingerprints must induce the same partition as the interpreter's
+    # canonical min-permutation view values
+    spec = _vsr_spec(values=("v1", "v2"), timer=1, symmetry=True)
+    sizes, total, _ = _interp_levels(spec, max_depth=4)
+    eng = DeviceBFS(spec, tile_size=32)
+    res = eng.run(max_depth=4)
+    assert res.ok
+    assert eng.level_sizes[:5] == sizes[:5]
+    assert res.distinct_states == total
+
+
+@requires_reference
+def test_invariant_kernels_match_interpreter():
+    spec = _vsr_spec(values=("v1", "v2"), timer=1)
+    eng = DeviceBFS(spec)
+    kern, codec = eng.kern, eng.codec
+    states = explore_states(spec, 120)[::3]
+    import jax
+    for name in ("AcknowledgedWriteNotLost",
+                 "AcknowledgedWritesExistOnMajority", "NoLogDivergence"):
+        fn = jax.jit(kern.invariant_fn([name]))
+        for st in states:
+            dense = codec.encode(st)
+            got = bool(fn({k: np.asarray(v) for k, v in dense.items()}))
+            want = spec.eval_predicate(name, st)
+            assert got == want, f"{name} differs"

@@ -1,0 +1,343 @@
+"""Device (TPU) breadth-first model checking engine.
+
+This is the reference's hot loop — TLC's BFS worker (SURVEY.md §3.1) —
+restructured as a data-parallel XLA pipeline.  Per frontier tile of T
+states, entirely on device:
+
+  tile --step_batch--> [T, L] lane successors     (vsr_kernel.step_all)
+       --fingerprint--> symmetry-least 128-bit fp (VIEW projection)
+       --invariants --> per-successor pass/fail   (checked on *every*
+                        generated state — a superset of TLC's
+                        fresh-only checking, sound because generated
+                        states are reachable)
+       --dedup+FPSet--> fresh mask                (engine/fpset.py)
+       --compaction --> packed fresh states, transferred host-side only
+
+The host orchestrates tiles, owns the frontier (numpy), and keeps
+(parent, action, lane) pointers per state for counterexample
+reconstruction in the reference's trace format (TRACE:3-7).
+
+Scale note: frontier + visited states live in host RAM (the device holds
+only fingerprints + the working tile), so capacity is host-memory-bound
+at ~5 KB/state; fingerprints in HBM at 16 B/state.  Multi-host sharding
+is the next tier (SURVEY.md §5 distributed backend).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.values import TLAError
+from ..models.vsr import ERR_BAG_OVERFLOW, VSRCodec
+from ..models.vsr_kernel import ACTION_NAMES, VSRKernel
+from .bfs import CheckResult
+from .fpset import dedup_batch, empty_table, grow, insert_batch
+from .spec import SpecModel
+from .trace import TraceEntry
+
+
+def _value_perm_table(spec, codec):
+    """spec.symmetry_perms (ModelValue maps) -> [P, V+1] id table with the
+    identity first (kernel takes the min over rows)."""
+    V = codec.shape.V
+    rows = [np.arange(V + 1, dtype=np.int32)]
+    for p in spec.symmetry_perms:
+        row = np.arange(V + 1, dtype=np.int32)
+        for mv_from, mv_to in p.items():
+            row[codec.value_id[mv_from]] = codec.value_id[mv_to]
+        rows.append(row)
+    return np.stack(rows)
+
+
+class _StateStore:
+    """Host-side registry of visited dense states, appended per batch;
+    gid -> state row lookup for trace reconstruction."""
+
+    def __init__(self):
+        self.chunks = []          # list of dict-of-np [n_i, ...]
+        self.offsets = [0]
+        self.parents = []         # gid -> (parent_gid | None, action_id)
+
+    def append(self, states, parent_gids, action_ids):
+        n = len(parent_gids)
+        if n:
+            self.chunks.append(states)
+            self.offsets.append(self.offsets[-1] + n)
+            self.parents.extend(zip(parent_gids, action_ids))
+        return self.offsets[-1]
+
+    def __len__(self):
+        return self.offsets[-1]
+
+    def get(self, gid):
+        import bisect
+        c = bisect.bisect_right(self.offsets, gid) - 1
+        row = gid - self.offsets[c]
+        return {k: v[row] for k, v in self.chunks[c].items()}
+
+
+class DeviceBFS:
+    def __init__(self, spec: SpecModel, max_msgs=None, tile_size=32,
+                 fpset_capacity=1 << 20):
+        self.spec = spec
+        self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
+        self.kern = VSRKernel(self.codec,
+                              perms=_value_perm_table(spec, self.codec))
+        self.tile = tile_size
+        self.fpset_capacity = fpset_capacity
+        self.L = self.kern.n_lanes
+        names = list(spec.cfg.invariants)
+        inv = self.kern.invariant_fn(names)
+        self.inv_names = names
+        kern = self.kern
+
+        def hash_dedup(succs, en):
+            """fingerprint + invariants + intra-batch dedup; independent
+            of the FPSet so a table growth never recompiles it."""
+            fps = jax.vmap(kern.fingerprint)(succs)
+            inv_ok = jax.vmap(inv)(succs)
+            viol = en & ~inv_ok
+            err = jnp.where(en, succs["err"], 0)
+            err_bag = ((err & ERR_BAG_OVERFLOW) != 0).any()
+            err_slot = ((err & ~ERR_BAG_OVERFLOW) != 0).any()
+            perm, cand = dedup_batch(fps, en)
+            return (fps, perm, cand, viol.any(), jnp.argmax(viol),
+                    err_bag, err_slot)
+
+        def pack(succs, fps, perm, fresh):
+            """compact globally-fresh lanes to the front for transfer."""
+            order = jnp.argsort(~fresh, stable=True)
+            sel = perm[order]
+            packed = {k: v[sel] for k, v in succs.items()}
+            return packed, fps[sel], sel, fresh.sum()
+
+        self._hash = jax.jit(hash_dedup)
+        self._pack = jax.jit(pack)
+
+    # ------------------------------------------------------------------
+    def run(self, max_states=None, max_depth=None, max_seconds=None,
+            check_deadlock=False, log=None,
+            progress_every=10.0) -> CheckResult:
+        spec, codec, kern = self.spec, self.codec, self.kern
+        res = CheckResult()
+        t0 = time.time()
+        store = _StateStore()
+        fp_cap = self.fpset_capacity
+        table = empty_table(fp_cap)
+        fp_count = 0
+
+        def emit(msg):
+            if log:
+                log(msg)
+
+        # --- register init states (host path, tiny) -------------------
+        init_dense = [codec.encode(st) for st in spec.init_states()]
+        init_batch = {k: np.stack([d[k] for d in init_dense])
+                      for k in init_dense[0]}
+        fps = np.asarray(kern.fingerprint_batch(init_batch))
+        keep, seen = [], set()
+        for i in range(len(init_dense)):
+            key = tuple(fps[i])
+            if key not in seen:
+                seen.add(key)
+                keep.append(i)
+        init_batch = {k: v[keep] for k, v in init_batch.items()}
+        table, fresh, _ = insert_batch(
+            table, jnp.asarray(fps[keep]),
+            jnp.ones((len(keep),), bool))
+        fp_count += len(keep)
+        store.append(init_batch, [None] * len(keep), [None] * len(keep))
+        for i in range(len(keep)):
+            bad = self._check_invariants_host(init_batch, i)
+            if bad:
+                res.ok = False
+                res.violated_invariant = bad
+                res.trace = self._trace(store, i)
+                return self._finish(res, store, t0, 0)
+        res.states_generated += len(init_dense)
+        frontier = init_batch
+        level_base = 0
+        depth = 0
+        last_progress = t0
+
+        self.level_sizes = [len(frontier["status"])]
+        while len(frontier["status"]) > 0:
+            if max_depth is not None and depth >= max_depth:
+                res.error = f"depth limit {max_depth} reached"
+                break
+            depth += 1
+            n_front = len(frontier["status"])
+            fresh_chunks, fresh_parents, fresh_actions = [], [], []
+            for off in range(0, n_front, self.tile):
+                tile = {k: v[off:off + self.tile]
+                        for k, v in frontier.items()}
+                n_valid = len(tile["status"])
+                if n_valid < self.tile:
+                    pad = self.tile - n_valid
+                    tile = {k: np.concatenate(
+                        [v, np.repeat(v[:1], pad, axis=0)])
+                        for k, v in tile.items()}
+                valid = np.arange(self.tile) < n_valid
+
+                succs, en = kern.step_batch(tile)
+                en = en & jnp.asarray(valid)[:, None]
+                if check_deadlock:
+                    dead = valid & ~np.asarray(en.any(axis=1))
+                    if dead.any():
+                        gid = level_base + off + int(np.argmax(dead))
+                        res.ok = False
+                        res.error = "deadlock"
+                        res.deadlock_state = self.codec.decode(store.get(gid))
+                        res.trace = self._trace(store, gid)
+                        res.diameter = depth
+                        return self._finish(res, store, t0, depth)
+                flat = {k: v.reshape((self.tile * self.L,) + v.shape[2:])
+                        for k, v in succs.items()}
+                en_flat = en.reshape(-1)
+                (fps, perm, cand, has_viol, viol_idx, err_bag,
+                 err_slot) = self._hash(flat, en_flat)
+
+                if bool(err_slot):
+                    raise TLAError(
+                        "dense-layout slot collision (a second DVC or "
+                        "recovery response from one source in one view): "
+                        "this restart-era interleaving needs the "
+                        "multi-slot layout (vsr.py docstring)")
+                if bool(err_bag):
+                    raise _KernelOverflow()
+                res.states_generated += int(np.asarray(en_flat).sum())
+
+                if bool(has_viol):
+                    # a generated state violates an invariant: name it
+                    # on host and reconstruct the trace
+                    vi = int(viol_idx)
+                    vstate = {k: np.asarray(v[vi]) for k, v in flat.items()}
+                    parent_gid = level_base + off + vi // self.L
+                    lane = vi % self.L
+                    bad = self._check_invariants_host(
+                        {k: v[None] for k, v in vstate.items()}, 0)
+                    res.ok = False
+                    res.violated_invariant = bad or self.inv_names[0]
+                    res.trace = self._trace(
+                        store, parent_gid,
+                        extra=(vstate, int(kern.lane_action[lane])))
+                    res.diameter = depth
+                    return self._finish(res, store, t0, depth)
+
+                fps_sorted = fps[perm]
+                while True:
+                    table, fresh, ovf = insert_batch(table, fps_sorted, cand)
+                    packed, pfps, sel, n_fresh = self._pack(
+                        flat, fps, perm, fresh)
+                    n = int(n_fresh)
+                    if n:
+                        fp_count += n
+                        pack_np = {k: np.asarray(v[:n])
+                                   for k, v in packed.items()}
+                        sel_np = np.asarray(sel[:n])
+                        fresh_chunks.append(pack_np)
+                        fresh_parents.append(
+                            level_base + off + sel_np // self.L)
+                        fresh_actions.append(
+                            kern.lane_action[sel_np % self.L])
+                    if bool(ovf) or fp_count > 0.6 * fp_cap:
+                        # probe overflow dropped unresolved lanes from
+                        # the insert: grow the table and re-insert —
+                        # already-inserted fingerprints come back as
+                        # duplicates, previously unresolved ones as fresh
+                        table = grow(table)
+                        fp_cap *= 4
+                        if bool(ovf):
+                            continue
+                    break
+
+                now = time.time()
+                if now - last_progress >= progress_every:
+                    last_progress = now
+                    emit(f"depth {depth}: {len(store)} distinct, "
+                         f"{res.states_generated} generated, "
+                         f"{res.states_generated / (now - t0):.0f} states/s")
+
+            if not fresh_chunks:
+                break
+            nxt = {k: np.concatenate([c[k] for c in fresh_chunks])
+                   for k in fresh_chunks[0]}
+            parents = np.concatenate(fresh_parents)
+            actions = np.concatenate(fresh_actions)
+            level_base = store.append(nxt, parents.tolist(), actions.tolist())
+            level_base -= len(parents)
+            frontier = nxt
+            self.level_sizes.append(len(parents))
+            if max_states and len(store) >= max_states:
+                res.error = f"state limit {max_states} reached"
+                break
+            if max_seconds and time.time() - t0 > max_seconds:
+                res.error = f"time budget {max_seconds}s reached"
+                break
+
+        res.diameter = depth
+        return self._finish(res, store, t0, depth)
+
+    # ------------------------------------------------------------------
+    def _finish(self, res, store, t0, depth):
+        res.distinct_states = len(store)
+        res.elapsed = time.time() - t0
+        return res
+
+    def _check_invariants_host(self, batch, i):
+        """Name the violated invariant for one dense state (decode +
+        interpreter evaluation; only used on the violation path)."""
+        st = self.codec.decode({k: v[i] for k, v in batch.items()})
+        return self.spec.check_invariants(st)
+
+    def _trace(self, store, gid, extra=None):
+        """Walk parent pointers to the init state, decode, and emit
+        TRACE-format entries (action name + source location)."""
+        loc = {a.name: a.location for a in self.spec.actions}
+        chain = []
+        cur = gid
+        while cur is not None:
+            parent, aid = store.parents[cur]
+            chain.append((store.get(cur), aid))
+            cur = parent
+        chain.reverse()
+        if extra is not None:
+            vstate, aid = extra
+            chain.append((vstate, aid))
+        out = []
+        for pos, (dense, aid) in enumerate(chain):
+            name = ACTION_NAMES[aid] if aid is not None else None
+            out.append(TraceEntry(
+                position=pos + 1, action_name=name,
+                location=loc.get(name), state=self.codec.decode(dense)))
+        return out
+
+
+class _KernelOverflow(Exception):
+    pass
+
+
+def device_bfs_check(spec: SpecModel, max_states=None, max_depth=None,
+                     check_deadlock=False, tile_size=32, max_msgs=None,
+                     log=None) -> CheckResult:
+    """Run the device BFS, growing the message-slot table on overflow
+    (the dense layout's only dynamic bound, vsr.py)."""
+    attempts = 0
+    while True:
+        eng = DeviceBFS(spec, max_msgs=max_msgs, tile_size=tile_size)
+        try:
+            return eng.run(max_states=max_states, max_depth=max_depth,
+                           check_deadlock=check_deadlock, log=log)
+        except _KernelOverflow:
+            attempts += 1
+            if attempts > 3:
+                raise TLAError("message table overflow after 3 growths")
+            max_msgs = eng.codec.shape.MAX_MSGS * 2
+            if log:
+                log(f"message table overflow; retrying with "
+                    f"MAX_MSGS={max_msgs}")

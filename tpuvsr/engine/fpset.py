@@ -1,0 +1,134 @@
+"""Device-resident fingerprint set (the TLC FPSet rebuilt for HBM).
+
+The reference workload drove TLC's disk-spilling FPSet to 500 GB
+(README:20); the TPU engine instead keeps 128-bit fingerprints in an
+HBM-resident open-addressing hash table and batch-inserts an entire
+frontier expansion per call (SURVEY.md §2.5).
+
+Layout: a claim array ``tags[CAP]`` holding word 0 of each fingerprint
+(0 = empty; fingerprints with word 0 == 0 are remapped to 1) and a
+payload array ``rows[CAP, 3]`` holding words 1..3.  Insertion is
+claim-then-verify linear probing, fully vectorized over the batch:
+
+  1. gather the tag at each lane's probe slot;
+  2. lanes seeing their own tag compare the payload — equal means
+     duplicate (resolved, not fresh);
+  3. lanes seeing empty scatter-claim the tag and payload, then re-read;
+     a lane that reads back its own tag AND payload won (resolved,
+     fresh) — losers and tag-collision victims probe the next slot.
+
+Batches must be intra-batch deduplicated first (two lanes carrying the
+same fingerprint would both win), which `dedup_batch` does with a
+lexicographic sort.  Like TLC's 64-bit fingerprinting, set membership is
+probabilistic: a 128-bit collision (or a same-slot claim-tag collision
+at ~2^-32 per probing pair, which can ghost one entry) silently merges
+two states; both are vanishingly unlikely at reachable-set sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+MAX_PROBES = 64
+
+
+def empty_table(capacity: int):
+    """capacity must be a power of two."""
+    assert capacity & (capacity - 1) == 0
+    return {"tags": jnp.zeros((capacity,), U32),
+            "rows": jnp.zeros((capacity, 3), U32)}
+
+
+def _slot_hash(fps):
+    """[B, 4] -> [B] uint32 probe-start; decorrelated from the claim tag
+    (word 0) so clustered tags don't cluster slots."""
+    h = fps[:, 0] ^ (fps[:, 1] * jnp.uint32(0x9E3779B1))
+    h = h ^ (fps[:, 2] * jnp.uint32(0x85EBCA6B)) ^ (fps[:, 3] >> 5)
+    h = h ^ (h >> 15)
+    return h * jnp.uint32(0x27D4EB2F)
+
+
+def dedup_batch(fps, mask):
+    """Keep the first occurrence of each distinct fingerprint.
+
+    Returns (perm, keep): `perm` sorts the batch so equal fingerprints
+    are adjacent (masked-out lanes sort to the end), `keep[i]` marks
+    lanes of fps[perm] that are valid first occurrences.
+    """
+    key = [jnp.where(mask, fps[:, i], jnp.uint32(0xFFFFFFFF))
+           for i in range(4)]
+    perm = jnp.lexsort((key[3], key[2], key[1], key[0]))
+    sfps = fps[perm]
+    smask = mask[perm]
+    neq = (sfps[1:] != sfps[:-1]).any(axis=1)
+    first = jnp.concatenate([jnp.ones((1,), bool), neq])
+    return perm, first & smask
+
+
+def insert_core(table, fps, mask):
+    """Insert fps[mask] into the table; fps must be intra-batch unique
+    among masked lanes.  Returns (table, fresh, overflow) where fresh
+    marks lanes whose fingerprint was not previously in the table.
+    Plain traceable function — compose inside a jit (insert_batch is the
+    standalone jitted form)."""
+    cap = table["tags"].shape[0]
+    capm = jnp.uint32(cap - 1)
+    tag = jnp.where(fps[:, 0] == 0, jnp.uint32(1), fps[:, 0])
+    row = fps[:, 1:]
+    # probe chain is derived from the *canonical* key (word 0 after the
+    # 0->1 claim remap) so a table rebuilt by grow() from stored
+    # (tag, row) pairs probes identically to future lookups
+    h0 = _slot_hash(jnp.concatenate([tag[:, None], row], axis=1))
+
+    def body(t, carry):
+        tags, rows, unresolved, fresh = carry
+        idx = (h0 + jnp.uint32(t)) & capm
+        cur_tag = tags[idx]
+        cur_row = rows[idx]
+        mine = (cur_tag == tag) & (cur_row == row).all(axis=1)
+        dup = unresolved & mine
+        empty = unresolved & (cur_tag == 0)
+        # claim: only lanes seeing empty scatter; conflicting claims are
+        # resolved by the read-back
+        cidx = jnp.where(empty, idx, jnp.uint32(cap))  # OOB drops the write
+        tags = tags.at[cidx].set(tag, mode="drop")
+        rows = rows.at[cidx].set(row, mode="drop")
+        won = empty & (tags[idx] == tag) & (rows[idx] == row).all(axis=1)
+        fresh = fresh | won
+        unresolved = unresolved & ~dup & ~won
+        return tags, rows, unresolved, fresh
+
+    tags, rows, unresolved, fresh = jax.lax.fori_loop(
+        0, MAX_PROBES, body,
+        (table["tags"], table["rows"], mask, jnp.zeros_like(mask)))
+    return ({"tags": tags, "rows": rows}, fresh, unresolved.any())
+
+
+insert_batch = partial(jax.jit, donate_argnums=(0,))(insert_core)
+
+
+def grow(table, factor=4):
+    """Host-side rebuild into a larger table (on probe overflow or high
+    load).  Rare; chunked re-insertion of all occupied slots."""
+    cap = int(table["tags"].shape[0])
+    tags = np.asarray(table["tags"])
+    rows = np.asarray(table["rows"])
+    occ = tags != 0
+    fps = np.concatenate([tags[occ, None], rows[occ]], axis=1)
+    new = empty_table(cap * factor)
+    chunk = 1 << 16
+    for off in range(0, fps.shape[0], chunk):
+        part = fps[off:off + chunk]
+        pad = np.zeros((chunk - part.shape[0], 4), np.uint32)
+        batch = jnp.asarray(np.concatenate([part, pad]))
+        m = jnp.asarray(np.arange(chunk) < part.shape[0])
+        new, _, ovf = insert_batch(new, batch, m)
+        if bool(ovf):
+            return grow(table, factor * 2)
+    return new

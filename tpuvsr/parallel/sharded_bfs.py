@@ -1,0 +1,145 @@
+"""Multi-chip BFS expansion: frontier + fingerprint set sharded over a
+device mesh (SURVEY.md §5 "distributed communication backend";
+BASELINE.json configs[4]).
+
+Design (the TPU answer to TLC's shared-memory worker pool):
+
+* the frontier is data-parallel over a 1-D mesh axis ``d`` — each device
+  expands its own tile of states with the vmapped transition kernel;
+* the fingerprint space is ownership-partitioned: fingerprint ``fp``
+  belongs to device ``route(fp) % n_devices``;
+* after local expansion + fingerprinting, successors' fingerprints are
+  bucketed by owner and exchanged with a single ``all_to_all`` over ICI;
+* each device dedups and inserts the fingerprints it owns into its local
+  HBM FPSet shard (engine/fpset.py), so the global visited set is the
+  disjoint union of shards and no two devices ever race on a slot.
+
+The exchange uses fixed-capacity buckets (XLA needs static shapes); a
+bucket overflow is reported so the host can re-run the tile in halves.
+Fresh successor *states* stay on the producing device in this step; the
+ownership exchange moves only 16-byte fingerprints + lane indices, which
+is what makes the collective cheap relative to HBM traffic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.fpset import dedup_batch, insert_core
+
+U32 = jnp.uint32
+
+
+def route(fps):
+    """Owner of each fingerprint ([.., 4] uint32 -> [..] uint32).  Uses a
+    mixed word decorrelated from both the FPSet claim tag (word 0) and
+    the slot hash so shard choice doesn't bias probe chains."""
+    return (fps[..., 1] * jnp.uint32(0x9E3779B9)) ^ (fps[..., 3] >> 7)
+
+
+def make_sharded_expand(kern, inv_fn, mesh: Mesh, axis: str = "d",
+                        bucket_cap: int = None):
+    """Build the jitted one-level expand step over `mesh`.
+
+    Returns step(tables, frontier, valid) ->
+        (tables, fresh_local, owned_fps, n_fresh, viol_any, err_any, ovf)
+    where every output is sharded over `axis`:
+      - fresh_local [n_dev tiles..]: per-device mask over the *local*
+        lane space of successors that are globally fresh AND owned
+        locally is not returned (states stay put) — instead
+        `fresh_keep` marks local lanes accepted by their owners.
+    """
+    n_dev = mesh.shape[axis]
+    L = kern.n_lanes
+
+    def step_shard(tables, tile, valid):
+        # tables arrive with the sharded leading axis of size 1:
+        # {"tags": [1, cap], "rows": [1, cap, 3]}
+        # tile:   state pytree [B_local, ...];  valid: [B_local]
+        tables = {k: v[0] for k, v in tables.items()}
+        B = valid.shape[0]
+        succs, en = jax.vmap(kern.step_all)(tile)
+        en = en & valid[:, None]
+        flat = {k: v.reshape((B * L,) + v.shape[2:]) for k, v in succs.items()}
+        en = en.reshape(-1)
+        fps = jax.vmap(kern.fingerprint)(flat)
+        inv_ok = jax.vmap(inv_fn)(flat)
+        viol_any = (en & ~inv_ok).any()
+        err_any = (en & (flat["err"] != 0)).any()
+
+        # local pre-dedup shrinks the exchange
+        perm, cand = dedup_batch(fps, en)
+        fps_s = fps[perm]
+        owner = (route(fps_s) % jnp.uint32(n_dev)).astype(jnp.int32)
+
+        cap = bucket_cap or max(64, (B * L) // max(1, n_dev // 2))
+        bucket = jnp.zeros((n_dev, cap, 4), U32)
+        sent_mask = jnp.zeros((n_dev, cap), bool)
+        bsrc = jnp.zeros((n_dev, cap), jnp.int32)      # index into fps_s
+        ovf = jnp.asarray(False)
+        for d in range(n_dev):
+            m = cand & (owner == d)
+            pos = jnp.cumsum(m) - 1
+            ovf = ovf | (pos[-1] + 1 > cap) & m.any()
+            idx = jnp.where(m & (pos < cap), pos, cap)  # cap row = dropped
+            bucket = bucket.at[d, idx].set(fps_s, mode="drop")
+            sent_mask = sent_mask.at[d, idx].set(m, mode="drop")
+            bsrc = bsrc.at[d, idx].set(jnp.arange(B * L, dtype=jnp.int32),
+                                       mode="drop")
+        # exchange: row j of the result comes from device j
+        inc_bucket = jax.lax.all_to_all(bucket, axis, 0, 0, tiled=False)
+        inc_maskd = jax.lax.all_to_all(sent_mask, axis, 0, 0, tiled=False)
+
+        # dedup + insert what I own (across the n_dev incoming chunks)
+        inc_fps = inc_bucket.reshape(n_dev * cap, 4)
+        inc_mask = inc_maskd.reshape(n_dev * cap)
+        perm2, cand2 = dedup_batch(inc_fps, inc_mask)
+        tables, fresh2, probe_ovf = insert_core(
+            tables, inc_fps[perm2], cand2)
+        # verdicts back to producers: un-permute, un-exchange
+        verdict = jnp.zeros((n_dev * cap,), bool).at[perm2].set(fresh2)
+        verdict = jax.lax.all_to_all(
+            verdict.reshape(n_dev, cap), axis, 0, 0, tiled=False)
+        # map bucket rows back to local sorted-lane indices; row i of the
+        # returned verdict is device i's decision about the chunk *I*
+        # sent it, so it pairs with my sent_mask/bsrc rows
+        fresh_keep_s = jnp.zeros((B * L,), bool)
+        for d in range(n_dev):
+            fresh_keep_s = fresh_keep_s.at[bsrc[d]].max(
+                verdict[d] & sent_mask[d])
+        # un-sort to the original lane order
+        fresh_keep = jnp.zeros((B * L,), bool).at[perm].set(fresh_keep_s)
+        n_fresh = fresh_keep.sum()[None]    # [1] per device -> [n_dev]
+        # global any-reduction for the diagnostics so every device (and
+        # the replicated outputs) agree
+        def par_any(x):
+            return jax.lax.psum(x.astype(jnp.int32), axis) > 0
+        tables = {k: v[None] for k, v in tables.items()}
+        return (tables, flat, fps, fresh_keep, n_fresh, par_any(viol_any),
+                par_any(err_any), par_any(ovf | probe_ovf))
+
+    spec_d = P(axis)
+    spec_tab = P(axis)     # each device holds its own shard row
+    step = jax.jit(jax.shard_map(
+        step_shard, mesh=mesh,
+        in_specs=(spec_tab, spec_d, spec_d),
+        out_specs=(spec_tab, spec_d, spec_d, spec_d, spec_d, P(), P(), P()),
+        check_vma=False),
+        donate_argnums=(0,))
+    return step
+
+
+def make_sharded_tables(mesh, axis, capacity_per_device):
+    """Global FPSet: one independent shard per device, stacked on the
+    leading (sharded) axis."""
+    n = mesh.shape[axis]
+    tabs = {"tags": jnp.zeros((n, capacity_per_device), U32),
+            "rows": jnp.zeros((n, capacity_per_device, 3), U32)}
+    sh = NamedSharding(mesh, P(axis))
+    return jax.device_put(tabs, sh)
