@@ -55,28 +55,20 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     print(f"bench: backend = {backend}", file=sys.stderr)
 
-    from tpuvsr.core.values import ModelValue
+    from __graft_entry__ import _small_spec
     from tpuvsr.engine.bfs import bfs_check
     from tpuvsr.engine.device_bfs import DeviceBFS
-    from tpuvsr.engine.spec import SpecModel
-    from tpuvsr.frontend.cfg import parse_cfg_file
-    from tpuvsr.frontend.parser import parse_module_file
-
-    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
-    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
-    cfg.constants["Values"] = frozenset({ModelValue("v1")})
-    cfg.constants["StartViewOnTimerLimit"] = 1
-    cfg.constants["RestartEmptyLimit"] = 0
-    cfg.symmetry = None
 
     # baseline: single-thread interpreter (exact TLC-style enumeration)
-    spec = SpecModel(mod, cfg)
+    spec = _small_spec()
     base = bfs_check(spec, max_states=INTERP_STATES)
     base_sps = base.states_generated / base.elapsed
     print(f"bench: interpreter baseline {base_sps:.0f} generated/s",
           file=sys.stderr)
 
-    # device engine: warm up compile on a depth-limited run, then measure
+    # device engine: warm up the jits on a depth-limited run, then
+    # measure on the SAME instance (run() resets its store/FPSet, and
+    # jax.jit caches by closure identity, so the compile is reused)
     tile = int(os.environ.get("BENCH_TILE", "64"))
     eng = DeviceBFS(spec, tile_size=tile)
     t0 = time.time()
@@ -84,9 +76,8 @@ def main():
     print(f"bench: compile+warmup {time.time() - t0:.1f}s",
           file=sys.stderr)
 
-    eng2 = DeviceBFS(spec, tile_size=tile)
-    res = eng2.run(max_seconds=BUDGET_S,
-                   log=lambda m: print(f"bench: {m}", file=sys.stderr))
+    res = eng.run(max_seconds=BUDGET_S,
+                  log=lambda m: print(f"bench: {m}", file=sys.stderr))
     dev_sps = res.states_generated / res.elapsed
     distinct_sps = res.distinct_states / res.elapsed
     print(f"bench: device {res.distinct_states} distinct "

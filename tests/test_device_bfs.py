@@ -141,6 +141,30 @@ def test_device_bfs_fixpoint_no_viewchange():
 
 
 @requires_reference
+def test_device_bfs_message_table_grows_in_place():
+    # deliberately undersized message table: the engine must grow it
+    # mid-run (padding preserves fingerprints) and still reach the same
+    # fixpoint; the restart-era config puts fresh lanes at the top of
+    # the (re-laid-out) lane space, catching stale lane bookkeeping
+    spec = _vsr_spec(values=("v1",), timer=0, restarts=1)
+    sizes, total, _ = _interp_levels(spec)
+    eng = DeviceBFS(spec, tile_size=8, max_msgs=2)
+    res = eng.run()
+    assert res.ok and res.distinct_states == total
+    assert eng.level_sizes == sizes
+    assert eng.codec.shape.MAX_MSGS > 2
+
+
+@requires_reference
+def test_device_bfs_incremental_hash_mode():
+    spec = _vsr_spec(values=("v1",), timer=0)
+    _sizes, total, _ = _interp_levels(spec)
+    eng = DeviceBFS(spec, tile_size=8, hash_mode="incremental")
+    res = eng.run()
+    assert res.ok and res.distinct_states == total
+
+
+@requires_reference
 def test_device_bfs_with_tiny_fpset_grows():
     # force FPSet growth mid-run; counts must be unaffected
     spec = _vsr_spec(values=("v1",), timer=0)

@@ -96,6 +96,55 @@ def test_kernel_matches_interpreter_recovery_era():
     _assert_same(spec, codec, kern, rec[::6] + states[:40:4])
 
 
+@pytest.mark.parametrize("values,timer,symmetry", [
+    (("v1",), 1, False),
+    (("v1", "v2"), 2, True),
+])
+def test_incremental_fingerprint_matches_full(values, timer, symmetry):
+    # the O(touched) incremental fingerprint must equal the full-state
+    # recompute on every enabled lane of sampled reachable states
+    import jax
+    import jax.numpy as jnp
+    from tpuvsr.core.values import ModelValue
+    from tpuvsr.engine.device_bfs import _value_perm_table
+
+    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
+    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
+    cfg.constants["StartViewOnTimerLimit"] = timer
+    if not symmetry:
+        cfg.symmetry = None
+    spec = SpecModel(mod, cfg)
+    codec = VSRCodec(spec.ev.constants, max_msgs=40)
+    kern = VSRKernel(codec, perms=_value_perm_table(spec, codec))
+
+    def both(st):
+        parts = kern.parent_parts(st)
+        outs = []
+        for name, fn in zip(ACTION_NAMES, kern._action_fns()):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+
+            def lane_eval(lane, fn=fn, name=name):
+                succ, en = fn(kern.seed_touch(st), lane)
+                ri = kern.lane_replica(name, st, lane)
+                inc = kern.fingerprint_incremental(succ, ri, parts, st)
+                full = kern.fingerprint(
+                    {k: v for k, v in succ.items()
+                     if not k.startswith("_")})
+                return inc, full, en
+            outs.append(jax.vmap(lane_eval)(lanes))
+        return tuple(jnp.concatenate([o[i] for o in outs])
+                     for i in range(3))
+
+    both_j = jax.jit(both)
+    states = explore_states(spec, 90)[::6]
+    for st in states:
+        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
+        inc, full, en = both_j(dense)
+        en = np.asarray(en)
+        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
+
+
 def test_kernel_smoke_init():
     spec, codec, kern = _load()
     st = next(iter(spec.init_states()))

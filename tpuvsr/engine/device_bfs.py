@@ -83,41 +83,99 @@ class _StateStore:
 
 class DeviceBFS:
     def __init__(self, spec: SpecModel, max_msgs=None, tile_size=32,
-                 fpset_capacity=1 << 20):
+                 fpset_capacity=1 << 20, hash_mode="full"):
         self.spec = spec
+        self.tile = tile_size
+        self.fpset_capacity = fpset_capacity
+        self.hash_mode = hash_mode
+        self.inv_names = list(spec.cfg.invariants)
+        self._build(max_msgs)
+
+    def _build(self, max_msgs):
+        """(Re)build codec, kernel, and jitted passes for a message-table
+        bound; called again by _grow_msgs on bag overflow."""
+        spec = self.spec
         self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
         self.kern = VSRKernel(self.codec,
                               perms=_value_perm_table(spec, self.codec))
-        self.tile = tile_size
-        self.fpset_capacity = fpset_capacity
         self.L = self.kern.n_lanes
-        names = list(spec.cfg.invariants)
-        inv = self.kern.invariant_fn(names)
-        self.inv_names = names
+        inv = self.kern.invariant_fn(self.inv_names)
         kern = self.kern
+        incremental = self.hash_mode == "incremental"
 
-        def hash_dedup(succs, en):
-            """fingerprint + invariants + intra-batch dedup; independent
-            of the FPSet so a table growth never recompiles it."""
-            fps = jax.vmap(kern.fingerprint)(succs)
-            inv_ok = jax.vmap(inv)(succs)
-            viol = en & ~inv_ok
-            err = jnp.where(en, succs["err"], 0)
+        def expand_hash(tile, valid):
+            """The fused hot pass: expand every lane, fingerprint and
+            invariant-check the successor without keeping it, and emit
+            only the per-lane smalls — the [T, L] successor states are
+            never engine outputs.  Fresh lanes are re-materialized
+            afterwards (a tiny fraction of the lane space)."""
+            def per_state(st):
+                # incremental: one full-state hash per parent,
+                # O(touched rows) per lane
+                parts = kern.parent_parts(st) if incremental else None
+                outs = []
+                for name, fn in zip(ACTION_NAMES, kern._action_fns()):
+                    lanes = jnp.arange(kern._lane_count(name),
+                                       dtype=jnp.int32)
+
+                    def lane_eval(lane, fn=fn, name=name):
+                        succ, en = fn(kern.seed_touch(st), lane)
+                        if incremental:
+                            ri = kern.lane_replica(name, st, lane)
+                            fp = kern.fingerprint_incremental(
+                                succ, ri, parts, st)
+                        else:
+                            fp = kern.fingerprint(
+                                {k: v for k, v in succ.items()
+                                 if not k.startswith("_")})
+                        return fp, inv(succ), succ["err"], en
+                    outs.append(jax.vmap(lane_eval)(lanes))
+                return tuple(jnp.concatenate([o[i] for o in outs])
+                             for i in range(4))
+            fps, inv_ok, err, en = jax.vmap(per_state)(tile)
+            en = en & valid[:, None]
+            fps = fps.reshape(-1, 4)
+            en = en.reshape(-1)
+            viol = en & ~inv_ok.reshape(-1)
+            err = jnp.where(en, err.reshape(-1), 0)
             err_bag = ((err & ERR_BAG_OVERFLOW) != 0).any()
             err_slot = ((err & ~ERR_BAG_OVERFLOW) != 0).any()
             perm, cand = dedup_batch(fps, en)
-            return (fps, perm, cand, viol.any(), jnp.argmax(viol),
+            return (fps, perm, cand, en, viol.any(), jnp.argmax(viol),
                     err_bag, err_slot)
 
-        def pack(succs, fps, perm, fresh):
-            """compact globally-fresh lanes to the front for transfer."""
+        def pack_fresh(fps, perm, fresh):
+            """order globally-fresh lane indices first for transfer."""
             order = jnp.argsort(~fresh, stable=True)
             sel = perm[order]
-            packed = {k: v[sel] for k, v in succs.items()}
-            return packed, fps[sel], sel, fresh.sum()
+            return fps[sel], sel, fresh.sum()
 
-        self._hash = jax.jit(hash_dedup)
-        self._pack = jax.jit(pack)
+        self._expand = jax.jit(expand_hash)
+        self._pack = jax.jit(pack_fresh)
+        self._mat = {}          # action id -> jitted vmapped action fn
+
+    def _grow_msgs(self, store):
+        """Grow MAX_MSGS in place: all-zero padding slots change no
+        fingerprint (only present slots contribute to the bag hash), so
+        the FPSet and every registered state stay valid — pad the stored
+        chunks and rebuild the jitted passes.  Returns the pad function
+        for the caller's frontier/pending chunks."""
+        old = self.codec.shape.MAX_MSGS
+        new = old * 2
+        self._build(new)
+
+        def pad(d):
+            out = dict(d)
+            for k in ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
+                      "m_log_len", "m_has_log"):
+                v = d[k]
+                shape = list(v.shape)
+                shape[1] = new - old
+                out[k] = np.concatenate(
+                    [v, np.zeros(shape, v.dtype)], axis=1)
+            return out
+        store.chunks = [pad(c) for c in store.chunks]
+        return pad
 
     # ------------------------------------------------------------------
     def run(self, max_states=None, max_depth=None, max_seconds=None,
@@ -173,34 +231,22 @@ class DeviceBFS:
             depth += 1
             n_front = len(frontier["status"])
             fresh_chunks, fresh_parents, fresh_actions = [], [], []
-            for off in range(0, n_front, self.tile):
+            off = 0
+            while off < n_front:
                 tile = {k: v[off:off + self.tile]
                         for k, v in frontier.items()}
                 n_valid = len(tile["status"])
                 if n_valid < self.tile:
-                    pad = self.tile - n_valid
+                    npad = self.tile - n_valid
                     tile = {k: np.concatenate(
-                        [v, np.repeat(v[:1], pad, axis=0)])
+                        [v, np.repeat(v[:1], npad, axis=0)])
                         for k, v in tile.items()}
                 valid = np.arange(self.tile) < n_valid
 
-                succs, en = kern.step_batch(tile)
-                en = en & jnp.asarray(valid)[:, None]
-                if check_deadlock:
-                    dead = valid & ~np.asarray(en.any(axis=1))
-                    if dead.any():
-                        gid = level_base + off + int(np.argmax(dead))
-                        res.ok = False
-                        res.error = "deadlock"
-                        res.deadlock_state = self.codec.decode(store.get(gid))
-                        res.trace = self._trace(store, gid)
-                        res.diameter = depth
-                        return self._finish(res, store, t0, depth)
-                flat = {k: v.reshape((self.tile * self.L,) + v.shape[2:])
-                        for k, v in succs.items()}
-                en_flat = en.reshape(-1)
-                (fps, perm, cand, has_viol, viol_idx, err_bag,
-                 err_slot) = self._hash(flat, en_flat)
+                tile_j = {k: jnp.asarray(v) for k, v in tile.items()}
+                (fps, perm, cand, en_flat, has_viol, viol_idx, err_bag,
+                 err_slot) = self._expand(tile_j, jnp.asarray(valid))
+                en_np = np.asarray(en_flat).reshape(self.tile, self.L)
 
                 if bool(err_slot):
                     raise TLAError(
@@ -209,14 +255,35 @@ class DeviceBFS:
                         "this restart-era interleaving needs the "
                         "multi-slot layout (vsr.py docstring)")
                 if bool(err_bag):
-                    raise _KernelOverflow()
-                res.states_generated += int(np.asarray(en_flat).sum())
+                    # message table too small for some successor in this
+                    # tile: grow in place and re-run the SAME tile (no
+                    # inserts happened yet for it)
+                    padf = self._grow_msgs(store)
+                    frontier = padf(frontier)
+                    fresh_chunks = [padf(c) for c in fresh_chunks]
+                    kern = self.kern      # _build replaced kernel+codec:
+                    codec = self.codec    # lane tables/L are all new
+                    emit(f"message table grown to "
+                         f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
+                    continue
+                if check_deadlock:
+                    dead = valid & ~en_np.any(axis=1)
+                    if dead.any():
+                        gid = level_base + off + int(np.argmax(dead))
+                        res.ok = False
+                        res.error = "deadlock"
+                        res.deadlock_state = self.codec.decode(store.get(gid))
+                        res.trace = self._trace(store, gid)
+                        res.diameter = depth
+                        return self._finish(res, store, t0, depth)
+                res.states_generated += int(en_np.sum())
 
                 if bool(has_viol):
                     # a generated state violates an invariant: name it
                     # on host and reconstruct the trace
                     vi = int(viol_idx)
-                    vstate = {k: np.asarray(v[vi]) for k, v in flat.items()}
+                    vstate = {k: v[0] for k, v in self._materialize(
+                        tile, np.asarray([vi])).items()}
                     parent_gid = level_base + off + vi // self.L
                     lane = vi % self.L
                     bad = self._check_invariants_host(
@@ -232,15 +299,13 @@ class DeviceBFS:
                 fps_sorted = fps[perm]
                 while True:
                     table, fresh, ovf = insert_batch(table, fps_sorted, cand)
-                    packed, pfps, sel, n_fresh = self._pack(
-                        flat, fps, perm, fresh)
+                    pfps, sel, n_fresh = self._pack(fps, perm, fresh)
                     n = int(n_fresh)
                     if n:
                         fp_count += n
-                        pack_np = {k: np.asarray(v[:n])
-                                   for k, v in packed.items()}
                         sel_np = np.asarray(sel[:n])
-                        fresh_chunks.append(pack_np)
+                        fresh_chunks.append(
+                            self._materialize(tile, sel_np))
                         fresh_parents.append(
                             level_base + off + sel_np // self.L)
                         fresh_actions.append(
@@ -256,6 +321,7 @@ class DeviceBFS:
                             continue
                     break
 
+                off += self.tile
                 now = time.time()
                 if now - last_progress >= progress_every:
                     last_progress = now
@@ -284,6 +350,45 @@ class DeviceBFS:
         return self._finish(res, store, t0, depth)
 
     # ------------------------------------------------------------------
+    def _materialize(self, tile, flat_idx):
+        """Re-run only the surviving lanes to produce their successor
+        states: group by action, pad each group to a power of two (few
+        compiled variants), and vmap the single action function."""
+        kern = self.kern
+        flat_idx = np.asarray(flat_idx)
+        parent_local = flat_idx // self.L
+        lane = flat_idx % self.L
+        aids = kern.lane_action[lane]
+        params = kern.lane_param[lane]
+        n = len(flat_idx)
+        out = {}
+        order = np.argsort(aids, kind="stable")
+        pos = 0
+        chunks, backperm = [], np.empty(n, np.int64)
+        for aid in np.unique(aids):
+            sel = order[aids[order] == aid]
+            cap = max(8, 1 << int(np.ceil(np.log2(len(sel)))))
+            pad = cap - len(sel)
+            gi = np.concatenate([parent_local[sel],
+                                 np.zeros(pad, np.int64)])
+            gp = np.concatenate([params[sel], np.zeros(pad, np.int32)])
+            states = {k: v[gi] for k, v in tile.items()}
+            fn = self._mat.get(int(aid))
+            if fn is None:
+                fn = jax.jit(jax.vmap(kern._action_fns()[int(aid)],
+                                      in_axes=(0, 0)))
+                self._mat[int(aid)] = fn
+            succ, _en = fn(states, jnp.asarray(gp))
+            chunk = {k: np.asarray(v[:len(sel)]) for k, v in succ.items()
+                     if not k.startswith("_")}
+            chunks.append(chunk)
+            backperm[sel] = np.arange(pos, pos + len(sel))
+            pos += len(sel)
+        cat = {k: np.concatenate([c[k] for c in chunks])
+               for k in chunks[0]}
+        # row i of the result is the successor for flat_idx[i]
+        return {k: v[backperm] for k, v in cat.items()}
+
     def _finish(self, res, store, t0, depth):
         res.distinct_states = len(store)
         res.elapsed = time.time() - t0
@@ -318,26 +423,10 @@ class DeviceBFS:
         return out
 
 
-class _KernelOverflow(Exception):
-    pass
-
-
 def device_bfs_check(spec: SpecModel, max_states=None, max_depth=None,
                      check_deadlock=False, tile_size=32, max_msgs=None,
                      log=None) -> CheckResult:
-    """Run the device BFS, growing the message-slot table on overflow
-    (the dense layout's only dynamic bound, vsr.py)."""
-    attempts = 0
-    while True:
-        eng = DeviceBFS(spec, max_msgs=max_msgs, tile_size=tile_size)
-        try:
-            return eng.run(max_states=max_states, max_depth=max_depth,
-                           check_deadlock=check_deadlock, log=log)
-        except _KernelOverflow:
-            attempts += 1
-            if attempts > 3:
-                raise TLAError("message table overflow after 3 growths")
-            max_msgs = eng.codec.shape.MAX_MSGS * 2
-            if log:
-                log(f"message table overflow; retrying with "
-                    f"MAX_MSGS={max_msgs}")
+    """Run the device BFS (message-table growth happens in place)."""
+    eng = DeviceBFS(spec, max_msgs=max_msgs, tile_size=tile_size)
+    return eng.run(max_states=max_states, max_depth=max_depth,
+                   check_deadlock=check_deadlock, log=log)

@@ -67,6 +67,8 @@ class SpecModel:
         flatten(d.body)
 
         def contains_temporal(e):
+            if isinstance(e, list):
+                return any(contains_temporal(x) for x in e)
             if not isinstance(e, tuple):
                 return False
             if e and isinstance(e[0], str) and e[0] in (

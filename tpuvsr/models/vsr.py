@@ -124,10 +124,11 @@ def shape_from_cfg(constants, max_msgs=None):
             f"config exceeds packed sort-key field widths (V={V} < 16, "
             f"max view {1 + T + restarts} < 256 required)")
     if max_msgs is None:
-        # Broadcasts insert <= R-1 distinct rows; the distinct-message
-        # universe is bounded but loose — start generous, the kernel
-        # flags overflow and the engine re-runs with a larger table.
-        max_msgs = 24 * (1 + T + restarts) + 8 * R * V
+        # The distinct-message universe is bounded but loose; start
+        # small — lane count and state size scale with MAX_MSGS, and the
+        # device engine grows the table in place on overflow.  (Measured:
+        # the shrunken flagship config peaks at 16 domain entries.)
+        max_msgs = 8 * (1 + T + restarts)
     return VSRShape(R=R, C=C, V=V, MAX_OPS=V, MAX_MSGS=max_msgs,
                     MAX_VIEW=1 + T, timer_limit=T, restart_limit=restarts)
 

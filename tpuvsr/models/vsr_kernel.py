@@ -122,9 +122,16 @@ class VSRKernel:
         self.lane_param = np.concatenate(params)
         self.n_lanes = int(self.lane_action.size)
 
-        # deterministic hash coefficients (4 x 32-bit lanes = 128-bit fp)
+        # deterministic hash coefficients (4 x 32-bit lanes = 128-bit fp).
+        # The fingerprint is decomposable (SURVEY.md §7.3.8 incremental
+        # hashing): fp = mix(mix(sum_r rep_row_hash(r)
+        #                        + sum_m present_m * slot_hash(m)) + seed),
+        # so a transition that touches one replica row and a few message
+        # slots updates the sums in O(touched) — the expand pass exploits
+        # this; the full recompute path must produce identical values.
         rng = np.random.default_rng(0xC0FFEE)
-        nrep = sum(int(np.prod(self._rep_shape(k))) for k in REP_KEYS)
+        nrep = 1 + sum(int(np.prod(self._rep_shape(k))) // s.R
+                       for k in REP_KEYS)      # replica id + per-r slices
         nmsg = NHDR + NENT + self.MAX_OPS * NENT + 3
         self._k_rep = jnp.asarray(
             rng.integers(1, 2**32, size=(4, nrep), dtype=np.uint64)
@@ -187,6 +194,17 @@ class VSRKernel:
                 & (st["m_log_len"] == row["log_len"])
                 & (st["m_has_log"] == row["has_log"]))
 
+    def _touch(self, st, idx, pred):
+        """Record a touched message slot for incremental fingerprinting
+        (no-op unless the caller seeded the "_ts" scratch keys)."""
+        if "_ts" not in st:
+            return st
+        st = dict(st)
+        n = jnp.clip(st["_tn"], 0, st["_ts"].shape[0] - 1)
+        st["_ts"] = jnp.where(pred, st["_ts"].at[n].set(idx), st["_ts"])
+        st["_tn"] = st["_tn"] + jnp.where(pred, 1, 0)
+        return st
+
     def _bag_send(self, st, row, pred=None):
         """SendFunc upsert (VSR.tla:228-231): +1 if present (tombstones
         revive), else insert at the first free slot with count 1."""
@@ -197,6 +215,7 @@ class VSRKernel:
         free = st["m_present"] == 0
         idx = jnp.where(found, jnp.argmax(eq), jnp.argmax(free))
         overflow = pred & ~found & ~free.any()
+        st = self._touch(st, idx, pred)
         st = dict(st)
         st["m_count"] = st["m_count"].at[idx].add(jnp.where(pred, 1, 0))
         wr = pred & ~found
@@ -220,6 +239,7 @@ class VSRKernel:
         return self._bag_send(st, row), ok
 
     def _bag_discard(self, st, k):
+        st = self._touch(st, k, jnp.asarray(True))
         st = dict(st)
         st["m_count"] = st["m_count"].at[k].add(-1)
         return st
@@ -764,6 +784,27 @@ class VSRKernel:
             self.act_complete_recovery,
         ]
 
+    def lane_replica(self, name, st, lane):
+        """The one replica a lane's action mutates (every VSR action
+        updates through EXCEPT ![r] on a single replica)."""
+        if name in ("TimerSendSVC", "SendDVC", "SendSV", "ExecuteOp",
+                    "RestartEmpty", "CompleteRecovery"):
+            return lane
+        if name == "ReceiveClientRequest":
+            return lane // self.V
+        if name == "SendGetState":
+            k = lane // self.R
+        else:
+            k = lane
+        return jnp.clip(st["m_hdr"][k, H_DEST] - 1, 0, self.R - 1)
+
+    def seed_touch(self, st):
+        """Add the incremental-fingerprint scratch keys."""
+        st = dict(st)
+        st["_ts"] = jnp.full((self.R + 1,), -1, I32)
+        st["_tn"] = jnp.asarray(0, I32)
+        return st
+
     def step_all(self, st):
         """One state -> all lane successors.
 
@@ -779,7 +820,7 @@ class VSRKernel:
             parts.append(succ)
             ens.append(en)
         succs = {k: jnp.concatenate([p[k] for p in parts], axis=0)
-                 for k in st}
+                 for k in st if not k.startswith("_")}
         return succs, jnp.concatenate(ens)
 
     # ==================================================================
@@ -807,32 +848,50 @@ class VSRKernel:
             perm[st["m_entry"][..., E_OPER]])
         return st
 
-    def _fp_one(self, st, perm):
-        st = self._permuted(st, perm)
-        rep = jnp.concatenate(
-            [jnp.asarray(st[k], jnp.uint32).reshape(-1) for k in REP_KEYS])
-        h_rep = (rep[None, :] * self._k_rep).sum(axis=1)
-        # messages: content-hash each slot, order-invariant masked sum
-        mrow = jnp.concatenate(
+    def _rep_rows(self, st):
+        """[R, n_rep] uint32 content rows, one per replica: the replica
+        id followed by every per-replica state slice."""
+        R = self.R
+        cols = [jnp.arange(R, dtype=jnp.uint32)[:, None]]
+        for k in REP_KEYS:
+            v = jnp.asarray(st[k], jnp.uint32)
+            cols.append(v.reshape(R, -1))
+        return jnp.concatenate(cols, axis=1)
+
+    def _rep_hashes(self, st):
+        """[R, 4] per-replica row hashes (position-keyed by replica id)."""
+        rows = self._rep_rows(st)
+        return self._mix32((rows[:, None, :] * self._k_rep[None]).sum(axis=2)
+                           + self._seeds[None, :])
+
+    def _slot_rows(self, st):
+        """[M, n_msg] uint32 content rows, one per message slot (slot
+        index NOT injected: the bag hash is slot-order-invariant)."""
+        return jnp.concatenate(
             [jnp.asarray(st["m_hdr"], jnp.uint32),
              jnp.asarray(st["m_entry"], jnp.uint32),
              jnp.asarray(st["m_log"], jnp.uint32).reshape(self.M, -1),
              jnp.asarray(st["m_log_len"], jnp.uint32)[:, None],
              jnp.asarray(st["m_has_log"], jnp.uint32)[:, None],
              jnp.asarray(st["m_count"], jnp.uint32)[:, None]], axis=1)
-        h_slot = self._mix32(
-            (mrow[:, None, :] * self._k_msg[None, :, :]).sum(axis=2)
-            + self._seeds[None, :])                      # [M, 4]
+
+    def _slot_hashes(self, st):
+        rows = self._slot_rows(st)
+        return self._mix32((rows[:, None, :] * self._k_msg[None]).sum(axis=2)
+                           + self._seeds[None, :])       # [M, 4]
+
+    def _fp_one(self, st, perm):
+        st = self._permuted(st, perm)
+        h_rep = self._rep_hashes(st).sum(axis=0)
         pres = jnp.asarray(st["m_present"], jnp.uint32)[:, None]
-        h_msg = (h_slot * pres).sum(axis=0)
+        h_msg = (self._slot_hashes(st) * pres).sum(axis=0)
         return self._mix32(self._mix32(h_rep + h_msg) + self._seeds)
 
-    def fingerprint(self, st):
-        """[4] uint32 canonical fingerprint: least over symmetry perms."""
-        st = {k: jnp.asarray(v) for k, v in st.items()}
-        fps = jax.vmap(lambda p: self._fp_one(st, p))(jnp.asarray(self.perms))
+    @staticmethod
+    def _lex_min4(fps):
+        """[P, 4] -> [4]: lexicographic least row."""
         best = fps[0]
-        for p in range(1, self.perms.shape[0]):
+        for p in range(1, fps.shape[0]):
             a, b = fps[p], best
             less = ((a[0] < b[0])
                     | ((a[0] == b[0]) & (a[1] < b[1]))
@@ -841,6 +900,94 @@ class VSRKernel:
                        & (a[3] < b[3])))
             best = jnp.where(less, a, best)
         return best
+
+    def fingerprint(self, st):
+        """[4] uint32 canonical fingerprint: least over symmetry perms."""
+        st = {k: jnp.asarray(v) for k, v in st.items()}
+        fps = jax.vmap(lambda p: self._fp_one(st, p))(jnp.asarray(self.perms))
+        return self._lex_min4(fps)
+
+    # -- incremental fingerprinting ------------------------------------
+    # Every action mutates exactly ONE replica row (VSR.tla actions all
+    # update through EXCEPT ![r]) plus at most R+1 message slots (a
+    # discard + an R-1-destination broadcast).  The kernel records the
+    # touched replica in succ["_ri"] and touched slots in succ["_ts"]
+    # (engine strips them), and the expand pass reconstitutes the
+    # successor fingerprint from the parent's per-row hash sums.
+
+    def parent_parts(self, st):
+        """Per-permutation hash parts of a parent state:
+        rep [P, R, 4], slot [P, M, 4], total [P, 4] (pre-mix sums)."""
+        def parts_one(perm):
+            stp = self._permuted(st, perm)
+            rep = self._rep_hashes(stp)
+            slot = self._slot_hashes(stp)
+            pres = jnp.asarray(stp["m_present"], jnp.uint32)[:, None]
+            total = rep.sum(axis=0) + (slot * pres).sum(axis=0)
+            return rep, slot, total
+        return jax.vmap(parts_one)(jnp.asarray(self.perms))
+
+    def _perm_entry_cols(self, rows, perm):
+        """Apply a value permutation to the oper column of [..., NENT]
+        log-entry rows."""
+        return rows.at[..., E_OPER].set(perm[rows[..., E_OPER]])
+
+    def _rep_row_one(self, st, i, perm):
+        """[n_rep] content row of replica i with `perm` applied."""
+        cols = [jnp.asarray(i, jnp.uint32)[None]]
+        for k in REP_KEYS:
+            v = st[k][i]
+            if k in ("log", "dvc_log", "rec_log"):
+                v = self._perm_entry_cols(v, perm)
+            cols.append(jnp.asarray(v, jnp.uint32).reshape(-1))
+        return jnp.concatenate(cols)
+
+    def _slot_row_one(self, st, m, perm):
+        """[n_msg] content row of message slot m with `perm` applied."""
+        return jnp.concatenate([
+            jnp.asarray(st["m_hdr"][m], jnp.uint32),
+            jnp.asarray(self._perm_entry_cols(st["m_entry"][m], perm),
+                        jnp.uint32),
+            jnp.asarray(self._perm_entry_cols(st["m_log"][m], perm),
+                        jnp.uint32).reshape(-1),
+            jnp.asarray(st["m_log_len"][m], jnp.uint32)[None],
+            jnp.asarray(st["m_has_log"][m], jnp.uint32)[None],
+            jnp.asarray(st["m_count"][m], jnp.uint32)[None]])
+
+    def fingerprint_incremental(self, succ, ri, parts, parent):
+        """Successor fingerprint in O(touched rows) from parent parts.
+
+        `ri` is the one replica the lane's action mutated
+        (lane_replica); succ carries "_ts" ([R+1] touched slot indices,
+        -1 padded, recorded by the bag primitives).  Produces values
+        identical to `fingerprint(succ)`."""
+        rep_h, slot_h, total = parts
+        i = ri
+        ts = succ["_ts"]
+        perms = jnp.asarray(self.perms)
+        p_pres = jnp.asarray(parent["m_present"], jnp.uint32)
+        s_pres = jnp.asarray(succ["m_present"], jnp.uint32)
+
+        def fp_p(p):
+            perm = perms[p]
+            d = total[p] - rep_h[p, i]
+            row = self._rep_row_one(succ, i, perm)
+            d = d + self._mix32((row[None, :] * self._k_rep).sum(axis=1)
+                                + self._seeds)
+            for t in range(ts.shape[0]):
+                s = ts[t]
+                ok = s >= 0
+                sc = jnp.clip(s, 0, self.M - 1)
+                d = d - jnp.where(ok, slot_h[p, sc] * p_pres[sc], 0)
+                new_row = self._slot_row_one(succ, sc, perm)
+                new_h = self._mix32(
+                    (new_row[None, :] * self._k_msg).sum(axis=1)
+                    + self._seeds)
+                d = d + jnp.where(ok, new_h * s_pres[sc], 0)
+            return self._mix32(self._mix32(d) + self._seeds)
+
+        fps = jax.vmap(fp_p)(jnp.arange(self.perms.shape[0]))
+        return self._lex_min4(fps)
 
     # ==================================================================
     # invariants (VSR.tla:926-952), vectorized
