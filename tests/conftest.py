@@ -60,6 +60,26 @@ def explore_states(spec, limit):
     return list(seen.values())
 
 
+def vsr_spec(values=("v1",), timer=1, restarts=0, symmetry=False,
+             invariants=None):
+    """The root VSR spec under its shipped cfg with test-size constant
+    overrides — the one canonical copy of this boilerplate."""
+    from tpuvsr.core.values import ModelValue
+    from tpuvsr.engine.spec import SpecModel
+    from tpuvsr.frontend.cfg import parse_cfg_file
+    from tpuvsr.frontend.parser import parse_module_file
+    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
+    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
+    cfg.constants["StartViewOnTimerLimit"] = timer
+    cfg.constants["RestartEmptyLimit"] = restarts
+    if not symmetry:
+        cfg.symmetry = None
+    if invariants is not None:
+        cfg.invariants = invariants
+    return SpecModel(mod, cfg)
+
+
 def reference_available():
     return os.path.isdir(REFERENCE)
 

@@ -1,0 +1,73 @@
+"""CLI tests: the reference specs + cfgs run unchanged through the
+TLC-compatible entry point."""
+
+import json
+import subprocess
+import sys
+
+from tests.conftest import REFERENCE, requires_reference
+
+pytestmark = requires_reference
+
+
+def _run(*argv, timeout=420):
+    return subprocess.run(
+        [sys.executable, "-m", "tpuvsr", *argv],
+        capture_output=True, text=True, timeout=timeout,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": "/root/repo",
+             "HOME": "/root"})
+
+
+def test_cli_bfs_interp_maxstates():
+    r = _run(f"{REFERENCE}/VSR.tla", "-engine", "interp",
+             "-maxstates", "500", "-json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == "bfs" and out["distinct_states"] >= 500
+
+
+def test_cli_simulate_interp():
+    r = _run(f"{REFERENCE}/VSR.tla", "-engine", "interp", "-simulate",
+             "-num", "5", "-depth", "10", "-json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == "simulate" and out["walks"] == 5
+
+
+def test_cli_checks_temporal_properties(tmp_path):
+    # a cfg with PROPERTY must run the liveness checker after safety;
+    # fairness-free spec -> stuttering violation, nonzero exit
+    spec = """---- MODULE Tk ----
+EXTENDS Naturals
+VARIABLES x
+Init == x = 0
+Incr == x' = (x + 1) % 3
+Next == Incr
+vars == <<x>>
+AtZero == x = 0
+Prop == []<>AtZero
+Spec == Init /\\ [][Next]_vars
+FairSpec == Init /\\ [][Next]_vars /\\ WF_vars(Incr)
+====
+"""
+    (tmp_path / "Tk.tla").write_text(spec)
+    (tmp_path / "Tk.cfg").write_text("SPECIFICATION Spec\nPROPERTY Prop\n")
+    r = _run(str(tmp_path / "Tk.tla"), "-json")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert r.returncode != 0
+    assert out["properties_ok"] is False and out["violated"] == "Prop"
+
+    (tmp_path / "Tk.cfg").write_text(
+        "SPECIFICATION FairSpec\nPROPERTY Prop\n")
+    r2 = _run(str(tmp_path / "Tk.tla"), "-json")
+    out2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert r2.returncode == 0 and out2["properties_ok"] is True
+
+
+def test_cli_analysis_spec_with_shipped_cfg():
+    r = _run(f"{REFERENCE}/analysis/03-state-transfer/VR_STATE_TRANSFER.tla",
+             "-maxstates", "300", "-json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["distinct_states"] >= 300

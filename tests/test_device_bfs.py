@@ -10,7 +10,8 @@ distinct-state counts (SURVEY.md §4.7).
 import numpy as np
 import pytest
 
-from tests.conftest import REFERENCE, explore_states, requires_reference
+from tests.conftest import (REFERENCE, explore_states, requires_reference,
+                            vsr_spec)
 from tpuvsr.core.values import ModelValue
 from tpuvsr.engine.device_bfs import DeviceBFS, device_bfs_check
 from tpuvsr.engine.fpset import dedup_batch, empty_table, insert_batch
@@ -90,15 +91,6 @@ def test_fpset_dedup_batch():
 # ---------------------------------------------------------------------
 # engine differential tests
 # ---------------------------------------------------------------------
-def _vsr_spec(values=("v1",), timer=1, restarts=0, symmetry=False):
-    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
-    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
-    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
-    cfg.constants["StartViewOnTimerLimit"] = timer
-    cfg.constants["RestartEmptyLimit"] = restarts
-    if not symmetry:
-        cfg.symmetry = None
-    return SpecModel(mod, cfg)
 
 
 def _interp_levels(spec, max_depth=None):
@@ -130,7 +122,7 @@ def _interp_levels(spec, max_depth=None):
 @requires_reference
 def test_device_bfs_fixpoint_no_viewchange():
     # timer=0: only the normal-op sub-protocol is reachable
-    spec = _vsr_spec(values=("v1",), timer=0)
+    spec = vsr_spec(values=("v1",), timer=0)
     sizes, total, diameter = _interp_levels(spec)
     eng = DeviceBFS(spec, tile_size=8)
     res = eng.run()
@@ -146,7 +138,7 @@ def test_device_bfs_message_table_grows_in_place():
     # mid-run (padding preserves fingerprints) and still reach the same
     # fixpoint; the restart-era config puts fresh lanes at the top of
     # the (re-laid-out) lane space, catching stale lane bookkeeping
-    spec = _vsr_spec(values=("v1",), timer=0, restarts=1)
+    spec = vsr_spec(values=("v1",), timer=0, restarts=1)
     sizes, total, _ = _interp_levels(spec)
     eng = DeviceBFS(spec, tile_size=8, max_msgs=2)
     res = eng.run()
@@ -157,7 +149,7 @@ def test_device_bfs_message_table_grows_in_place():
 
 @requires_reference
 def test_device_bfs_incremental_hash_mode():
-    spec = _vsr_spec(values=("v1",), timer=0)
+    spec = vsr_spec(values=("v1",), timer=0)
     _sizes, total, _ = _interp_levels(spec)
     eng = DeviceBFS(spec, tile_size=8, hash_mode="incremental")
     res = eng.run()
@@ -167,7 +159,7 @@ def test_device_bfs_incremental_hash_mode():
 @requires_reference
 def test_device_bfs_with_tiny_fpset_grows():
     # force FPSet growth mid-run; counts must be unaffected
-    spec = _vsr_spec(values=("v1",), timer=0)
+    spec = vsr_spec(values=("v1",), timer=0)
     sizes, total, _ = _interp_levels(spec)
     eng = DeviceBFS(spec, tile_size=8, fpset_capacity=16)
     res = eng.run()
@@ -178,7 +170,7 @@ def test_device_bfs_with_tiny_fpset_grows():
 @requires_reference
 @pytest.mark.slow
 def test_device_bfs_levels_with_viewchange():
-    spec = _vsr_spec(values=("v1",), timer=1)
+    spec = vsr_spec(values=("v1",), timer=1)
     sizes, total, _ = _interp_levels(spec, max_depth=5)
     eng = DeviceBFS(spec, tile_size=32)
     res = eng.run(max_depth=5)
@@ -192,7 +184,7 @@ def test_device_bfs_levels_with_viewchange():
 def test_device_bfs_recovery_fixpoint():
     # exercises RestartEmpty/Recovery*/CompleteRecovery and tombstone
     # revival on device to fixpoint
-    spec = _vsr_spec(values=("v1",), timer=0, restarts=1)
+    spec = vsr_spec(values=("v1",), timer=0, restarts=1)
     sizes, total, _ = _interp_levels(spec)
     eng = DeviceBFS(spec, tile_size=32)
     res = eng.run()
@@ -207,7 +199,7 @@ def test_device_bfs_symmetry_levels():
     # |Values|=2 with Permutations symmetry: device min-over-perm
     # fingerprints must induce the same partition as the interpreter's
     # canonical min-permutation view values
-    spec = _vsr_spec(values=("v1", "v2"), timer=1, symmetry=True)
+    spec = vsr_spec(values=("v1", "v2"), timer=1, symmetry=True)
     sizes, total, _ = _interp_levels(spec, max_depth=4)
     eng = DeviceBFS(spec, tile_size=32)
     res = eng.run(max_depth=4)
@@ -218,7 +210,7 @@ def test_device_bfs_symmetry_levels():
 
 @requires_reference
 def test_invariant_kernels_match_interpreter():
-    spec = _vsr_spec(values=("v1", "v2"), timer=1)
+    spec = vsr_spec(values=("v1", "v2"), timer=1)
     eng = DeviceBFS(spec)
     kern, codec = eng.kern, eng.codec
     states = explore_states(spec, 120)[::3]

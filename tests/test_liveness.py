@@ -1,0 +1,114 @@
+"""Liveness checker tests: fair-SCC search over the behavior graph.
+
+The A01 pair is the corpus oracle: under LivenessSpec (per-action WF,
+A01:793-806) both shipped properties hold on small constants; under the
+fairness-free Spec the same properties are violated by stuttering
+lassos — exactly the distinction the reference's cfg comments describe.
+"""
+
+import pytest
+
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.engine.liveness import liveness_check
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file, parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_file, parse_module_text
+
+TICKER = """---- MODULE Ticker ----
+EXTENDS Naturals
+VARIABLES x, stopped
+
+Init ==
+    /\\ x = 0
+    /\\ stopped = FALSE
+
+Tick ==
+    /\\ stopped = FALSE
+    /\\ x' = (x + 1) % 3
+    /\\ UNCHANGED stopped
+
+Stop ==
+    /\\ stopped' = TRUE
+    /\\ UNCHANGED x
+
+Next ==
+    \\/ Tick
+    \\/ Stop
+
+AtZero == x = 0
+Hit == x = 2
+
+Spec == Init /\\ [][Next]_vars
+FairSpec == Init /\\ [][Next]_vars /\\ WF_vars(Tick)
+
+AlwaysEventuallyZero == []<>AtZero
+EventuallyHit == AtZero ~> Hit
+
+vars == <<x, stopped>>
+====
+"""
+
+
+def _ticker(spec_name, props):
+    cfg = parse_cfg_text(
+        f"SPECIFICATION {spec_name}\nPROPERTY\n" + "\n".join(props) + "\n")
+    return SpecModel(parse_module_text(TICKER), cfg)
+
+
+def test_gf_holds_under_fairness():
+    # WF(Tick): Stop is never forced, but once stopped Tick is disabled,
+    # so the stuttering lasso at a stopped state IS fair — x can stop
+    # away from zero: property fails even under WF(Tick)
+    spec = _ticker("FairSpec", ["AlwaysEventuallyZero"])
+    res = liveness_check(spec)
+    assert not res.ok
+    assert res.property_name == "AlwaysEventuallyZero"
+
+
+def test_gf_violated_without_fairness():
+    spec = _ticker("Spec", ["AlwaysEventuallyZero"])
+    res = liveness_check(spec)
+    assert not res.ok
+    # stuttering lasso: cycle state has x != 0
+    assert res.trace[-1].state["x"] != 0
+
+
+def test_leadsto():
+    spec = _ticker("FairSpec", ["EventuallyHit"])
+    res = liveness_check(spec)
+    # from x=0, Stop can fire before reaching 2, then stutter: violated
+    assert not res.ok
+
+    # remove the Stop escape: strengthen fairness can't help since Stop
+    # freezes the system; instead check on a stop-free next relation
+    TICKER2 = TICKER.replace("\\/ Stop\n", "")
+    cfg = parse_cfg_text("SPECIFICATION FairSpec\nPROPERTY EventuallyHit\n")
+    spec2 = SpecModel(parse_module_text(TICKER2), cfg)
+    res2 = liveness_check(spec2)
+    assert res2.ok
+
+
+@requires_reference
+@pytest.mark.slow
+def test_a01_liveness_corpus_oracle():
+    from tpuvsr.core.values import ModelValue
+    path = f"{REFERENCE}/analysis/01-view-changes/VR_ASSUME_NEWVIEWCHANGE"
+    mod = parse_module_file(f"{path}.tla")
+    cfg = parse_cfg_file(f"{path}.cfg")
+    cfg.constants["Values"] = frozenset({ModelValue("v1")})
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    spec = SpecModel(mod, cfg)
+    res = liveness_check(spec, max_states=200000)
+    assert res.ok, (res.property_name, res.error)
+    assert res.distinct_states > 100
+
+    # fairness-free: ConvergenceToView breaks via a stuttering lasso in
+    # a mid-view-change state
+    cfg2 = parse_cfg_file(f"{path}.cfg")
+    cfg2.constants["Values"] = frozenset({ModelValue("v1")})
+    cfg2.constants["StartViewOnTimerLimit"] = 1
+    cfg2.specification = "Spec"
+    spec2 = SpecModel(mod, cfg2)
+    res2 = liveness_check(spec2, max_states=200000)
+    assert not res2.ok
+    assert res2.property_name == "ConvergenceToView"

@@ -9,7 +9,7 @@ import pytest
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tests.conftest import REFERENCE, requires_reference
+from tests.conftest import REFERENCE, requires_reference, vsr_spec
 from tpuvsr.core.values import ModelValue
 from tpuvsr.engine.device_bfs import DeviceBFS
 from tpuvsr.engine.spec import SpecModel
@@ -23,18 +23,10 @@ pytestmark = [requires_reference,
                                  reason="needs 8 virtual devices")]
 
 
-def _vsr_spec(values=("v1",), timer=1):
-    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
-    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
-    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
-    cfg.constants["StartViewOnTimerLimit"] = timer
-    cfg.constants["RestartEmptyLimit"] = 0
-    cfg.symmetry = None
-    return SpecModel(mod, cfg)
 
 
 def test_sharded_expand_matches_single_device():
-    spec = _vsr_spec()
+    spec = vsr_spec()
     eng = DeviceBFS(spec)          # reuse its codec/kernel/invariants
     kern, codec = eng.kern, eng.codec
     inv = kern.invariant_fn(list(spec.cfg.invariants))

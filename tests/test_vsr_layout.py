@@ -8,23 +8,9 @@ the message bag's tombstones and the implied-field-compressed recv sets
 
 import pytest
 
-from tests.conftest import (REFERENCE, explore_states, requires_reference,
-                            state_key)
-from tpuvsr.engine.spec import SpecModel
-from tpuvsr.frontend.cfg import parse_cfg_file
-from tpuvsr.frontend.parser import parse_module_file
+from tests.conftest import (explore_states, requires_reference, state_key,
+                            vsr_spec)
 from tpuvsr.models.vsr import VSRCodec
-
-
-def _vsr_spec(values=("v1",), timer=1, restarts=0):
-    from tpuvsr.core.values import ModelValue
-    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
-    cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
-    cfg.constants["Values"] = frozenset(ModelValue(v) for v in values)
-    cfg.constants["StartViewOnTimerLimit"] = timer
-    cfg.constants["RestartEmptyLimit"] = restarts
-    cfg.symmetry = None
-    return SpecModel(mod, cfg)
 
 
 @requires_reference
@@ -34,7 +20,7 @@ def _vsr_spec(values=("v1",), timer=1, restarts=0):
     (("v1", "v2"), 1, 1, 400),   # exercises recovery-message encodings
 ])
 def test_roundtrip_reachable_states(values, timer, restarts, n):
-    spec = _vsr_spec(values, timer, restarts)
+    spec = vsr_spec(values, timer, restarts)
     codec = VSRCodec(spec.cfg.constants)
     states = explore_states(spec, n)
     assert len(states) > 50
@@ -49,7 +35,7 @@ def test_init_state_is_zero_state():
     # The all-zeros dense state IS the spec's Init (VSR.tla:323-348):
     # statuses Normal(=0), views... view is 1 in Init, so not all-zero;
     # encode(init) must still round-trip and match field expectations.
-    spec = _vsr_spec()
+    spec = vsr_spec()
     codec = VSRCodec(spec.cfg.constants)
     init = next(iter(spec.init_states()))
     d = codec.encode(init)

@@ -1,0 +1,142 @@
+"""TLC-flag-compatible command line (SURVEY.md §5 config/flag system).
+
+    python -m tpuvsr SPEC.tla [-config FILE.cfg] [options]
+
+The reference corpus's specs and cfgs run unchanged; flags mirror the
+TLC CLI that the reference's README drives (workers/simulation/depth):
+
+  -config FILE     model file (default: SPEC base name + .cfg)
+  -workers N|auto  accepted for TLC compatibility (the device engine
+                   parallelizes across lanes/devices instead of threads)
+  -simulate        simulation mode (random walks) instead of BFS
+  -depth N         walk depth in simulation mode (default 100)
+  -num N           number of walks (default 10000; TLC runs forever)
+  -seed N          simulation RNG seed
+  -engine E        auto | device | interp (default auto: the jit+vmap
+                   device engine for specs with a compiled kernel, the
+                   interpreter otherwise)
+  -maxstates N     stop BFS after N distinct states
+  -deadlock        enable deadlock reporting (note: TLC's flag of the
+                   same name *disables* its default-on check; the
+                   reference corpus only runs deadlock-off)
+  -json            emit a one-line JSON result summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tpuvsr", add_help=True, prefix_chars="-",
+        description="TPU-native TLA+ model checker for the VSR corpus")
+    p.add_argument("spec", help="path to the .tla module")
+    p.add_argument("-config", help=".cfg model file")
+    p.add_argument("-workers", default="auto")
+    p.add_argument("-simulate", action="store_true")
+    p.add_argument("-depth", type=int, default=100)
+    p.add_argument("-num", type=int, default=10000)
+    p.add_argument("-seed", type=int, default=0)
+    p.add_argument("-engine", choices=["auto", "device", "interp"],
+                   default="auto")
+    p.add_argument("-maxstates", type=int, default=None)
+    p.add_argument("-deadlock", action="store_true")
+    p.add_argument("-json", action="store_true")
+    p.add_argument("-maxseconds", type=float, default=None)
+    return p
+
+
+def _pick_engine(requested, spec):
+    if requested != "auto":
+        return requested
+    # the compiled device kernel covers the root VSR module (C=1);
+    # everything else runs on the interpreter
+    if spec.module.name == "VSR" and \
+            spec.ev.constants.get("ClientCount") == 1:
+        return "device"
+    return "interp"
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from ..engine.spec import load_spec
+    from ..engine.trace import format_trace
+
+    cfg_path = args.config or os.path.splitext(args.spec)[0] + ".cfg"
+    spec = load_spec(args.spec, cfg_path)
+    engine = _pick_engine(args.engine, spec)
+    t0 = time.time()
+
+    def log(msg):
+        print(f"[tpuvsr] {msg}", file=sys.stderr)
+
+    log(f"spec {spec.module.name}, engine {engine}, "
+        f"{'simulation' if args.simulate else 'BFS'}")
+
+    if args.simulate:
+        if engine == "device":
+            from ..engine.device_sim import device_simulate
+            res = device_simulate(spec, num=args.num, depth=args.depth,
+                                  seed=args.seed, log=log,
+                                  check_deadlock=args.deadlock,
+                                  max_seconds=args.maxseconds)
+        else:
+            from ..engine.simulate import simulate
+            res = simulate(spec, num=args.num, depth=args.depth,
+                           seed=args.seed, check_deadlock=args.deadlock,
+                           log=log, time_budget=args.maxseconds)
+        summary = {"mode": "simulate", "ok": res.ok,
+                   "walks": res.walks, "steps": res.steps,
+                   "violated": res.violated_invariant,
+                   "elapsed_s": round(res.elapsed, 3)}
+    else:
+        if engine == "device":
+            from ..engine.device_bfs import device_bfs_check
+            res = device_bfs_check(spec, max_states=args.maxstates,
+                                   check_deadlock=args.deadlock, log=log)
+        else:
+            from ..engine.bfs import bfs_check
+            res = bfs_check(spec, check_deadlock=args.deadlock,
+                            max_states=args.maxstates, log=log)
+        summary = {"mode": "bfs", "ok": res.ok,
+                   "distinct_states": res.distinct_states,
+                   "states_generated": res.states_generated,
+                   "diameter": res.diameter,
+                   "violated": res.violated_invariant,
+                   "error": res.error,
+                   "elapsed_s": round(res.elapsed, 3)}
+        if res.ok and not res.error and spec.temporal_props:
+            from ..engine.liveness import liveness_check
+            log(f"checking temporal properties: "
+                f"{', '.join(spec.temporal_props)}")
+            lres = liveness_check(spec, max_states=args.maxstates, log=log)
+            summary["properties_ok"] = lres.ok
+            if not lres.ok:
+                res.ok = False
+                res.trace = lres.trace
+                summary["ok"] = False
+                summary["violated"] = lres.property_name or lres.error
+                res.violated_invariant = lres.property_name
+                print(f"Error: Temporal property "
+                      f"{lres.property_name or lres.error} is violated.",
+                      file=sys.stderr)
+
+    if not res.ok and res.trace:
+        print(f"Error: Invariant {res.violated_invariant} is violated.",
+              file=sys.stderr)
+        print(format_trace(res.trace))
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            print(f"{k}: {v}")
+    return 0 if res.ok else 12        # TLC exit code 12 = safety violation
+
+
+if __name__ == "__main__":
+    sys.exit(main())

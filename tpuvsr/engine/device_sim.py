@@ -1,0 +1,199 @@
+"""Device simulation mode: vectorized random walks (TLC's simulator,
+README:22, rebuilt as a vmapped XLA program; BASELINE.json configs[2]).
+
+Semantics match TLC's SimulationWorker: each walk starts at the initial
+state and repeatedly jumps to a successor chosen uniformly at random
+from the full (action x binding) successor list — which is exactly the
+kernel's lane space — checking invariants at every visited state, up to
+a depth bound.  A walker with no enabled successor stays put (TLC ends
+the walk; with -deadlock it is reported).
+
+W walkers advance in lockstep inside one jitted step: expand all lanes,
+draw an argmax-of-masked-uniforms lane (uniform over enabled lanes),
+gather the chosen successor, and evaluate the invariants.  Per-walker
+histories are kept host-side as (action id, lane param) pairs — stable
+across message-table growth — so a violating walk replays through the
+materialize kernels into a full TRACE-format counterexample.  On bag
+overflow the message table grows in place (zero padding changes no
+state content) and the erroring step is redrawn.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.vsr import VSRCodec
+from ..models.vsr_kernel import ACTION_NAMES, VSRKernel
+from .device_bfs import _value_perm_table
+from .simulate import SimResult
+from .spec import SpecModel
+from .trace import TraceEntry
+
+_MSG_KEYS = ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
+             "m_log_len", "m_has_log")
+
+
+class DeviceSimulator:
+    def __init__(self, spec: SpecModel, max_msgs=None, walkers=256):
+        self.spec = spec
+        self.W = walkers
+        self.inv_names = list(spec.cfg.invariants)
+        self._build(max_msgs)
+
+    def _build(self, max_msgs):
+        spec = self.spec
+        self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
+        self.kern = VSRKernel(self.codec,
+                              perms=_value_perm_table(spec, self.codec))
+        inv = self.kern.invariant_fn(self.inv_names)
+        kern = self.kern
+
+        def step(states, keys):
+            def one(st, key):
+                succs, en = kern.step_all(st)
+                u = jax.random.uniform(key, en.shape)
+                lane = jnp.argmax(jnp.where(en, u, -1.0))
+                alive = en.any()
+                succ = {k: jnp.where(alive, v[lane], st[k])
+                        for k, v in succs.items()}
+                bad = alive & ~inv(succ)
+                err = alive & (succ["err"] != 0)
+                return succ, lane, alive, bad, err
+            return jax.vmap(one)(states, keys)
+
+        self._step = jax.jit(step)
+        self._mat = {}
+
+    def _grow_msgs(self, batches):
+        """Double MAX_MSGS and pad the given dense batches."""
+        old = self.codec.shape.MAX_MSGS
+        self._build(old * 2)
+
+        def pad(d):
+            out = dict(d)
+            for k in _MSG_KEYS:
+                v = np.asarray(d[k])
+                shape = list(v.shape)
+                shape[1] = old
+                out[k] = np.concatenate(
+                    [v, np.zeros(shape, v.dtype)], axis=1)
+            return out
+        return [pad(b) for b in batches]
+
+    def _materialize_one(self, st, aid, param):
+        fn = self._mat.get(aid)
+        if fn is None:
+            fn = jax.jit(jax.vmap(self.kern._action_fns()[aid],
+                                  in_axes=(0, 0)))
+            self._mat[aid] = fn
+        batch = {k: np.asarray(v)[None] for k, v in st.items()}
+        succ, en = fn(batch, jnp.asarray([param], jnp.int32))
+        assert bool(np.asarray(en)[0]), "replay chose a disabled lane"
+        return {k: np.asarray(v)[0] for k, v in succ.items()
+                if not k.startswith("_")}
+
+    def run(self, num=1000, depth=100, seed=0, check_deadlock=False,
+            log=None, max_seconds=None) -> SimResult:
+        """Run `num` walks of `depth` steps (W at a time)."""
+        spec, codec = self.spec, self.codec
+        res = SimResult()
+        t0 = time.time()
+        init_dense = [codec.encode(st) for st in spec.init_states()]
+        init = {k: np.repeat(np.stack([d[k] for d in init_dense])[:1],
+                             self.W, axis=0) for k in init_dense[0]}
+        bad0 = spec.check_invariants(
+            codec.decode({k: np.asarray(v[0]) for k, v in init.items()}))
+        if bad0:
+            res.ok = False
+            res.violated_invariant = bad0
+            res.elapsed = time.time() - t0
+            return res
+        key = jax.random.PRNGKey(seed)
+        stop = False
+        while res.walks < num and not stop:
+            states = {k: np.asarray(v) for k, v in init.items()}
+            hist_aid = np.full((self.W, depth), -1, np.int32)
+            hist_par = np.zeros((self.W, depth), np.int32)
+            was_alive = np.ones((self.W,), bool)
+            for d in range(depth):
+                key, sub = jax.random.split(key)
+                keys = jax.random.split(sub, self.W)
+                while True:
+                    out = self._step(
+                        {k: jnp.asarray(v) for k, v in states.items()},
+                        keys)
+                    nstates, lanes, alive, bad, err = out
+                    if np.asarray(err).any():
+                        # bag overflow in some successor: grow the table,
+                        # pad walker states, and redraw this step
+                        init, states = self._grow_msgs([init, states])
+                        if log:
+                            log(f"message table grown to "
+                                f"{self.codec.shape.MAX_MSGS} slots")
+                        continue
+                    break
+                lanes = np.asarray(lanes)
+                alive_np = np.asarray(alive)
+                hist_aid[:, d] = np.where(
+                    alive_np, self.kern.lane_action[lanes], -1)
+                hist_par[:, d] = np.where(
+                    alive_np, self.kern.lane_param[lanes], 0)
+                states = {k: np.asarray(v) for k, v in nstates.items()}
+                res.steps += int(alive_np.sum())
+                if check_deadlock and (was_alive & ~alive_np).any():
+                    w = int(np.argmax(was_alive & ~alive_np))
+                    res.ok = False
+                    res.deadlocks += 1
+                    res.trace = self._replay(init, hist_aid[w], hist_par[w])
+                    res.violated_invariant = None
+                    res.elapsed = time.time() - t0
+                    return res
+                was_alive = alive_np
+                bad_np = np.asarray(bad)
+                if bad_np.any():
+                    w = int(np.argmax(bad_np))
+                    res.ok = False
+                    res.trace = self._replay(init, hist_aid[w], hist_par[w])
+                    res.violated_invariant = self.spec.check_invariants(
+                        res.trace[-1].state) or self.inv_names[0]
+                    res.elapsed = time.time() - t0
+                    return res
+                if max_seconds and time.time() - t0 > max_seconds:
+                    stop = True
+                    break
+            res.walks += self.W
+            if log:
+                el = time.time() - t0
+                log(f"{res.walks} walks, {res.steps / el:.0f} steps/s")
+        res.elapsed = time.time() - t0
+        return res
+
+    def _replay(self, init, aids, params):
+        """Re-execute one walk's (action, param) choices into a trace."""
+        st = {k: np.asarray(v[0]) for k, v in init.items()}
+        loc = {a.name: a.location for a in self.spec.actions}
+        out = [TraceEntry(position=1, action_name=None, location=None,
+                          state=self.codec.decode(st))]
+        for i in range(len(aids)):
+            if aids[i] < 0:
+                break
+            st = self._materialize_one(st, int(aids[i]), int(params[i]))
+            name = ACTION_NAMES[aids[i]]
+            out.append(TraceEntry(position=i + 2, action_name=name,
+                                  location=loc.get(name),
+                                  state=self.codec.decode(st)))
+        return out
+
+
+def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
+                    walkers=256, max_msgs=None, check_deadlock=False,
+                    log=None, max_seconds=None) -> SimResult:
+    sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers)
+    return sim.run(num=num, depth=depth, seed=seed,
+                   check_deadlock=check_deadlock, log=log,
+                   max_seconds=max_seconds)

@@ -1,0 +1,350 @@
+"""Liveness checking: behavior graph x property automaton, fair-SCC
+search under weak fairness (SURVEY.md §3.4; exercised by the 01-series
+cfgs: SPECIFICATION LivenessSpec + PROPERTY ConvergenceToView /
+OpEventuallyAllOrNothing, A01:770-809).
+
+Property shapes supported (the corpus's inventory):
+  []<>P                  — violated by a fair lasso whose cycle is
+                           everywhere ~P
+  P ~> Q                 — violated by a fair lasso with a P-state at or
+                           before the cycle and no later Q
+  \\A x \\in S : ...      — constant-set quantification over either shape
+
+Both negations are one-jump Büchi automata (guess the point after which
+the bad condition holds forever), so the product graph is at most twice
+the behavior graph.  A cycle C is weakly fair for WF_vars(A) iff C takes
+a real (state-changing) A-step or some state of C has <<A>>_vars
+disabled; infinite stuttering at a state is a (trivially) fair cycle for
+every WF whose action is disabled there — TLC's temporal semantics for
+[][Next]_vars specs.
+
+The graph is built with the interpreter (liveness configs are the small
+ones; symmetry must be off, as the reference cfg comments insist —
+A01 cfg:22-24).  States are identified by their VIEW value, matching
+TLC's behavior-graph construction under a VIEW.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..core.values import TLAError
+from .spec import SpecModel
+from .trace import TraceEntry
+
+
+@dataclass
+class LivenessResult:
+    ok: bool = True
+    property_name: str = None
+    distinct_states: int = 0
+    elapsed: float = 0.0
+    trace: list = field(default_factory=list)   # prefix + cycle
+    cycle_start: int = 0                        # index into trace
+    error: str = None
+
+
+def _build_graph(spec: SpecModel, max_states=None):
+    """Reachable behavior graph: states, edges (sid, action, tid)."""
+    if spec.symmetry_perms:
+        raise TLAError("liveness checking requires SYMMETRY off "
+                       "(reference cfg guidance, A01 cfg:22-24)")
+    ids = {}
+    states = []
+    edges = []          # list of lists: sid -> [(action_name, tid)]
+    order = []
+
+    def intern(st):
+        k = spec.view_value(st)
+        sid = ids.get(k)
+        if sid is None:
+            sid = len(states)
+            ids[k] = sid
+            states.append(st)
+            edges.append([])
+            order.append(sid)
+        return sid
+
+    frontier = [intern(st) for st in spec.init_states()]
+    inits = list(frontier)
+    seen_depth = 0
+    while frontier:
+        seen_depth += 1
+        nxt = []
+        for sid in frontier:
+            if edges[sid]:
+                continue
+            st = states[sid]
+            for action, succ in spec.successors(st):
+                known = len(states)
+                tid = intern(succ)
+                edges[sid].append((action.name, tid))
+                if tid >= known:
+                    nxt.append(tid)
+            if max_states and len(states) > max_states:
+                raise TLAError(
+                    f"liveness graph exceeds {max_states} states")
+        frontier = nxt
+    return states, edges, inits
+
+
+def _collect_props(spec: SpecModel, name):
+    """Expand a PROPERTY definition into (kind, P_expr, Q_expr, env)
+    leaves; kind in {"gf", "leadsto"}."""
+    from ..interp.evalr import EMPTY_ENV, EvalCtx
+    d = spec.module.defs.get(name)
+    if d is None:
+        raise TLAError(f"PROPERTY {name} not defined")
+    leaves = []
+
+    def walk(e, env):
+        tag = e[0]
+        if tag == "box" and e[1][0] == "diamond":
+            leaves.append(("gf", e[1][1], None, env))
+        elif tag == "binop" and e[1] == "leadsto":
+            leaves.append(("leadsto", e[2], e[3], env))
+        elif tag == "forall":
+            for binding in spec.ev._group_bindings(e[1], env, EvalCtx({})):
+                walk(e[2], env.extend(binding))
+        elif tag == "and":
+            for x in e[1]:
+                walk(x, env)
+        elif tag == "id" and e[1] in spec.module.defs:
+            walk(spec.module.defs[e[1]].body, env)
+        else:
+            raise TLAError(f"unsupported temporal property shape: {tag}")
+    walk(d.body, EMPTY_ENV)
+    return leaves
+
+
+def _eval_pred(spec, expr, env, st):
+    from ..interp.evalr import EvalCtx
+    return spec.ev.eval(expr, env, EvalCtx(st)) is True
+
+
+def _fairness_names(spec):
+    """WF action names from the decomposed SPECIFICATION."""
+    names = []
+    for kind, _sub, act in spec.fairness:
+        if kind != "wf":
+            raise TLAError("only weak fairness appears in the corpus")
+        if act[0] == "id":
+            names.append(act[1])
+        else:
+            raise TLAError(f"unsupported fairness action: {act!r}")
+    return names
+
+
+def _tarjan_sccs(n_nodes, succ):
+    """Iterative Tarjan over node ids 0..n-1 with succ(u) -> iterable."""
+    index = [-1] * n_nodes
+    low = [0] * n_nodes
+    onstack = [False] * n_nodes
+    stack = []
+    sccs = []
+    counter = [0]
+    for root in range(n_nodes):
+        if index[root] != -1:
+            continue
+        work = [(root, 0, list(succ(root)))]
+        while work:
+            u, pi, children = work[-1]
+            if pi == 0:
+                index[u] = low[u] = counter[0]
+                counter[0] += 1
+                stack.append(u)
+                onstack[u] = True
+            advanced = False
+            for ci in range(pi, len(children)):
+                v = children[ci]
+                if index[v] == -1:
+                    work[-1] = (u, ci + 1, children)
+                    work.append((v, 0, list(succ(v))))
+                    advanced = True
+                    break
+                elif onstack[v]:
+                    low[u] = min(low[u], index[v])
+            if advanced:
+                continue
+            if low[u] == index[u]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack[w] = False
+                    comp.append(w)
+                    if w == u:
+                        break
+                sccs.append(comp)
+            work.pop()
+            if work:
+                p = work[-1][0]
+                low[p] = min(low[p], low[u])
+    return sccs
+
+
+def liveness_check(spec: SpecModel, max_states=None,
+                   log=None) -> LivenessResult:
+    res = LivenessResult()
+    t0 = time.time()
+    try:
+        states, edges, inits = _build_graph(spec, max_states)
+    except TLAError as e:
+        res.ok = False
+        res.error = str(e)
+        res.elapsed = time.time() - t0
+        return res
+    res.distinct_states = len(states)
+    if log:
+        log(f"behavior graph: {len(states)} states, "
+            f"{sum(len(e) for e in edges)} edges")
+
+    wf_names = _fairness_names(spec)
+    n = len(states)
+    # per-state: which WF actions have a real (state-changing) step
+    enabled = [set() for _ in range(n)]
+    for sid in range(n):
+        for aname, tid in edges[sid]:
+            if tid != sid:
+                enabled[sid].add(aname)
+
+    for prop_name in spec.temporal_props:
+        for kind, p_expr, q_expr, env in _collect_props(spec, prop_name):
+            if kind == "gf":
+                # violation automaton: jump to phase 1 on ~P, stay on ~P
+                def bad_here(sid):
+                    return not _eval_pred(spec, p_expr, env, states[sid])
+            else:
+                # P ~> Q: phase-1 condition is ~Q; the jump additionally
+                # requires P at the jump state (checked when seeding)
+                def bad_here(sid):
+                    return not _eval_pred(spec, q_expr, env, states[sid])
+            bad = [bad_here(sid) for sid in range(n)]
+            if kind == "leadsto":
+                seed = [bad[sid]
+                        and _eval_pred(spec, p_expr, env, states[sid])
+                        for sid in range(n)]
+            else:
+                seed = bad
+
+            # phase-1 subgraph: states with bad=True, edges bad->bad
+            # (+ implicit stutter self-loops).  A fair cycle inside it
+            # reachable from a seed state violates the property.
+            def p1_succ(u):
+                return [tid for (_a, tid) in edges[u] if bad[tid]]
+
+            sccs = _tarjan_sccs(n, lambda u: p1_succ(u) if bad[u] else [])
+            comp_of = [-1] * n
+            for ci, comp in enumerate(sccs):
+                for u in comp:
+                    comp_of[u] = ci
+
+            def cycle_fair(comp):
+                """A fair cycle exists within this (all-bad) SCC iff for
+                every WF action: some internal state-changing edge takes
+                it, or some SCC state has it disabled — strong
+                connectivity then stitches one cycle through all the
+                witnesses.  A singleton SCC is the stuttering lasso,
+                fair iff every WF action is disabled there."""
+                comp_set = set(comp)
+                taken = {a for u in comp for (a, t) in edges[u]
+                         if t in comp_set and t != u}
+                for wf in wf_names:
+                    if wf in taken:
+                        continue
+                    if all(wf in enabled[u] for u in comp):
+                        return False    # wf action always enabled,
+                                        # never taken: unfair
+                return True
+
+            # a violation needs BOTH a fair all-bad SCC and a lasso
+            # reaching it (init -> seed -> bad-only path) — try every
+            # candidate SCC, not just the first
+            for comp in sccs:
+                if not all(bad[u] for u in comp):
+                    continue
+                if not cycle_fair(comp):
+                    continue
+                path = _find_lasso(spec, states, edges, inits, seed, bad,
+                                   set(comp))
+                if path is not None:
+                    res.ok = False
+                    res.property_name = prop_name
+                    res.trace, res.cycle_start = path
+                    res.elapsed = time.time() - t0
+                    return res
+    res.elapsed = time.time() - t0
+    return res
+
+
+def _find_lasso(spec, states, edges, inits, seed, bad, comp):
+    """BFS init -> seed state s, then bad-only path s -> comp; returns
+    (trace_entries, cycle_start_index) or None."""
+    from collections import deque
+
+    # phase A: shortest path from any init to a seed state
+    prev = {}
+    dq = deque()
+    for i in inits:
+        if i not in prev:
+            prev[i] = (None, None)
+            dq.append(i)
+    target = None
+    while dq:
+        u = dq.popleft()
+        if seed[u]:
+            # phase B must reach comp from u via bad states
+            pb = _bad_path(edges, bad, u, comp)
+            if pb is not None:
+                target = (u, pb)
+                break
+        for aname, t in edges[u]:
+            if t not in prev:
+                prev[t] = (u, aname)
+                dq.append(t)
+    if target is None:
+        return None
+    u, pb = target
+    # reconstruct prefix
+    pre = []
+    cur = u
+    while cur is not None:
+        p, a = prev[cur]
+        pre.append((cur, a))
+        cur = p
+    pre.reverse()
+    full = pre + pb[1:] if pb else pre
+    loc = {a.name: a.location for a in spec.actions}
+    entries = []
+    for i, (sid, aname) in enumerate(full):
+        entries.append(TraceEntry(
+            position=i + 1, action_name=aname,
+            location=loc.get(aname) if aname else None,
+            state=states[sid]))
+    cycle_start = len(pre) - 1 if not pb or len(pb) <= 1 else len(pre)
+    return entries, max(0, cycle_start)
+
+
+def _bad_path(edges, bad, start, comp):
+    """BFS through bad-states from start into comp; [(sid, action)]."""
+    from collections import deque
+    if start in comp:
+        return [(start, None)]
+    prev = {start: (None, None)}
+    dq = deque([start])
+    while dq:
+        u = dq.popleft()
+        for aname, t in edges[u]:
+            if bad[t] and t not in prev:
+                prev[t] = (u, aname)
+                if t in comp:
+                    out = []
+                    cur = t
+                    while cur is not None:
+                        p, a = prev[cur]
+                        out.append((cur, a))
+                        cur = p
+                    out.reverse()
+                    return out
+                dq.append(t)
+    return None

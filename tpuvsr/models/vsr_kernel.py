@@ -1016,12 +1016,20 @@ class VSRKernel:
     def inv_test(self, st):
         return jnp.asarray(True)
 
+    def pred_all_replicas_same_view(self, st):
+        # AllReplicasMoveToSameView (VSR.tla:958-962): a state predicate
+        # used by the liveness property []<>P; not an invariant in the
+        # shipped cfg, but checkable as one
+        return ((st["view"] == st["view"][0]).all()
+                & (st["status"] == NORMAL).all())
+
     INVARIANT_FNS = {
         "AcknowledgedWriteNotLost": "inv_acknowledged_write_not_lost",
         "AcknowledgedWritesExistOnMajority":
             "inv_acknowledged_writes_exist_on_majority",
         "NoLogDivergence": "inv_no_log_divergence",
         "TestInv": "inv_test",
+        "AllReplicasMoveToSameView": "pred_all_replicas_same_view",
     }
 
     def invariant_fn(self, names):
