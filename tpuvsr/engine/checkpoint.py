@@ -1,0 +1,87 @@
+"""Checker-level checkpoint/resume (SURVEY.md §5): snapshot the BFS
+frontier, fingerprint table, visited-state store, and counters so
+multi-day runs survive preemption — the analog of TLC's queue/FPSet
+checkpointing implied by the reference's 500 GB multi-day guidance
+(README:20).
+
+Format: one directory with numbered .npz chunk files plus a manifest;
+written atomically (tmp dir + rename) so a crash mid-write leaves the
+previous checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+FORMAT_VERSION = 1
+
+
+def save_checkpoint(path, *, table, store, frontier, level_base, depth,
+                    level_sizes, fp_count, fp_cap, states_generated,
+                    max_msgs, elapsed):
+    """Write a complete engine snapshot to `path` (atomic)."""
+    tmp = path + ".tmp"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "fpset.npz"),
+             tags=np.asarray(table["tags"]),
+             rows=np.asarray(table["rows"]))
+    np.savez(os.path.join(tmp, "frontier.npz"), **frontier)
+    for i, chunk in enumerate(store.chunks):
+        np.savez(os.path.join(tmp, f"chunk{i:05d}.npz"), **chunk)
+    manifest = {
+        "format": FORMAT_VERSION,
+        "n_chunks": len(store.chunks),
+        "offsets": store.offsets,
+        "parents": [[p if p is not None else -1,
+                     a if a is not None else -1]
+                    for p, a in store.parents],
+        "level_base": level_base,
+        "depth": depth,
+        "level_sizes": level_sizes,
+        "fp_count": fp_count,
+        "fp_cap": fp_cap,
+        "states_generated": states_generated,
+        "max_msgs": max_msgs,
+        "elapsed": elapsed,
+    }
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+
+
+def load_checkpoint(path):
+    """Read a snapshot; returns a dict of the save_checkpoint kwargs."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format"] != FORMAT_VERSION:
+        raise ValueError(f"checkpoint format {manifest['format']} "
+                         f"unsupported")
+    fp = np.load(os.path.join(path, "fpset.npz"))
+    table = {"tags": fp["tags"], "rows": fp["rows"]}
+    fr = np.load(os.path.join(path, "frontier.npz"))
+    frontier = {k: fr[k] for k in fr.files}
+    chunks = []
+    for i in range(manifest["n_chunks"]):
+        c = np.load(os.path.join(path, f"chunk{i:05d}.npz"))
+        chunks.append({k: c[k] for k in c.files})
+    parents = [(None if p == -1 else p, None if a == -1 else a)
+               for p, a in manifest["parents"]]
+    return {
+        "table": table, "frontier": frontier, "chunks": chunks,
+        "offsets": manifest["offsets"], "parents": parents,
+        "level_base": manifest["level_base"], "depth": manifest["depth"],
+        "level_sizes": manifest["level_sizes"],
+        "fp_count": manifest["fp_count"], "fp_cap": manifest["fp_cap"],
+        "states_generated": manifest["states_generated"],
+        "max_msgs": manifest["max_msgs"],
+        "elapsed": manifest["elapsed"],
+    }
