@@ -1,32 +1,67 @@
 """Headline benchmark: distinct states/sec of the device BFS engine on
 the shrunken flagship config (BASELINE.json configs[0]: VSR.tla with
 R=3, C=1, Values={v1}, StartViewOnTimerLimit=1 — 43,941 distinct
-states, diameter 24).
+states, diameter 24), checked to fixpoint.
 
-Prints ONE JSON line {metric, value, unit, vs_baseline}.
-vs_baseline = device states/sec over the single-thread interpreter
-oracle's states/sec on the same spec (the stand-in for the reference's
-explicit-state checker until a TLC number is recorded; the reference
-publishes no throughput figures — SURVEY.md §6).
+Prints ONE JSON line {metric, value, unit, vs_baseline, ...}.
+vs_baseline = device distinct states/sec over the single-thread
+interpreter oracle's distinct states/sec on the same spec (the stand-in
+for the reference's explicit-state checker; the reference publishes no
+throughput figures — SURVEY.md §6).
 
-Robustness: the session TPU is reached through a tunnel that can hang
-backend init; the platform is probed in a subprocess with a timeout and
-the bench falls back to CPU if the tunnel is down.
+Robustness (round-1 failure modes):
+* the metric JSON is ALWAYS emitted — on SIGTERM/SIGINT, on an internal
+  deadline short of the driver timeout, and on any crash — carrying
+  whatever was measured so far plus a `phase` marker;
+* the backend actually used is recorded in the JSON so a CPU-fallback
+  run can't masquerade as a TPU number;
+* the session TPU is reached through a tunnel that can hang backend
+  init: the platform is probed in a subprocess with a timeout and the
+  bench falls back to CPU if the tunnel is down.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
-REFERENCE = os.environ.get(
-    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
 
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
-INTERP_STATES = int(os.environ.get("BENCH_INTERP_STATES", "4000"))
+INTERP_STATES = int(os.environ.get("BENCH_INTERP_STATES", "3000"))
+T0 = time.time()
+DEADLINE = T0 + 0.92 * BUDGET_S
+
+RESULT = {
+    "metric": "VSR.tla BFS distinct states/sec (R=3, |Values|=1, timer=1)",
+    "value": 0.0,
+    "unit": "states/sec",
+    "vs_baseline": 0.0,
+    "backend": "unknown",
+    "phase": "startup",
+}
+_EMITTED = False
+
+
+def emit(code=0):
+    global _EMITTED
+    if not _EMITTED:
+        _EMITTED = True
+        print(json.dumps(RESULT), flush=True)
+    if code is not None:
+        os._exit(code)
+
+
+def _on_signal(signum, frame):
+    RESULT["phase"] += f" (signal {signum})"
+    emit(1)
+
+
+signal.signal(signal.SIGTERM, _on_signal)
+signal.signal(signal.SIGINT, _on_signal)
 
 
 def _probe_default_backend(timeout=180):
@@ -49,10 +84,11 @@ def main():
     if backend is None:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        backend = "cpu (tpu tunnel unavailable)"
+        backend = "cpu-fallback (tpu tunnel unavailable)"
     import jax
     if backend.startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
+    RESULT["backend"] = backend
     print(f"bench: backend = {backend}", file=sys.stderr)
 
     from __graft_entry__ import _small_spec
@@ -60,39 +96,58 @@ def main():
     from tpuvsr.engine.device_bfs import DeviceBFS
 
     # baseline: single-thread interpreter (exact TLC-style enumeration)
+    RESULT["phase"] = "interpreter-baseline"
     spec = _small_spec()
     base = bfs_check(spec, max_states=INTERP_STATES)
-    base_sps = base.states_generated / base.elapsed
-    print(f"bench: interpreter baseline {base_sps:.0f} generated/s",
+    base_sps = base.distinct_states / base.elapsed
+    RESULT["baseline_interp_distinct_per_s"] = round(base_sps, 1)
+    print(f"bench: interpreter baseline {base_sps:.0f} distinct/s "
+          f"({base.states_generated / base.elapsed:.0f} generated/s)",
           file=sys.stderr)
 
-    # device engine: warm up the jits on a depth-limited run, then
-    # measure on the SAME instance (run() resets its store/FPSet, and
-    # jax.jit caches by closure identity, so the compile is reused)
-    tile = int(os.environ.get("BENCH_TILE", "64"))
-    eng = DeviceBFS(spec, tile_size=tile)
+    # device engine: compile+warm on a depth-limited run, then measure a
+    # fresh full run on the SAME instance (jits are cached by closure)
+    RESULT["phase"] = "compile"
+    tile = int(os.environ.get("BENCH_TILE", "256"))
+    eng = DeviceBFS(spec, tile_size=tile, fpset_capacity=1 << 21,
+                    next_capacity=1 << 15, expand_mult=2,
+                    expand_mults={"ReceiveMatchingSVC": 4, "SendDVC": 4})
     t0 = time.time()
-    eng.run(max_depth=1)
-    print(f"bench: compile+warmup {time.time() - t0:.1f}s",
-          file=sys.stderr)
+    eng.run(max_depth=6)
+    compile_s = time.time() - t0
+    RESULT["compile_s"] = round(compile_s, 1)
+    print(f"bench: compile+warmup {compile_s:.1f}s", file=sys.stderr)
 
-    res = eng.run(max_seconds=BUDGET_S,
+    RESULT["phase"] = "device-bfs"
+    t0 = time.time()
+    res = eng.run(max_seconds=max(30.0, DEADLINE - time.time()),
                   log=lambda m: print(f"bench: {m}", file=sys.stderr))
     dev_sps = res.states_generated / res.elapsed
     distinct_sps = res.distinct_states / res.elapsed
+    RESULT.update({
+        "phase": "done" if not res.error else f"partial: {res.error}",
+        "value": round(distinct_sps, 1),
+        "vs_baseline": round(distinct_sps / base_sps, 3),
+        "distinct_states": res.distinct_states,
+        "states_generated": res.states_generated,
+        "diameter": res.diameter,
+        "elapsed_s": round(res.elapsed, 2),
+        "generated_per_s": round(dev_sps, 1),
+        "reached_fixpoint": res.error is None,
+    })
     print(f"bench: device {res.distinct_states} distinct "
           f"({res.error or 'fixpoint'}), {dev_sps:.0f} generated/s, "
           f"{distinct_sps:.0f} distinct/s, diameter {res.diameter}",
           file=sys.stderr)
-
-    print(json.dumps({
-        "metric": "VSR.tla BFS distinct states/sec "
-                  "(R=3, |Values|=1, timer=1)",
-        "value": round(distinct_sps, 1),
-        "unit": "states/sec",
-        "vs_baseline": round(dev_sps / base_sps, 3),
-    }))
+    emit(None)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — always emit the JSON
+        RESULT["phase"] += f" (error: {type(e).__name__}: {e})"
+        import traceback
+        traceback.print_exc()
+        emit(1)
+    emit(0)

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 # live config too.
 import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
+# persistent compilation cache: the big jitted level/step kernels take
+# minutes to compile on CPU; cache them across test processes
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

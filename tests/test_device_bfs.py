@@ -223,3 +223,29 @@ def test_invariant_kernels_match_interpreter():
             got = bool(fn({k: np.asarray(v) for k, v in dense.items()}))
             want = spec.eval_predicate(name, st)
             assert got == want, f"{name} differs"
+
+
+def test_fpset_insert_duplicates_single_fresh():
+    # claim-based insert must resolve intra-batch duplicate
+    # fingerprints to exactly ONE fresh lane (losers must re-check the
+    # contested slot, not probe past it — the round-2 lost-claim bug)
+    rng = np.random.default_rng(11)
+    base = rng.integers(1, 2**32, size=(64, 4), dtype=np.uint64).astype(
+        np.uint32)
+    fps = np.repeat(base, 4, axis=0)
+    fps = fps[rng.permutation(len(fps))]
+    mask = np.ones((len(fps),), bool)
+    table = empty_table(1 << 10)
+    table, fresh, ovf = insert_batch(table, fps, mask)
+    fresh = np.asarray(fresh)
+    assert not bool(ovf)
+    assert int(fresh.sum()) == 64
+    seen = set()
+    for i in range(len(fps)):
+        if fresh[i]:
+            key = tuple(int(x) for x in fps[i])
+            assert key not in seen
+            seen.add(key)
+    # nothing fresh on re-insert
+    _, fresh2, _ = insert_batch(table, fps, mask)
+    assert not np.asarray(fresh2).any()

@@ -153,3 +153,34 @@ def test_kernel_smoke_init():
     assert set(want) == set(got)
     for name in want:
         assert want[name] == got[name]
+
+
+@requires_reference
+def test_guard_fns_match_action_enabledness():
+    # the cheap guard pass (two-phase expand, device_bfs) must agree
+    # with the action functions' own `en` on every lane of every
+    # sampled reachable state — including the recovery era
+    import jax
+    import jax.numpy as jnp
+
+    spec, codec, kern = _load({"StartViewOnTimerLimit": "1",
+                               "RestartEmptyLimit": "1"})
+    states = explore_states(spec, 160)[::2]
+    gfns = kern._guard_fns()
+    afns = kern._action_fns()
+
+    @jax.jit
+    def all_en(dense):
+        outs_g, outs_a = [], []
+        for name, g, a in zip(ACTION_NAMES, gfns, afns):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+            outs_g.append(jax.vmap(lambda ln, g=g: g(dense, ln))(lanes))
+            outs_a.append(jax.vmap(
+                lambda ln, a=a: a(dense, ln)[1])(lanes))
+        return jnp.concatenate(outs_g), jnp.concatenate(outs_a)
+
+    for st in states:
+        dense = {k: jnp.asarray(v) for k, v in codec.encode(st).items()}
+        g, a = all_en(dense)
+        assert (np.asarray(g) == np.asarray(a)).all(), \
+            f"guard/action enabledness mismatch"

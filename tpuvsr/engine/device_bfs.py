@@ -1,26 +1,40 @@
-"""Device (TPU) breadth-first model checking engine.
+"""Device-resident (TPU) breadth-first model checking engine.
 
 This is the reference's hot loop — TLC's BFS worker (SURVEY.md §3.1) —
-restructured as a data-parallel XLA pipeline.  Per frontier tile of T
-states, entirely on device:
+restructured so an entire BFS level runs ON DEVICE inside one jitted
+``lax.while_loop``, with a single host synchronization per chunk of
+tiles (round 1 synced ~5x per 32-state tile, which over a tunneled TPU
+was the whole runtime).  Per tile of T frontier states, per action:
 
-  tile --step_batch--> [T, L] lane successors     (vsr_kernel.step_all)
-       --fingerprint--> symmetry-least 128-bit fp (VIEW projection)
-       --invariants --> per-successor pass/fail   (checked on *every*
-                        generated state — a superset of TLC's
-                        fresh-only checking, sound because generated
-                        states are reachable)
-       --dedup+FPSet--> fresh mask                (engine/fpset.py)
-       --compaction --> packed fresh states, transferred host-side only
+  tile --guard pass --> enabled mask over all lanes (cheap)
+       --compaction  --> enabled lanes only, per-action capacity caps
+       --vmap expand --> successors for enabled lanes (vsr_kernel)
+       --fingerprint --> incremental 128-bit fp    (VIEW + symmetry)
+       --invariants  --> per-successor pass/fail
+       --FPSet insert--> fresh mask (claim-based, duplicate-tolerant;
+                         a conservative headroom check at tile entry
+                         keeps inserts and scatters atomic)
+       --scatter     --> fresh successors + (parent, action, param)
+                         written straight into the device-resident
+                         next-frontier buffer
 
-The host orchestrates tiles, owns the frontier (numpy), and keeps
-(parent, action, lane) pointers per state for counterexample
-reconstruction in the reference's trace format (TRACE:3-7).
+Full states never leave the device.  The host keeps only the compact
+(parent gid, action id, lane param) pointer table, and counterexamples
+are reconstructed by REPLAYING the recorded action chain from the
+initial state (exactly how the recorded choices determine the states),
+then emitted in the reference's trace format (TRACE:3-7).
 
-Scale note: frontier + visited states live in host RAM (the device holds
-only fingerprints + the working tile), so capacity is host-memory-bound
-at ~5 KB/state; fingerprints in HBM at 16 B/state.  Multi-host sharding
-is the next tier (SURVEY.md §5 distributed backend).
+Pause/resume protocol: growth events (message-table too small, FPSet
+load, next-buffer capacity), invariant violations, in-action slot
+errors and deadlocks surface as a `reason` code; the level kernel
+commits NOTHING for the action that failed, so the host can grow the
+relevant structure and re-enter the level at the paused tile — lanes
+already committed simply dedup against the FPSet on re-run.
+
+Scale note: fingerprints live in HBM at 16 B/state; the frontier and
+next-frontier buffers hold dense states in HBM (~state_size x capacity);
+the host holds 10 B/state of trace pointers.  Multi-host sharding is
+the next tier (SURVEY.md §5 distributed backend, parallel/sharded_bfs).
 """
 
 from __future__ import annotations
@@ -36,10 +50,31 @@ from ..core.values import TLAError
 from ..models.vsr import ERR_BAG_OVERFLOW, VSRCodec
 from ..models.vsr_kernel import ACTION_NAMES, VSRKernel
 from .bfs import CheckResult
-from .fpset import dedup_batch, empty_table, grow, insert_batch
+from .fpset import empty_table, grow, insert_batch, insert_core
 from .spec import SpecModel
 from .trace import TraceEntry
 
+I32 = jnp.int32
+
+# The jitted level kernel takes minutes to build; persist compiled
+# binaries across processes (bench, CLI, tests share one cache).
+if not jax.config.jax_compilation_cache_dir:
+    import os as _os
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.environ.get("TPUVSR_JAX_CACHE",
+                        _os.path.expanduser("~/.cache/tpuvsr_jax")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+# level-kernel stop reasons
+RUNNING = 0
+R_VIOLATION = 2      # an invariant failed on a generated state
+R_BAG_GROW = 3       # a successor needs more message-table slots
+R_FPSET_GROW = 4     # fingerprint probing exhausted (table too full)
+R_NEXT_GROW = 5      # next-frontier buffer out of capacity
+R_SLOT_ERR = 6       # dense-layout slot collision (config limitation)
+R_DEADLOCK = 7       # a frontier state has no enabled successor
+R_EXPAND_GROW = 8    # per-action enabled-lane compaction buffer too small
 
 def _value_perm_table(spec, codec):
     """spec.symmetry_perms (ModelValue maps) -> [P, V+1] id table with the
@@ -54,150 +89,288 @@ def _value_perm_table(spec, codec):
     return np.stack(rows)
 
 
-class _StateStore:
-    """Host-side registry of visited dense states, appended per batch;
-    gid -> state row lookup for trace reconstruction."""
-
-    def __init__(self):
-        self.chunks = []          # list of dict-of-np [n_i, ...]
-        self.offsets = [0]
-        self.parents = []         # gid -> (parent_gid | None, action_id)
-
-    def append(self, states, parent_gids, action_ids):
-        n = len(parent_gids)
-        if n:
-            self.chunks.append(states)
-            self.offsets.append(self.offsets[-1] + n)
-            self.parents.extend(zip(parent_gids, action_ids))
-        return self.offsets[-1]
-
-    def __len__(self):
-        return self.offsets[-1]
-
-    def get(self, gid):
-        import bisect
-        c = bisect.bisect_right(self.offsets, gid) - 1
-        row = gid - self.offsets[c]
-        return {k: v[row] for k, v in self.chunks[c].items()}
-
-
 class DeviceBFS:
-    def __init__(self, spec: SpecModel, max_msgs=None, tile_size=32,
-                 fpset_capacity=1 << 20, hash_mode="full"):
+    def __init__(self, spec: SpecModel, max_msgs=None, tile_size=128,
+                 fpset_capacity=1 << 20, hash_mode="incremental",
+                 next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
+                 expand_mults=None):
         self.spec = spec
         self.tile = tile_size
         self.fpset_capacity = fpset_capacity
         self.hash_mode = hash_mode
+        self.next_cap = next_capacity
+        self.chunk_tiles = chunk_tiles
+        # per-action enabled-lane compaction capacity = tile * mult
+        # (each action's cap auto-doubles on its own R_EXPAND_GROW;
+        # pass a pre-calibrated per-action vector to skip the growth
+        # recompiles)
+        if expand_mults is not None:
+            self.expand_mults = dict(expand_mults) if isinstance(
+                expand_mults, dict) else list(expand_mults)
+            if isinstance(self.expand_mults, dict):
+                name_ix = {n: i for i, n in enumerate(ACTION_NAMES)}
+                base = [expand_mult] * len(ACTION_NAMES)
+                for n, m in self.expand_mults.items():
+                    base[name_ix[n]] = m
+                self.expand_mults = base
+        else:
+            self.expand_mults = [expand_mult] * len(ACTION_NAMES)
         self.inv_names = list(spec.cfg.invariants)
         self._build(max_msgs)
 
+    # ------------------------------------------------------------------
+    # kernel + jitted level construction
+    # ------------------------------------------------------------------
     def _build(self, max_msgs):
-        """(Re)build codec, kernel, and jitted passes for a message-table
-        bound; called again by _grow_msgs on bag overflow."""
+        """(Re)build codec, kernel, and the jitted level pass for a
+        message-table bound; called again on bag growth."""
         spec = self.spec
         self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
         self.kern = VSRKernel(self.codec,
                               perms=_value_perm_table(spec, self.codec))
         self.L = self.kern.n_lanes
-        inv = self.kern.invariant_fn(self.inv_names)
+        self._inv = self.kern.invariant_fn(self.inv_names)
+        self._mat = {}          # action id -> jitted single-action fn
+        self._level = jax.jit(self._make_level(),
+                              donate_argnums=(0, 4, 5, 6, 7))
+
+    def _make_level(self):
         kern = self.kern
+        inv = self._inv
+        T = self.tile
+        K = self.chunk_tiles
         incremental = self.hash_mode == "incremental"
 
-        def expand_hash(tile, valid):
-            """The fused hot pass: expand every lane, fingerprint and
-            invariant-check the successor without keeping it, and emit
-            only the per-lane smalls — the [T, L] successor states are
-            never engine outputs.  Fresh lanes are re-materialized
-            afterwards (a tiny fraction of the lane space)."""
-            def per_state(st):
-                # incremental: one full-state hash per parent,
-                # O(touched rows) per lane
-                parts = kern.parent_parts(st) if incremental else None
-                outs = []
-                for name, fn in zip(ACTION_NAMES, kern._action_fns()):
-                    lanes = jnp.arange(kern._lane_count(name),
-                                       dtype=jnp.int32)
+        def level(slots, frontier, n_front, start_t,
+                  nb, nbp, nba, nbprm, n_next0, want_deadlock):
+            N_cap = nbp.shape[0]
+            F_cap = frontier["status"].shape[0]
+            n_tiles = (n_front + T - 1) // T
 
-                    def lane_eval(lane, fn=fn, name=name):
-                        succ, en = fn(kern.seed_touch(st), lane)
-                        if incremental:
+            def cond(c):
+                return ((c["t"] < n_tiles) & (c["t"] < start_t + K)
+                        & (c["reason"] == RUNNING))
+
+            # per-action compaction capacities (adaptive; R_EXPAND_GROW
+            # carries the overflowing action so only it grows)
+            caps = [min(T * kern._lane_count(nm),
+                        max(64, T * self.expand_mults[a]))
+                    for a, nm in enumerate(ACTION_NAMES)]
+            total_E = sum(caps)
+
+            def body(c):
+                t = c["t"]
+                base = t * T
+                sidx = base + jnp.arange(T, dtype=I32)
+                valid = sidx < n_front
+                tile = {k: v[jnp.clip(sidx, 0, F_cap - 1)]
+                        for k, v in frontier.items()}
+                if incremental:
+                    parts = jax.vmap(kern.parent_parts)(tile)
+
+                slots = c["slots"]
+                nb, nbp, nba, nbprm = c["nb"], c["nbp"], c["nba"], c["nbprm"]
+                nn, dist = c["nn"], c["dist"]
+                reason, viol = c["reason"], c["viol"]
+                en_any = jnp.zeros((T,), bool)
+                gen_local = jnp.asarray(0, I32)
+                grow_aid = c["grow_aid"]
+
+                # headroom check up front: with N_cap - nn >= total_E no
+                # scatter can overrun the buffer, so an insert is never
+                # committed without its successors landing — which keeps
+                # the pause/resume protocol idempotent with no membership
+                # query pass
+                commit = (N_cap - nn) >= total_E
+                cap_ok = commit
+                reason = jnp.where((reason == RUNNING) & ~cap_ok,
+                                   R_NEXT_GROW, reason)
+                viol_any = jnp.asarray(False)
+                bag_err = jnp.asarray(False)
+                slot_err = jnp.asarray(False)
+                ovf_e = jnp.asarray(False)
+                ovf_i = jnp.asarray(False)
+
+                for aid, (name, fn, guard) in enumerate(
+                        zip(ACTION_NAMES, kern._action_fns(),
+                            kern._guard_fns())):
+                    L_a = kern._lane_count(name)
+                    TL = T * L_a
+                    lanes = jnp.arange(L_a, dtype=I32)
+                    E_a = caps[aid]
+
+                    # -- phase 1: cheap guard pass over every lane -----
+                    en = jax.vmap(lambda st: jax.vmap(
+                        lambda ln: guard(st, ln))(lanes))(tile)
+                    en = en & valid[:, None]
+                    en_any = en_any | en.any(axis=1)
+                    en_f = en.reshape(TL)
+                    n_en = en_f.sum()
+                    gen_local = gen_local + n_en
+                    ovf_a = n_en > E_a
+                    grow_aid = jnp.where(ovf_a & ~ovf_e, aid, grow_aid)
+                    ovf_e = ovf_e | ovf_a
+
+                    # -- phase 2: expand only the enabled lanes --------
+                    (sel,) = jnp.nonzero(en_f, size=E_a, fill_value=TL)
+                    sel_ok = sel < TL
+                    pidx = jnp.clip(sel // L_a, 0, T - 1).astype(I32)
+                    lane_sel = (sel % L_a).astype(I32)
+                    st_sel = {k: v[pidx] for k, v in tile.items()}
+
+                    if incremental:
+                        parts_sel = jax.tree_util.tree_map(
+                            lambda v: v[pidx], parts)
+
+                        def one(st, parts_one, lane, fn=fn, name=name):
+                            succ, en1 = fn(kern.seed_touch(st), lane)
                             ri = kern.lane_replica(name, st, lane)
                             fp = kern.fingerprint_incremental(
-                                succ, ri, parts, st)
-                        else:
-                            fp = kern.fingerprint(
-                                {k: v for k, v in succ.items()
-                                 if not k.startswith("_")})
-                        return fp, inv(succ), succ["err"], en
-                    outs.append(jax.vmap(lane_eval)(lanes))
-                return tuple(jnp.concatenate([o[i] for o in outs])
-                             for i in range(4))
-            fps, inv_ok, err, en = jax.vmap(per_state)(tile)
-            en = en & valid[:, None]
-            fps = fps.reshape(-1, 4)
-            en = en.reshape(-1)
-            viol = en & ~inv_ok.reshape(-1)
-            err = jnp.where(en, err.reshape(-1), 0)
-            err_bag = ((err & ERR_BAG_OVERFLOW) != 0).any()
-            err_slot = ((err & ~ERR_BAG_OVERFLOW) != 0).any()
-            perm, cand = dedup_batch(fps, en)
-            return (fps, perm, cand, en, viol.any(), jnp.argmax(viol),
-                    err_bag, err_slot)
+                                succ, ri, parts_one, st)
+                            clean = {k: v for k, v in succ.items()
+                                     if not k.startswith("_")}
+                            return clean, fp, en1, inv(clean), clean["err"]
+                        succ_f, fp, en2, iok, errv = jax.vmap(one)(
+                            st_sel, parts_sel, lane_sel)
+                    else:
+                        def one(st, lane, fn=fn):
+                            succ, en1 = fn(st, lane)
+                            return (succ, kern.fingerprint(succ), en1,
+                                    inv(succ), succ["err"])
+                        succ_f, fp, en2, iok, errv = jax.vmap(one)(
+                            st_sel, lane_sel)
 
-        def pack_fresh(fps, perm, fresh):
-            """order globally-fresh lane indices first for transfer."""
-            order = jnp.argsort(~fresh, stable=True)
-            sel = perm[order]
-            return fps[sel], sel, fresh.sum()
+                    en_s = en2 & sel_ok
+                    errv = jnp.where(en_s, errv, 0)
+                    viol_l = en_s & ~iok & (errv == 0)
+                    a_bag = ((errv & ERR_BAG_OVERFLOW) != 0).any()
+                    a_slot = ((errv & ~ERR_BAG_OVERFLOW) != 0).any()
+                    have_v = viol_l.any()
+                    vidx = jnp.argmax(viol_l)
+                    vinfo = jnp.stack([(base + pidx[vidx]).astype(I32),
+                                       jnp.asarray(aid, I32),
+                                       lane_sel[vidx]])
+                    viol = jnp.where(have_v & (viol[0] < 0), vinfo, viol)
+                    viol_any = viol_any | have_v
+                    bag_err = bag_err | a_bag
+                    slot_err = slot_err | a_slot
 
-        self._expand = jax.jit(expand_hash)
-        self._pack = jax.jit(pack_fresh)
-        self._mat = {}          # action id -> jitted vmapped action fn
+                    # -- phase 3: insert + scatter, consumed in place --
+                    commit_a = (commit & ~have_v & ~a_slot & ~a_bag
+                                & ~ovf_a)
+                    tbl, fresh, a_ovf_i = insert_core(
+                        {"slots": slots}, fp, en_s & commit_a)
+                    slots = tbl["slots"]
+                    dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1,
+                                     N_cap).astype(I32)
+                    for k in nb:
+                        nb[k] = nb[k].at[dest].set(succ_f[k], mode="drop")
+                    nbp = nbp.at[dest].set(base + pidx, mode="drop")
+                    nba = nba.at[dest].set(aid, mode="drop")
+                    nbprm = nbprm.at[dest].set(lane_sel, mode="drop")
+                    nfi = fresh.sum()
+                    nn = nn + nfi
+                    dist = dist + nfi
+                    ovf_i = ovf_i | a_ovf_i
+                    commit = commit_a & ~a_ovf_i
 
-    def _grow_msgs(self, store):
-        """Grow MAX_MSGS in place: all-zero padding slots change no
-        fingerprint (only present slots contribute to the bag hash), so
-        the FPSet and every registered state stay valid — pad the stored
-        chunks and rebuild the jitted passes.  Returns the pad function
-        for the caller's frontier/pending chunks."""
-        old = self.codec.shape.MAX_MSGS
-        new = old * 2
-        self._build(new)
+                # failure cause priority: violation > slot error > bag
+                # growth > expand-capacity > fpset growth (next-capacity
+                # was folded in up front)
+                new_reason = jnp.where(
+                    viol_any, R_VIOLATION,
+                    jnp.where(slot_err, R_SLOT_ERR,
+                              jnp.where(bag_err, R_BAG_GROW,
+                                        jnp.where(ovf_e, R_EXPAND_GROW,
+                                                  jnp.where(ovf_i,
+                                                            R_FPSET_GROW,
+                                                            RUNNING)))))
+                reason = jnp.where(reason == RUNNING, new_reason, reason)
 
-        def pad(d):
-            out = dict(d)
-            for k in ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
-                      "m_log_len", "m_has_log"):
-                v = d[k]
-                shape = list(v.shape)
-                shape[1] = new - old
-                out[k] = np.concatenate(
-                    [v, np.zeros(shape, v.dtype)], axis=1)
-            return out
-        store.chunks = [pad(c) for c in store.chunks]
-        return pad
+                dead = valid & ~en_any
+                dl = want_deadlock & commit & dead.any()
+                reason = jnp.where(dl & (reason == RUNNING),
+                                   R_DEADLOCK, reason)
+                dead_i = jnp.where(dl, base + jnp.argmax(dead), c["dead"])
+                return {
+                    "t": jnp.where(commit & (reason == RUNNING),
+                                   t + 1, t),
+                    "reason": reason, "viol": viol, "dead": dead_i,
+                    "grow_aid": grow_aid,
+                    "slots": slots,
+                    "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
+                    "nn": nn, "dist": dist,
+                    "gen": c["gen"] + jnp.where(commit, gen_local, 0),
+                }
+
+            init = {
+                "t": jnp.asarray(start_t, I32),
+                "reason": jnp.asarray(RUNNING, I32),
+                "viol": jnp.full((3,), -1, I32),
+                "dead": jnp.asarray(-1, I32),
+                "grow_aid": jnp.asarray(-1, I32),
+                "slots": slots,
+                "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
+                "nn": jnp.asarray(n_next0, I32),
+                "dist": jnp.asarray(0, I32),
+                "gen": jnp.asarray(0, I32),
+            }
+            return jax.lax.while_loop(cond, body, init)
+
+        return level
 
     # ------------------------------------------------------------------
+    # growth handlers
+    # ------------------------------------------------------------------
+    def _grow_msgs(self, device_states):
+        """Double MAX_MSGS in place: all-zero padding slots change no
+        fingerprint (only present slots contribute to the bag hash), so
+        the FPSet and every recorded trace pointer stay valid.  Pads the
+        given on-device state pytrees and rebuilds the jitted passes."""
+        old = self.codec.shape.MAX_MSGS
+        self._build(old * 2)
+        return [self.codec.pad_msgs(d, old) for d in device_states]
+
+    @staticmethod
+    def _grow_next(bufs, factor=4):
+        """Enlarge the next-frontier buffer set, preserving contents."""
+        nb, nbp, nba, nbprm = bufs
+        cap = nbp.shape[0]
+        new = cap * factor
+
+        def padv(v):
+            shape = (new - cap,) + v.shape[1:]
+            return jnp.concatenate([v, jnp.zeros(shape, v.dtype)])
+        return ({k: padv(v) for k, v in nb.items()},
+                padv(nbp), padv(nba), padv(nbprm))
+
+    # ------------------------------------------------------------------
+    def _alloc_bufs(self, cap):
+        zero = self.codec.zero_state()
+        nb = {k: jnp.zeros((cap,) + np.shape(v), np.int32)
+              for k, v in zero.items()}
+        return (nb, jnp.zeros((cap,), I32), jnp.zeros((cap,), I32),
+                jnp.zeros((cap,), I32))
+
     def run(self, max_states=None, max_depth=None, max_seconds=None,
             check_deadlock=False, log=None,
             progress_every=10.0) -> CheckResult:
-        spec, codec, kern = self.spec, self.codec, self.kern
+        spec, codec = self.spec, self.codec  # codec only for init encode
         res = CheckResult()
         t0 = time.time()
-        store = _StateStore()
         fp_cap = self.fpset_capacity
         table = empty_table(fp_cap)
-        fp_count = 0
 
         def emit(msg):
             if log:
                 log(msg)
 
         # --- register init states (host path, tiny) -------------------
-        init_dense = [codec.encode(st) for st in spec.init_states()]
+        init_states = list(spec.init_states())
+        init_dense = [codec.encode(st) for st in init_states]
         init_batch = {k: np.stack([d[k] for d in init_dense])
                       for k in init_dense[0]}
-        fps = np.asarray(kern.fingerprint_batch(init_batch))
+        fps = np.asarray(self.kern.fingerprint_batch(init_batch))
         keep, seen = [], set()
         for i in range(len(init_dense)):
             key = tuple(fps[i])
@@ -205,226 +378,207 @@ class DeviceBFS:
                 seen.add(key)
                 keep.append(i)
         init_batch = {k: v[keep] for k, v in init_batch.items()}
-        table, fresh, _ = insert_batch(
-            table, jnp.asarray(fps[keep]),
-            jnp.ones((len(keep),), bool))
-        fp_count += len(keep)
-        store.append(init_batch, [None] * len(keep), [None] * len(keep))
-        for i in range(len(keep)):
-            bad = self._check_invariants_host(init_batch, i)
+        self._init_states = [init_states[i] for i in keep]
+        n0 = len(keep)
+        table, _, _ = insert_batch(
+            table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+        fp_count = n0
+        # host trace store: gid -> (parent gid, action, param)
+        self._h_parent = [np.full(n0, -1, np.int64)]
+        self._h_action = [np.full(n0, -1, np.int32)]
+        self._h_param = [np.zeros(n0, np.int32)]
+        for i in range(n0):
+            bad = spec.check_invariants(self._init_states[i])
             if bad:
                 res.ok = False
                 res.violated_invariant = bad
-                res.trace = self._trace(store, i)
-                return self._finish(res, store, t0, 0)
+                res.trace = self._trace(i)
+                return self._finish(res, t0, 0, fp_count)
         res.states_generated += len(init_dense)
-        frontier = init_batch
-        level_base = 0
+
+        # --- device frontier + next buffers ---------------------------
+        f_cap = max(self.next_cap, n0)
+        front, fpar, fact, fprm = self._alloc_bufs(f_cap)
+        front = {k: front[k].at[:n0].set(init_batch[k]) for k in front}
+        bufs = self._alloc_bufs(self.next_cap)
+        n_front = n0
+        level_base = 0          # gid of frontier[0]
         depth = 0
         last_progress = t0
+        self.level_sizes = [n0]
 
-        self.level_sizes = [len(frontier["status"])]
-        while len(frontier["status"]) > 0:
+        while n_front > 0:
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
                 break
             depth += 1
-            n_front = len(frontier["status"])
-            fresh_chunks, fresh_parents, fresh_actions = [], [], []
-            off = 0
-            while off < n_front:
-                tile = {k: v[off:off + self.tile]
-                        for k, v in frontier.items()}
-                n_valid = len(tile["status"])
-                if n_valid < self.tile:
-                    npad = self.tile - n_valid
-                    tile = {k: np.concatenate(
-                        [v, np.repeat(v[:1], npad, axis=0)])
-                        for k, v in tile.items()}
-                valid = np.arange(self.tile) < n_valid
+            start_t = 0
+            n_next = 0
+            n_tiles = (n_front + self.tile - 1) // self.tile
+            stop = None
+            while start_t < n_tiles:
+                nb, nbp, nba, nbprm = bufs
+                out = self._level(
+                    table["slots"], front,
+                    jnp.asarray(n_front, I32), jnp.asarray(start_t, I32),
+                    nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
+                    jnp.asarray(bool(check_deadlock)))
+                table = {"slots": out["slots"]}
+                bufs = (out["nb"], out["nbp"], out["nba"], out["nbprm"])
+                reason, start_t, n_next, gen_add, dist_add = (
+                    int(out["reason"]), int(out["t"]), int(out["nn"]),
+                    int(out["gen"]), int(out["dist"]))
+                res.states_generated += gen_add
+                fp_count += dist_add
 
-                tile_j = {k: jnp.asarray(v) for k, v in tile.items()}
-                (fps, perm, cand, en_flat, has_viol, viol_idx, err_bag,
-                 err_slot) = self._expand(tile_j, jnp.asarray(valid))
-                en_np = np.asarray(en_flat).reshape(self.tile, self.L)
-
-                if bool(err_slot):
+                if reason == RUNNING:
+                    pass
+                elif reason == R_VIOLATION:
+                    vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
+                    gid = level_base + vp
+                    parent_dense = self._fetch_row(front, vp)
+                    vstate = self._materialize_one(parent_dense, va, vprm)
+                    bad = spec.check_invariants(
+                        self.codec.decode(vstate))
+                    res.ok = False
+                    res.violated_invariant = bad or self.inv_names[0]
+                    res.trace = self._trace(gid, extra=(va, vprm))
+                    res.diameter = depth
+                    return self._finish(res, t0, depth, fp_count)
+                elif reason == R_BAG_GROW:
+                    front, nb = self._grow_msgs([front, bufs[0]])
+                    bufs = (nb,) + bufs[1:]
+                    emit(f"message table grown to "
+                         f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
+                elif reason == R_FPSET_GROW:
+                    table = grow(table)
+                    fp_cap *= 4
+                    emit(f"FPSet grown to {fp_cap} slots")
+                elif reason == R_NEXT_GROW:
+                    bufs = self._grow_next(bufs)
+                    emit(f"next-frontier buffer grown to "
+                         f"{bufs[1].shape[0]}")
+                elif reason == R_EXPAND_GROW:
+                    aid = int(out["grow_aid"])
+                    self.expand_mults[aid] *= 2
+                    self._level = jax.jit(self._make_level(),
+                                          donate_argnums=(0, 4, 5, 6, 7))
+                    emit(f"expand buffer for {ACTION_NAMES[aid]} grown "
+                         f"to tile x {self.expand_mults[aid]} "
+                         f"(recompiling)")
+                elif reason == R_SLOT_ERR:
                     raise TLAError(
                         "dense-layout slot collision (a second DVC or "
                         "recovery response from one source in one view): "
                         "this restart-era interleaving needs the "
                         "multi-slot layout (vsr.py docstring)")
-                if bool(err_bag):
-                    # message table too small for some successor in this
-                    # tile: grow in place and re-run the SAME tile (no
-                    # inserts happened yet for it)
-                    padf = self._grow_msgs(store)
-                    frontier = padf(frontier)
-                    fresh_chunks = [padf(c) for c in fresh_chunks]
-                    kern = self.kern      # _build replaced kernel+codec:
-                    codec = self.codec    # lane tables/L are all new
-                    emit(f"message table grown to "
-                         f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
-                    continue
-                if check_deadlock:
-                    dead = valid & ~en_np.any(axis=1)
-                    if dead.any():
-                        gid = level_base + off + int(np.argmax(dead))
-                        res.ok = False
-                        res.error = "deadlock"
-                        res.deadlock_state = self.codec.decode(store.get(gid))
-                        res.trace = self._trace(store, gid)
-                        res.diameter = depth
-                        return self._finish(res, store, t0, depth)
-                res.states_generated += int(en_np.sum())
-
-                if bool(has_viol):
-                    # a generated state violates an invariant: name it
-                    # on host and reconstruct the trace
-                    vi = int(viol_idx)
-                    vstate = {k: v[0] for k, v in self._materialize(
-                        tile, np.asarray([vi])).items()}
-                    parent_gid = level_base + off + vi // self.L
-                    lane = vi % self.L
-                    bad = self._check_invariants_host(
-                        {k: v[None] for k, v in vstate.items()}, 0)
+                elif reason == R_DEADLOCK:
+                    di = int(out["dead"])
+                    gid = level_base + di
                     res.ok = False
-                    res.violated_invariant = bad or self.inv_names[0]
-                    res.trace = self._trace(
-                        store, parent_gid,
-                        extra=(vstate, int(kern.lane_action[lane])))
+                    res.error = "deadlock"
+                    res.deadlock_state = self.codec.decode(
+                        self._fetch_row(front, di))
+                    res.trace = self._trace(gid)
                     res.diameter = depth
-                    return self._finish(res, store, t0, depth)
+                    return self._finish(res, t0, depth, fp_count)
 
-                fps_sorted = fps[perm]
-                while True:
-                    table, fresh, ovf = insert_batch(table, fps_sorted, cand)
-                    pfps, sel, n_fresh = self._pack(fps, perm, fresh)
-                    n = int(n_fresh)
-                    if n:
-                        fp_count += n
-                        sel_np = np.asarray(sel[:n])
-                        fresh_chunks.append(
-                            self._materialize(tile, sel_np))
-                        fresh_parents.append(
-                            level_base + off + sel_np // self.L)
-                        fresh_actions.append(
-                            kern.lane_action[sel_np % self.L])
-                    if bool(ovf) or fp_count > 0.6 * fp_cap:
-                        # probe overflow dropped unresolved lanes from
-                        # the insert: grow the table and re-insert —
-                        # already-inserted fingerprints come back as
-                        # duplicates, previously unresolved ones as fresh
-                        table = grow(table)
-                        fp_cap *= 4
-                        if bool(ovf):
-                            continue
-                    break
-
-                off += self.tile
                 now = time.time()
                 if now - last_progress >= progress_every:
                     last_progress = now
-                    emit(f"depth {depth}: {len(store)} distinct, "
+                    emit(f"depth {depth}: {fp_count} distinct, "
                          f"{res.states_generated} generated, "
-                         f"{res.states_generated / (now - t0):.0f} states/s")
+                         f"{res.states_generated / (now - t0):.0f} gen/s, "
+                         f"{fp_count / (now - t0):.0f} distinct/s")
+                if max_seconds and now - t0 > max_seconds:
+                    stop = f"time budget {max_seconds}s reached"
+                    break
 
-            if not fresh_chunks:
+            # ---- level complete: pull trace pointers, swap buffers ---
+            nb, nbp, nba, nbprm = bufs
+            if n_next:
+                par, act, prm = jax.device_get(
+                    (nbp[:n_next], nba[:n_next], nbprm[:n_next]))
+                self._h_parent.append(np.asarray(par, np.int64) + level_base)
+                self._h_action.append(np.asarray(act, np.int32))
+                self._h_param.append(np.asarray(prm, np.int32))
+                self.level_sizes.append(n_next)
+            level_base += n_front
+            # the old frontier set becomes the next scratch buffer set
+            front, bufs = nb, (front, fpar, fact, fprm)
+            fpar, fact, fprm = nbp, nba, nbprm
+            n_front = n_next
+            if stop:
+                res.error = stop
                 break
-            nxt = {k: np.concatenate([c[k] for c in fresh_chunks])
-                   for k in fresh_chunks[0]}
-            parents = np.concatenate(fresh_parents)
-            actions = np.concatenate(fresh_actions)
-            level_base = store.append(nxt, parents.tolist(), actions.tolist())
-            level_base -= len(parents)
-            frontier = nxt
-            self.level_sizes.append(len(parents))
-            if max_states and len(store) >= max_states:
+            if n_next == 0:
+                break
+            if max_states and fp_count >= max_states:
                 res.error = f"state limit {max_states} reached"
                 break
-            if max_seconds and time.time() - t0 > max_seconds:
-                res.error = f"time budget {max_seconds}s reached"
-                break
+            # proactive FPSet growth between levels keeps probe chains
+            # short and the in-level overflow pause rare
+            if fp_count > 0.5 * fp_cap:
+                table = grow(table)
+                fp_cap *= 4
+                emit(f"FPSet grown to {fp_cap} slots")
 
         res.diameter = depth
-        return self._finish(res, store, t0, depth)
+        return self._finish(res, t0, depth, fp_count)
 
     # ------------------------------------------------------------------
-    def _materialize(self, tile, flat_idx):
-        """Re-run only the surviving lanes to produce their successor
-        states: group by action, pad each group to a power of two (few
-        compiled variants), and vmap the single action function."""
-        kern = self.kern
-        flat_idx = np.asarray(flat_idx)
-        parent_local = flat_idx // self.L
-        lane = flat_idx % self.L
-        aids = kern.lane_action[lane]
-        params = kern.lane_param[lane]
-        n = len(flat_idx)
-        out = {}
-        order = np.argsort(aids, kind="stable")
-        pos = 0
-        chunks, backperm = [], np.empty(n, np.int64)
-        for aid in np.unique(aids):
-            sel = order[aids[order] == aid]
-            cap = max(8, 1 << int(np.ceil(np.log2(len(sel)))))
-            pad = cap - len(sel)
-            gi = np.concatenate([parent_local[sel],
-                                 np.zeros(pad, np.int64)])
-            gp = np.concatenate([params[sel], np.zeros(pad, np.int32)])
-            states = {k: v[gi] for k, v in tile.items()}
-            fn = self._mat.get(int(aid))
-            if fn is None:
-                fn = jax.jit(jax.vmap(kern._action_fns()[int(aid)],
-                                      in_axes=(0, 0)))
-                self._mat[int(aid)] = fn
-            succ, _en = fn(states, jnp.asarray(gp))
-            chunk = {k: np.asarray(v[:len(sel)]) for k, v in succ.items()
-                     if not k.startswith("_")}
-            chunks.append(chunk)
-            backperm[sel] = np.arange(pos, pos + len(sel))
-            pos += len(sel)
-        cat = {k: np.concatenate([c[k] for c in chunks])
-               for k in chunks[0]}
-        # row i of the result is the successor for flat_idx[i]
-        return {k: v[backperm] for k, v in cat.items()}
+    def _fetch_row(self, batch, i):
+        return {k: np.asarray(v[i]) for k, v in batch.items()}
 
-    def _finish(self, res, store, t0, depth):
-        res.distinct_states = len(store)
+    def _materialize_one(self, st, aid, param):
+        """Apply one recorded (action, lane param) to a single dense
+        state — the trace-replay step."""
+        fn = self._mat.get(aid)
+        if fn is None:
+            fn = jax.jit(jax.vmap(self.kern._action_fns()[aid],
+                                  in_axes=(0, 0)))
+            self._mat[aid] = fn
+        batch = {k: np.asarray(v)[None] for k, v in st.items()}
+        succ, en = fn(batch, jnp.asarray([param], jnp.int32))
+        assert bool(np.asarray(en)[0]), "trace replay chose a disabled lane"
+        return {k: np.asarray(v)[0] for k, v in succ.items()
+                if not k.startswith("_")}
+
+    def _finish(self, res, t0, depth, fp_count):
+        res.distinct_states = fp_count
         res.elapsed = time.time() - t0
         return res
 
-    def _check_invariants_host(self, batch, i):
-        """Name the violated invariant for one dense state (decode +
-        interpreter evaluation; only used on the violation path)."""
-        st = self.codec.decode({k: v[i] for k, v in batch.items()})
-        return self.spec.check_invariants(st)
-
-    def _trace(self, store, gid, extra=None):
-        """Walk parent pointers to the init state, decode, and emit
-        TRACE-format entries (action name + source location)."""
-        loc = {a.name: a.location for a in self.spec.actions}
-        chain = []
+    def _trace(self, gid, extra=None):
+        """Walk the host pointer table back to an init state, then
+        replay the recorded (action, param) chain through the kernel to
+        materialize each state, emitting TRACE-format entries."""
+        parent = np.concatenate(self._h_parent)
+        action = np.concatenate(self._h_action)
+        param = np.concatenate(self._h_param)
+        steps = []
         cur = gid
-        while cur is not None:
-            parent, aid = store.parents[cur]
-            chain.append((store.get(cur), aid))
-            cur = parent
-        chain.reverse()
+        while action[cur] >= 0:
+            steps.append((int(action[cur]), int(param[cur])))
+            cur = int(parent[cur])
+        steps.reverse()
         if extra is not None:
-            vstate, aid = extra
-            chain.append((vstate, aid))
-        out = []
-        for pos, (dense, aid) in enumerate(chain):
-            name = ACTION_NAMES[aid] if aid is not None else None
-            out.append(TraceEntry(
-                position=pos + 1, action_name=name,
-                location=loc.get(name), state=self.codec.decode(dense)))
+            steps.append(extra)
+        loc = {a.name: a.location for a in self.spec.actions}
+        st = self.codec.encode(self._init_states[cur])
+        out = [TraceEntry(position=1, action_name=None, location=None,
+                          state=self.codec.decode(st))]
+        for pos, (aid, prm) in enumerate(steps):
+            st = self._materialize_one(st, aid, prm)
+            name = ACTION_NAMES[aid]
+            out.append(TraceEntry(position=pos + 2, action_name=name,
+                                  location=loc.get(name),
+                                  state=self.codec.decode(st)))
         return out
 
 
 def device_bfs_check(spec: SpecModel, max_states=None, max_depth=None,
-                     check_deadlock=False, tile_size=32, max_msgs=None,
+                     check_deadlock=False, tile_size=128, max_msgs=None,
                      log=None) -> CheckResult:
     """Run the device BFS (message-table growth happens in place)."""
     eng = DeviceBFS(spec, max_msgs=max_msgs, tile_size=tile_size)

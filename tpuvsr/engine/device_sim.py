@@ -1,5 +1,6 @@
 """Device simulation mode: vectorized random walks (TLC's simulator,
-README:22, rebuilt as a vmapped XLA program; BASELINE.json configs[2]).
+README:22, rebuilt as a scan-based XLA program; BASELINE.json
+configs[2]).
 
 Semantics match TLC's SimulationWorker: each walk starts at the initial
 state and repeatedly jumps to a successor chosen uniformly at random
@@ -8,14 +9,24 @@ kernel's lane space — checking invariants at every visited state, up to
 a depth bound.  A walker with no enabled successor stays put (TLC ends
 the walk; with -deadlock it is reported).
 
-W walkers advance in lockstep inside one jitted step: expand all lanes,
-draw an argmax-of-masked-uniforms lane (uniform over enabled lanes),
-gather the chosen successor, and evaluate the invariants.  Per-walker
-histories are kept host-side as (action id, lane param) pairs — stable
-across message-table growth — so a violating walk replays through the
-materialize kernels into a full TRACE-format counterexample.  On bag
-overflow the message table grows in place (zero padding changes no
-state content) and the erroring step is redrawn.
+TPU structure (one host sync per CHUNK of steps, not per step):
+
+* enabledness comes from the cheap guard pass over all lanes
+  (vsr_kernel guard fns) — successors are never materialized for the
+  draw;
+* the drawn lane is applied with ``lax.switch`` over the 19 action
+  bodies, one successor per walker;
+* ``lax.scan`` advances all W walkers CHUNK steps inside one jit,
+  recording (action id, lane param) histories as scan outputs that stay
+  on device unless a violation needs replaying;
+* on bag overflow the message table grows in place (zero padding
+  changes no state content) and the chunk is re-run from its saved
+  entry states — the walk segment is simply redrawn under the larger
+  layout.
+
+A violating walk replays its recorded (action, param) chain through the
+materialize kernel into a full TRACE-format counterexample
+(state_transfer_violation_trace.txt:3-7 format).
 """
 
 from __future__ import annotations
@@ -34,14 +45,15 @@ from .simulate import SimResult
 from .spec import SpecModel
 from .trace import TraceEntry
 
-_MSG_KEYS = ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
-             "m_log_len", "m_has_log")
+I32 = jnp.int32
 
 
 class DeviceSimulator:
-    def __init__(self, spec: SpecModel, max_msgs=None, walkers=256):
+    def __init__(self, spec: SpecModel, max_msgs=None, walkers=256,
+                 chunk_steps=32):
         self.spec = spec
         self.W = walkers
+        self.chunk = chunk_steps
         self.inv_names = list(spec.cfg.invariants)
         self._build(max_msgs)
 
@@ -52,38 +64,69 @@ class DeviceSimulator:
                               perms=_value_perm_table(spec, self.codec))
         inv = self.kern.invariant_fn(self.inv_names)
         kern = self.kern
+        lane_aid = jnp.asarray(kern.lane_action)
+        lane_prm = jnp.asarray(kern.lane_param)
+        guards = kern._guard_fns()
+        fns = kern._action_fns()
 
-        def step(states, keys):
-            def one(st, key):
-                succs, en = kern.step_all(st)
+        def guard_all(st):
+            outs = []
+            for name, g in zip(ACTION_NAMES, guards):
+                lanes = jnp.arange(kern._lane_count(name), dtype=I32)
+                outs.append(jax.vmap(lambda ln, g=g: g(st, ln))(lanes))
+            return jnp.concatenate(outs)
+
+        branches = [lambda st, p, f=f: f(st, p)[0] for f in fns]
+
+        def apply_lane(st, aid, prm):
+            return jax.lax.switch(aid, branches, st, prm)
+
+        def chunk_fn(states, was_alive, keys):
+            def step(carry, key):
+                states, was_alive, bad, dead, err_any, steps, d = carry
+                en = jax.vmap(guard_all)(states)          # [W, L]
                 u = jax.random.uniform(key, en.shape)
-                lane = jnp.argmax(jnp.where(en, u, -1.0))
-                alive = en.any()
-                succ = {k: jnp.where(alive, v[lane], st[k])
-                        for k, v in succs.items()}
-                bad = alive & ~inv(succ)
+                lane = jnp.argmax(jnp.where(en, u, -1.0), axis=1)
+                alive = en.any(axis=1)
+                aid = lane_aid[lane]
+                prm = lane_prm[lane]
+                succ = jax.vmap(apply_lane)(states, aid, prm)
+                sel = {k: alive.reshape((-1,) + (1,) * (v.ndim - 1))
+                       for k, v in states.items()}
+                states = {k: jnp.where(sel[k], succ[k], v)
+                          for k, v in states.items()}
                 err = alive & (succ["err"] != 0)
-                return succ, lane, alive, bad, err
-            return jax.vmap(one)(states, keys)
+                iok = jax.vmap(inv)(succ)
+                badw = alive & ~iok & ~err
+                hit = badw.any() & (bad[0] < 0)
+                bad = jnp.where(hit, jnp.stack(
+                    [jnp.argmax(badw).astype(I32), d]), bad)
+                dw = was_alive & ~alive
+                hitd = dw.any() & (dead[0] < 0)
+                dead = jnp.where(hitd, jnp.stack(
+                    [jnp.argmax(dw).astype(I32), d]), dead)
+                err_any = err_any | err.any()
+                steps = steps + alive.sum()
+                hist = (jnp.where(alive, aid, -1).astype(I32),
+                        jnp.where(alive, prm, 0).astype(I32))
+                return (states, alive, bad, dead, err_any, steps,
+                        d + 1), hist
 
-        self._step = jax.jit(step)
+            init = (states, was_alive, jnp.full((2,), -1, I32),
+                    jnp.full((2,), -1, I32), jnp.asarray(False),
+                    jnp.asarray(0, I32), jnp.asarray(0, I32))
+            (states, alive, bad, dead, err_any, steps, _d), hist = \
+                jax.lax.scan(step, init, keys)
+            return states, alive, bad, dead, err_any, steps, hist
+
+        self._chunk = jax.jit(chunk_fn)
         self._mat = {}
 
     def _grow_msgs(self, batches):
         """Double MAX_MSGS and pad the given dense batches."""
         old = self.codec.shape.MAX_MSGS
         self._build(old * 2)
-
-        def pad(d):
-            out = dict(d)
-            for k in _MSG_KEYS:
-                v = np.asarray(d[k])
-                shape = list(v.shape)
-                shape[1] = old
-                out[k] = np.concatenate(
-                    [v, np.zeros(shape, v.dtype)], axis=1)
-            return out
-        return [pad(b) for b in batches]
+        return [self.codec.pad_msgs(b, old) for b in batches]
 
     def _materialize_one(self, st, aid, param):
         fn = self._mat.get(aid)
@@ -99,7 +142,8 @@ class DeviceSimulator:
 
     def run(self, num=1000, depth=100, seed=0, check_deadlock=False,
             log=None, max_seconds=None) -> SimResult:
-        """Run `num` walks of `depth` steps (W at a time)."""
+        """Run `num` walks of `depth` steps (W at a time, `chunk` steps
+        per device sync)."""
         spec, codec = self.spec, self.codec
         res = SimResult()
         t0 = time.time()
@@ -114,55 +158,56 @@ class DeviceSimulator:
             res.elapsed = time.time() - t0
             return res
         key = jax.random.PRNGKey(seed)
+        init = {k: jnp.asarray(v) for k, v in init.items()}
         stop = False
         while res.walks < num and not stop:
-            states = {k: np.asarray(v) for k, v in init.items()}
-            hist_aid = np.full((self.W, depth), -1, np.int32)
-            hist_par = np.zeros((self.W, depth), np.int32)
-            was_alive = np.ones((self.W,), bool)
-            for d in range(depth):
+            states = init
+            was_alive = jnp.ones((self.W,), bool)
+            hists = []          # [(ha [k, W], hp [k, W])] device arrays
+            d = 0
+            while d < depth:
+                k = min(self.chunk, depth - d)
                 key, sub = jax.random.split(key)
-                keys = jax.random.split(sub, self.W)
+                keys = jax.random.split(sub, k)
                 while True:
-                    out = self._step(
-                        {k: jnp.asarray(v) for k, v in states.items()},
-                        keys)
-                    nstates, lanes, alive, bad, err = out
-                    if np.asarray(err).any():
-                        # bag overflow in some successor: grow the table,
-                        # pad walker states, and redraw this step
+                    (nstates, alive, bad, dead, err_any, steps,
+                     hist) = self._chunk(states, was_alive, keys)
+                    if bool(err_any):
+                        # bag overflow inside the chunk: grow the table,
+                        # pad saved entry states, redraw the chunk
                         init, states = self._grow_msgs([init, states])
                         if log:
                             log(f"message table grown to "
                                 f"{self.codec.shape.MAX_MSGS} slots")
                         continue
                     break
-                lanes = np.asarray(lanes)
-                alive_np = np.asarray(alive)
-                hist_aid[:, d] = np.where(
-                    alive_np, self.kern.lane_action[lanes], -1)
-                hist_par[:, d] = np.where(
-                    alive_np, self.kern.lane_param[lanes], 0)
-                states = {k: np.asarray(v) for k, v in nstates.items()}
-                res.steps += int(alive_np.sum())
-                if check_deadlock and (was_alive & ~alive_np).any():
-                    w = int(np.argmax(was_alive & ~alive_np))
+                hists.append(hist)
+                res.steps += int(steps)
+                bad = np.asarray(bad)
+                dead = np.asarray(dead)
+                # report whichever event happened at the earlier step of
+                # the chunk; within one step deadlocks are checked first
+                # (matching the per-step engine semantics)
+                dead_first = (check_deadlock and dead[0] >= 0
+                              and (bad[0] < 0 or dead[1] <= bad[1]))
+                if dead_first:
+                    w, ds = int(dead[0]), int(dead[1])
                     res.ok = False
                     res.deadlocks += 1
-                    res.trace = self._replay(init, hist_aid[w], hist_par[w])
+                    res.trace = self._replay(init, hists, w, d + ds)
                     res.violated_invariant = None
                     res.elapsed = time.time() - t0
                     return res
-                was_alive = alive_np
-                bad_np = np.asarray(bad)
-                if bad_np.any():
-                    w = int(np.argmax(bad_np))
+                if bad[0] >= 0:
+                    w, ds = int(bad[0]), int(bad[1])
                     res.ok = False
-                    res.trace = self._replay(init, hist_aid[w], hist_par[w])
-                    res.violated_invariant = self.spec.check_invariants(
+                    res.trace = self._replay(init, hists, w, d + ds + 1)
+                    res.violated_invariant = spec.check_invariants(
                         res.trace[-1].state) or self.inv_names[0]
                     res.elapsed = time.time() - t0
                     return res
+                states, was_alive = nstates, alive
+                d += k
                 if max_seconds and time.time() - t0 > max_seconds:
                     stop = True
                     break
@@ -173,16 +218,19 @@ class DeviceSimulator:
         res.elapsed = time.time() - t0
         return res
 
-    def _replay(self, init, aids, params):
-        """Re-execute one walk's (action, param) choices into a trace."""
-        st = {k: np.asarray(v[0]) for k, v in init.items()}
+    def _replay(self, init, hists, w, n_steps):
+        """Re-execute walker `w`'s first `n_steps` recorded choices into
+        a TRACE-format counterexample."""
+        aids = np.concatenate([np.asarray(ha)[:, w] for ha, _hp in hists])
+        prms = np.concatenate([np.asarray(hp)[:, w] for _ha, hp in hists])
+        st = {k: np.asarray(v[w]) for k, v in init.items()}
         loc = {a.name: a.location for a in self.spec.actions}
         out = [TraceEntry(position=1, action_name=None, location=None,
                           state=self.codec.decode(st))]
-        for i in range(len(aids)):
+        for i in range(min(n_steps, len(aids))):
             if aids[i] < 0:
                 break
-            st = self._materialize_one(st, int(aids[i]), int(params[i]))
+            st = self._materialize_one(st, int(aids[i]), int(prms[i]))
             name = ACTION_NAMES[aids[i]]
             out.append(TraceEntry(position=i + 2, action_name=name,
                                   location=loc.get(name),
@@ -192,8 +240,9 @@ class DeviceSimulator:
 
 def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
                     walkers=256, max_msgs=None, check_deadlock=False,
-                    log=None, max_seconds=None) -> SimResult:
-    sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers)
+                    log=None, max_seconds=None, chunk_steps=32) -> SimResult:
+    sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers,
+                          chunk_steps=chunk_steps)
     return sim.run(num=num, depth=depth, seed=seed,
                    check_deadlock=check_deadlock, log=log,
                    max_seconds=max_seconds)

@@ -5,24 +5,29 @@ The reference workload drove TLC's disk-spilling FPSet to 500 GB
 HBM-resident open-addressing hash table and batch-inserts an entire
 frontier expansion per call (SURVEY.md §2.5).
 
-Layout: a claim array ``tags[CAP]`` holding word 0 of each fingerprint
-(0 = empty; fingerprints with word 0 == 0 are remapped to 1) and a
-payload array ``rows[CAP, 3]`` holding words 1..3.  Insertion is
-claim-then-verify linear probing, fully vectorized over the batch:
+Layout: one ``slots[CAP, 5]`` uint32 array per table; columns are
+(tag, row0, row1, row2, claim) where tag is word 0 of the fingerprint
+(0 = empty slot; fingerprints with word 0 == 0 are remapped to 1),
+row0..2 are words 1..3, and claim transiently holds the batch lane id
+that claimed the slot.  Insertion is claim-then-verify linear probing,
+fully vectorized over the batch:
 
-  1. gather the tag at each lane's probe slot;
-  2. lanes seeing their own tag compare the payload — equal means
-     duplicate (resolved, not fresh);
-  3. lanes seeing empty scatter-claim the tag and payload, then re-read;
-     a lane that reads back its own tag AND payload won (resolved,
-     fresh) — losers and tag-collision victims probe the next slot.
+  1. gather each lane's probe slot;
+  2. lanes seeing their own (tag, row) are duplicates (resolved);
+  3. lanes seeing empty scatter their full (tag, row, lane-id) payload
+     in ONE scatter, then re-read; the lane that reads back its own
+     payload — including the lane id — won (resolved, fresh); losers
+     probe on.
 
-Batches must be intra-batch deduplicated first (two lanes carrying the
-same fingerprint would both win), which `dedup_batch` does with a
-lexicographic sort.  Like TLC's 64-bit fingerprinting, set membership is
-probabilistic: a 128-bit collision (or a same-slot claim-tag collision
-at ~2^-32 per probing pair, which can ghost one entry) silently merges
-two states; both are vanishingly unlikely at reachable-set sizes.
+Because the claim column disambiguates same-fingerprint writers within
+one scatter, batches may contain duplicate fingerprints: exactly one
+lane per distinct new fingerprint resolves fresh, and its duplicates
+resolve as duplicates on the next probe iteration.  (This is what lets
+the BFS level kernel skip sort-based intra-batch dedup entirely.)
+
+Like TLC's 64-bit fingerprinting, set membership is probabilistic: a
+128-bit collision silently merges two states — vanishingly unlikely at
+reachable-set sizes.
 """
 
 from __future__ import annotations
@@ -41,8 +46,7 @@ MAX_PROBES = 64
 def empty_table(capacity: int):
     """capacity must be a power of two."""
     assert capacity & (capacity - 1) == 0
-    return {"tags": jnp.zeros((capacity,), U32),
-            "rows": jnp.zeros((capacity, 3), U32)}
+    return {"slots": jnp.zeros((capacity, 5), U32)}
 
 
 def _slot_hash(fps):
@@ -59,7 +63,9 @@ def dedup_batch(fps, mask):
 
     Returns (perm, keep): `perm` sorts the batch so equal fingerprints
     are adjacent (masked-out lanes sort to the end), `keep[i]` marks
-    lanes of fps[perm] that are valid first occurrences.
+    lanes of fps[perm] that are valid first occurrences.  (The BFS
+    engine no longer needs this — insert_core tolerates duplicates —
+    but the sharded exchange uses it to shrink traffic.)
     """
     key = [jnp.where(mask, fps[:, i], jnp.uint32(0xFFFFFFFF))
            for i in range(4)]
@@ -71,56 +77,103 @@ def dedup_batch(fps, mask):
     return perm, first & smask
 
 
-def insert_core(table, fps, mask):
-    """Insert fps[mask] into the table; fps must be intra-batch unique
-    among masked lanes.  Returns (table, fresh, overflow) where fresh
-    marks lanes whose fingerprint was not previously in the table.
-    Plain traceable function — compose inside a jit (insert_batch is the
-    standalone jitted form)."""
-    cap = table["tags"].shape[0]
-    capm = jnp.uint32(cap - 1)
+def _keyed(fps):
+    """Canonical (tag, row) encoding: word 0 remapped 0 -> 1 so 0 can
+    mark empty slots; the probe chain hashes the canonical key so a
+    table rebuilt by grow() probes identically to future lookups."""
     tag = jnp.where(fps[:, 0] == 0, jnp.uint32(1), fps[:, 0])
-    row = fps[:, 1:]
-    # probe chain is derived from the *canonical* key (word 0 after the
-    # 0->1 claim remap) so a table rebuilt by grow() from stored
-    # (tag, row) pairs probes identically to future lookups
-    h0 = _slot_hash(jnp.concatenate([tag[:, None], row], axis=1))
+    keyed = jnp.concatenate([tag[:, None], fps[:, 1:]], axis=1)
+    return keyed, _slot_hash(keyed)
 
-    def body(t, carry):
-        tags, rows, unresolved, fresh = carry
+
+def insert_core(table, fps, mask):
+    """Insert fps[mask] into the table.  Duplicate fingerprints within
+    the batch are allowed: exactly one lane per distinct new fingerprint
+    returns fresh.  Returns (table, fresh, overflow); overflow means
+    some lanes were still unresolved after MAX_PROBES (their inserts
+    did not happen — grow the table and retry).  Plain traceable
+    function — compose inside a jit (insert_batch is the standalone
+    jitted form)."""
+    slots = table["slots"]
+    cap = slots.shape[0]
+    capm = jnp.uint32(cap - 1)
+    keyed, h0 = _keyed(fps)
+    n = fps.shape[0]
+    lane_id = jnp.arange(n, dtype=U32)
+    payload = jnp.concatenate([keyed, lane_id[:, None]], axis=1)  # [n, 5]
+
+    def cond(carry):
+        t, _slots, unresolved, _fresh = carry
+        return (t < MAX_PROBES) & unresolved.any()
+
+    def body(carry):
+        t, slots, unresolved, fresh = carry
         idx = (h0 + jnp.uint32(t)) & capm
-        cur_tag = tags[idx]
-        cur_row = rows[idx]
-        mine = (cur_tag == tag) & (cur_row == row).all(axis=1)
+        cur = slots[idx]
+        mine = (cur[:, :4] == keyed).all(axis=1)
         dup = unresolved & mine
-        empty = unresolved & (cur_tag == 0)
-        # claim: only lanes seeing empty scatter; conflicting claims are
-        # resolved by the read-back
+        empty = unresolved & (cur[:, 0] == 0)
+        # claim: one scatter writes tag+row+lane-id atomically, so the
+        # read-back names a single winner even among equal fingerprints
         cidx = jnp.where(empty, idx, jnp.uint32(cap))  # OOB drops the write
-        tags = tags.at[cidx].set(tag, mode="drop")
-        rows = rows.at[cidx].set(row, mode="drop")
-        won = empty & (tags[idx] == tag) & (rows[idx] == row).all(axis=1)
+        slots = slots.at[cidx].set(payload, mode="drop")
+        post = slots[idx]
+        won = empty & (post == payload).all(axis=1)
+        # a lane that saw empty but reads back its own (tag, row) under
+        # someone else's claim lost the race to an EQUAL fingerprint —
+        # resolve it as a duplicate now; advancing the probe would
+        # wrongly insert the fingerprint a second time at the next slot
+        lost_dup = empty & ~won & (post[:, :4] == keyed).all(axis=1)
         fresh = fresh | won
-        unresolved = unresolved & ~dup & ~won
-        return tags, rows, unresolved, fresh
+        unresolved = unresolved & ~dup & ~won & ~lost_dup
+        return t + 1, slots, unresolved, fresh
 
-    tags, rows, unresolved, fresh = jax.lax.fori_loop(
-        0, MAX_PROBES, body,
-        (table["tags"], table["rows"], mask, jnp.zeros_like(mask)))
-    return ({"tags": tags, "rows": rows}, fresh, unresolved.any())
+    _, slots, unresolved, fresh = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), slots, mask, jnp.zeros_like(mask)))
+    return {**table, "slots": slots}, fresh, unresolved.any()
 
 
 insert_batch = partial(jax.jit, donate_argnums=(0,))(insert_core)
 
 
+def query_core(table, fps, mask):
+    """Read-only membership probe: returns (fresh, overflow).  `fresh`
+    marks masked lanes whose fingerprint is NOT in the table (duplicate
+    lanes within the batch all read fresh — callers using the count for
+    capacity checks get a conservative overcount); lanes unresolved
+    after MAX_PROBES raise `overflow` and are not fresh."""
+    slots = table["slots"]
+    cap = slots.shape[0]
+    capm = jnp.uint32(cap - 1)
+    keyed, h0 = _keyed(fps)
+
+    def cond(carry):
+        t, unresolved, _fresh = carry
+        return (t < MAX_PROBES) & unresolved.any()
+
+    def body(carry):
+        t, unresolved, fresh = carry
+        idx = (h0 + jnp.uint32(t)) & capm
+        cur = slots[idx]
+        mine = (cur[:, :4] == keyed).all(axis=1)
+        empty = unresolved & (cur[:, 0] == 0)
+        fresh = fresh | empty
+        unresolved = unresolved & ~mine & ~empty
+        return t + 1, unresolved, fresh
+
+    _, unresolved, fresh = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), mask, jnp.zeros_like(mask)))
+    return fresh, unresolved.any()
+
+
 def grow(table, factor=4):
     """Host-side rebuild into a larger table (on probe overflow or high
     load).  Rare; chunked re-insertion of all occupied slots."""
-    cap = int(table["tags"].shape[0])
-    tags = np.asarray(table["tags"])
-    rows = np.asarray(table["rows"])
-    occ = tags != 0
-    fps = np.concatenate([tags[occ, None], rows[occ]], axis=1)
+    slots = np.asarray(table["slots"])
+    occ = slots[:, 0] != 0
+    fps = slots[occ, :4]
+    cap = int(slots.shape[0])
     new = empty_table(cap * factor)
     chunk = 1 << 16
     for off in range(0, fps.shape[0], chunk):

@@ -192,6 +192,31 @@ class VSRCodec:
             "err": z(),
         }
 
+    # -- message-table growth ----------------------------------------------
+    MSG_KEYS = ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
+                "m_log_len", "m_has_log")
+
+    def pad_msgs(self, dense, old_max_msgs):
+        """Pad a dense state pytree from `old_max_msgs` slots to this
+        codec's MAX_MSGS by appending all-zero slots along axis 1.  Zero
+        padding is content-neutral: absent slots contribute nothing to
+        fingerprints, so grown states hash identically (the in-place
+        growth invariant both device engines rely on)."""
+        import jax.numpy as jnp
+        new = self.shape.MAX_MSGS
+        out = dict(dense)
+        for k in self.MSG_KEYS:
+            v = dense[k]
+            shape = list(v.shape)
+            shape[1] = new - old_max_msgs
+            if isinstance(v, np.ndarray):
+                out[k] = np.concatenate(
+                    [v, np.zeros(shape, v.dtype)], axis=1)
+            else:
+                out[k] = jnp.concatenate(
+                    [v, jnp.zeros(shape, v.dtype)], axis=1)
+        return out
+
     # -- encode ------------------------------------------------------------
     def _enc_entry(self, e: FnVal):
         return [e.apply("view_number"), self.value_id[e.apply("operation")],

@@ -768,6 +768,163 @@ class VSRKernel:
         return s2, en
 
     # ==================================================================
+    # guard-only evaluation (the cheap pass of the two-phase expand)
+    #
+    # Each guard replicates exactly the `en` conjunction of its action —
+    # reading a handful of scalars/rows — so the engine can evaluate
+    # enabledness over the full [T, n_lanes] lane space at ~1% of the
+    # cost of building successors, then expand only the enabled lanes.
+    # Kept in lockstep with the action bodies; `test_guard_fns_match`
+    # holds them to the actions differentially.
+    # ==================================================================
+    def _recv_guard(self, st, k, mtype):
+        return ((st["m_present"][k] == 1) & (st["m_count"][k] > 0)
+                & (st["m_hdr"][k, H_TYPE] == mtype))
+
+    def _dest_i(self, st, k):
+        return jnp.clip(st["m_hdr"][k, H_DEST] - 1, 0, self.R - 1)
+
+    def guard_timer_send_svc(self, st, lane):
+        i = lane
+        return ((st["aux_svc"] < self.shape.timer_limit)
+                & ~self._is_primary(st, i, i + 1))
+
+    def guard_receive_higher_svc(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_SVC)
+                & (st["m_hdr"][k, H_VIEW] > st["view"][i]))
+
+    def guard_receive_matching_svc(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_SVC)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i])
+                & (st["status"][i] == VIEWCHANGE))
+
+    def guard_send_dvc(self, st, lane):
+        i = lane
+        return ((st["status"][i] == VIEWCHANGE) & (st["sent_dvc"][i] == 0)
+                & (st["svc"][i].sum() >= self.R // 2))
+
+    def guard_receive_higher_dvc(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_DVC)
+                & (st["m_hdr"][k, H_VIEW] > st["view"][i]))
+
+    def guard_receive_matching_dvc(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_DVC)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i]))
+
+    def guard_send_sv(self, st, lane):
+        i = lane
+        return ((st["status"][i] == VIEWCHANGE) & (st["sent_sv"][i] == 0)
+                & ((st["dvc"][i] == 1).sum() >= self.R // 2 + 1))
+
+    def guard_receive_sv(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_SV)
+                & (st["m_hdr"][k, H_VIEW] >= st["view"][i]))
+
+    def guard_receive_client_request(self, st, lane):
+        i = lane // self.V
+        v = lane % self.V + 1
+        return (self._is_primary(st, i, i + 1) & (st["status"][i] == NORMAL)
+                & (st["aux_acked"][v - 1] == 0)
+                & (st["ct"][i, 0, T_EXEC] == 1))
+
+    def guard_receive_prepare(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_PREPARE)
+                & (st["status"][i] == NORMAL)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i])
+                & (st["m_hdr"][k, H_OP] == st["op"][i] + 1))
+
+    def guard_receive_prepare_ok(self, st, k):
+        i = self._dest_i(st, k)
+        j = jnp.clip(st["m_hdr"][k, H_SRC] - 1, 0, self.R - 1)
+        return (self._recv_guard(st, k, M_PREPAREOK)
+                & self._is_primary(st, i, st["m_hdr"][k, H_DEST])
+                & (st["status"][i] == NORMAL)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i])
+                & (st["m_hdr"][k, H_OP] > st["peer_op"][i, j]))
+
+    def guard_execute_op(self, st, lane):
+        i = lane
+        opn = st["commit"][i] + 1
+        committed = (st["peer_op"][i] >= opn).sum() >= self.R // 2
+        return (self._is_primary(st, i, i + 1) & (st["status"][i] == NORMAL)
+                & (st["commit"][i] < st["op"][i]) & committed)
+
+    def guard_send_get_state(self, st, lane):
+        k = lane // self.R
+        rdest = lane % self.R + 1
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_PREPARE)
+              & ~self._is_primary(st, i, r) & (r != rdest)
+              & (st["status"][i] == NORMAL)
+              & (hdr[H_VIEW] > st["view"][i])
+              & (hdr[H_OP] > st["op"][i] + 1))
+        # SendOnce: the GetState record must not already be in the bag
+        # (VSR.tla:250-252); the bag is unchanged by the truncation, so
+        # the membership test can run against the parent state
+        trunc = jnp.minimum(st["commit"][i], st["log_len"][i])
+        row = self._row(M_GETSTATE, view=hdr[H_VIEW], op=trunc,
+                        dest=rdest, src=r)
+        return en & ~self._row_eq(st, row).any()
+
+    def guard_receive_get_state(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_GETSTATE)
+                & (st["view"][i] == st["m_hdr"][k, H_VIEW])
+                & (st["status"][i] == NORMAL)
+                & (st["op"][i] > st["m_hdr"][k, H_OP]))
+
+    def guard_receive_new_state(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_NEWSTATE)
+                & (st["view"][i] == st["m_hdr"][k, H_VIEW])
+                & (st["status"][i] == NORMAL)
+                & (st["op"][i] == st["m_hdr"][k, H_FIRST] - 1))
+
+    def guard_restart_empty(self, st, lane):
+        del lane
+        return st["aux_restart"] < self.shape.restart_limit
+
+    def guard_receive_recovery(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_RECOVERY)
+                & (st["status"][i] == NORMAL))
+
+    def guard_receive_recovery_response(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_RECOVERYRESP)
+                & (st["rec_number"][i] == st["m_hdr"][k, H_X])
+                & (st["status"][i] == RECOVERING))
+
+    def guard_complete_recovery(self, st, lane):
+        i = lane
+        cand = (st["rec"][i] == 1) & (st["rec_has_log"][i] == 1)
+        return ((st["status"][i] == RECOVERING)
+                & ((st["rec"][i] == 1).sum() > self.R // 2)
+                & cand.any())
+
+    def _guard_fns(self):
+        return [
+            self.guard_timer_send_svc, self.guard_receive_higher_svc,
+            self.guard_receive_matching_svc, self.guard_send_dvc,
+            self.guard_receive_higher_dvc, self.guard_receive_matching_dvc,
+            self.guard_send_sv, self.guard_receive_sv,
+            self.guard_receive_client_request, self.guard_receive_prepare,
+            self.guard_receive_prepare_ok, self.guard_execute_op,
+            self.guard_send_get_state, self.guard_receive_get_state,
+            self.guard_receive_new_state, self.guard_restart_empty,
+            self.guard_receive_recovery, self.guard_receive_recovery_response,
+            self.guard_complete_recovery,
+        ]
+
+    # ==================================================================
     # full Next: all lanes of all actions, stacked
     # ==================================================================
     def _action_fns(self):

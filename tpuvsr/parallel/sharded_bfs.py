@@ -60,7 +60,7 @@ def make_sharded_expand(kern, inv_fn, mesh: Mesh, axis: str = "d",
 
     def step_shard(tables, tile, valid):
         # tables arrive with the sharded leading axis of size 1:
-        # {"tags": [1, cap], "rows": [1, cap, 3]}
+        # {"slots": [1, cap, 5]}
         # tile:   state pytree [B_local, ...];  valid: [B_local]
         tables = {k: v[0] for k, v in tables.items()}
         B = valid.shape[0]
@@ -139,7 +139,6 @@ def make_sharded_tables(mesh, axis, capacity_per_device):
     """Global FPSet: one independent shard per device, stacked on the
     leading (sharded) axis."""
     n = mesh.shape[axis]
-    tabs = {"tags": jnp.zeros((n, capacity_per_device), U32),
-            "rows": jnp.zeros((n, capacity_per_device, 3), U32)}
+    tabs = {"slots": jnp.zeros((n, capacity_per_device, 5), U32)}
     sh = NamedSharding(mesh, P(axis))
     return jax.device_put(tabs, sh)
