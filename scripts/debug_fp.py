@@ -1,0 +1,60 @@
+"""Debug the defect-hunt false positive: rerun the exact hunt
+(deterministic PRNG), then check the replayed final state with both the
+interpreter and the device invariant kernel, and re-walk the whole
+trace through the interpreter validating each transition."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.engine.device_sim import DeviceSimulator
+
+REFERENCE = "/root/reference/vsr-revisited/paper"
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+sim = DeviceSimulator(spec, walkers=4096, chunk_steps=32, max_msgs=48)
+res = sim.run(num=10**9, depth=64, seed=0, max_seconds=900,
+              log=lambda m: print(f"hunt: {m}", file=sys.stderr))
+print(f"ok={res.ok} violated={res.violated_invariant} steps={res.steps}")
+if res.trace is None:
+    sys.exit("no violation found")
+
+final = res.trace[-1].state
+print("interp check_invariants(final):", spec.check_invariants(final))
+dense = sim.codec.encode(final)
+inv = sim.kern.invariant_fn(sim.inv_names)
+ok = inv({k: jnp.asarray(v) for k, v in dense.items()})
+print("device inv ok on replayed final state:", bool(ok))
+
+# validate every step of the trace through the interpreter
+cur = res.trace[0].state
+interp_ok = True
+for te in res.trace[1:]:
+    succs = dict()
+    for aname, succ in spec.successors(cur):
+        # match on full state equality
+        pass
+    # find a successor matching te.state under action te.action_name
+    found = False
+    for aname, succ in spec.successors(cur):
+        if aname == te.action_name and succ == te.state:
+            found = True
+            break
+    if not found:
+        print(f"STEP {te.position} ({te.action_name}): interpreter has no "
+              f"matching successor!")
+        interp_ok = False
+        break
+    cur = te.state
+print("interpreter trace validation:", "PASS" if interp_ok else "FAIL")

@@ -1,0 +1,85 @@
+"""Rerun the exact defect hunt, pickle the trace + dense states for
+offline analysis, and print a detailed per-key diff at the first
+interpreter-validation failure."""
+
+import os
+import pickle
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.engine.device_sim import DeviceSimulator
+
+REFERENCE = "/root/reference/vsr-revisited/paper"
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+sim = DeviceSimulator(spec, walkers=4096, chunk_steps=32, max_msgs=48)
+trace = None
+try:
+    res = sim.run(num=10**9, depth=64, seed=0, max_seconds=900,
+                  log=lambda m: print(f"hunt: {m}", file=sys.stderr))
+    trace = res.trace
+    print(f"ok={res.ok} violated={res.violated_invariant} steps={res.steps}")
+except Exception as e:
+    print(f"EXCEPTION: {type(e).__name__}: {e}")
+    trace = getattr(e, "trace", None)
+if trace is None:
+    sys.exit("no violation found")
+
+
+class TE:
+    pass
+
+
+res_trace = trace
+
+
+class R:
+    trace = res_trace
+
+
+res = R()
+
+with open("/tmp/defect_trace.pkl", "wb") as f:
+    pickle.dump([(te.position, te.action_name, te.state)
+                 for te in res.trace], f)
+print("pickled trace to /tmp/defect_trace.pkl")
+
+cur = res.trace[0].state
+for te in res.trace[1:]:
+    cands = [succ for a, succ in spec.successors(cur)
+             if a.name == te.action_name]
+    exact = [s for s in cands if s == te.state]
+    if not exact:
+        print(f"STEP {te.position} ({te.action_name}): no exact match "
+              f"among {len(cands)} interp candidates")
+        # diff against the closest candidate (fewest differing keys)
+        best, bestdiff = None, None
+        for s in cands:
+            diff = [k for k in s if s[k] != te.state.get(k)]
+            if bestdiff is None or len(diff) < len(bestdiff):
+                best, bestdiff = s, diff
+        if best is None:
+            print("  (no candidates at all)")
+        else:
+            print(f"  closest candidate differs on {bestdiff}")
+            for k in bestdiff:
+                print(f"  {k}:\n    interp: {best[k]}\n"
+                      f"    replay: {te.state.get(k)}")
+        extra = set(te.state) - set(cur)
+        missing = set(cur) - set(te.state)
+        if extra or missing:
+            print(f"  key-set drift: extra={extra} missing={missing}")
+        break
+    cur = te.state
+else:
+    print("full trace validates against interpreter")

@@ -1,0 +1,68 @@
+"""Diff the kernel's TimerSendSVC successor against the interpreter's,
+from the defect-config initial state."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.models.vsr import VSRCodec
+from tpuvsr.models.vsr_kernel import ACTION_NAMES, VSRKernel
+
+REFERENCE = "/root/reference/vsr-revisited/paper"
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+codec = VSRCodec(spec.ev.constants, max_msgs=48)
+kern = VSRKernel(codec)
+
+init = list(spec.init_states())[0]
+dense = codec.encode(init)
+dec = codec.decode(dense)
+
+# sanity: encode/decode roundtrip vs raw init
+for k in init:
+    if init[k] != dec[k]:
+        print(f"ROUNDTRIP MISMATCH on {k}:\n  raw: {init[k]}\n  dec: {dec[k]}")
+
+aid = ACTION_NAMES.index("TimerSendSVC")
+fn = kern._action_fns()[aid]
+for prm in range(3):
+    st = {k: jnp.asarray(v) for k, v in dense.items()}
+    succ, en = fn(st, jnp.asarray(prm, jnp.int32))
+    succ = {k: np.asarray(v) for k, v in succ.items()
+            if not k.startswith("_")}
+    print(f"lane {prm}: enabled={bool(en)}")
+    if not bool(en):
+        continue
+    ksucc = codec.decode(succ)
+    matches = []
+    for a, isucc in spec.successors(dec):
+        if a.name != "TimerSendSVC":
+            continue
+        same = all(isucc[k] == ksucc[k] for k in isucc)
+        matches.append(same)
+        if same:
+            break
+    if not any(matches):
+        print(f"  NO MATCH among {len(matches)} interp TimerSendSVC succs")
+        # print field diffs vs first interp successor
+        a, isucc = [x for x in spec.successors(dec)
+                    if x[0].name == "TimerSendSVC"][prm]
+        for k in isucc:
+            if isucc[k] != ksucc[k]:
+                print(f"  field {k}:\n    interp: {isucc[k]}\n"
+                      f"    kernel: {ksucc[k]}")
+    else:
+        print("  match ok")

@@ -1,0 +1,110 @@
+"""Manually re-execute the defect hunt's first chunk outside lax.scan,
+tracking walker 3567: compare the device-path state against the
+recorded-(aid,prm) replay at every step; print the first divergence."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.engine.device_sim import DeviceSimulator
+from tpuvsr.models.vsr_kernel import ACTION_NAMES
+
+I32 = jnp.int32
+W_TRACK = 3567
+REFERENCE = "/root/reference/vsr-revisited/paper"
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+sim = DeviceSimulator(spec, walkers=4096, chunk_steps=32, max_msgs=48)
+kern = sim.kern
+codec = sim.codec
+lane_aid = jnp.asarray(kern.lane_action)
+lane_prm = jnp.asarray(kern.lane_param)
+guards = kern._guard_fns()
+fns = kern._action_fns()
+inv = kern.invariant_fn(sim.inv_names)
+
+
+def guard_all(st):
+    outs = []
+    for name, g in zip(ACTION_NAMES, guards):
+        lanes = jnp.arange(kern._lane_count(name), dtype=I32)
+        outs.append(jax.vmap(lambda ln, g=g: g(st, ln))(lanes))
+    return jnp.concatenate(outs)
+
+
+branches = [lambda st, p, f=f: f(st, p)[0] for f in fns]
+
+
+def apply_lane(st, aid, prm):
+    return jax.lax.switch(aid, branches, st, prm)
+
+
+step_fn = jax.jit(lambda states, key: _step(states, key))
+
+
+def _step(states, key):
+    en = jax.vmap(guard_all)(states)
+    u = jax.random.uniform(key, en.shape)
+    lane = jnp.argmax(jnp.where(en, u, -1.0), axis=1)
+    alive = en.any(axis=1)
+    aid = lane_aid[lane]
+    prm = lane_prm[lane]
+    succ = jax.vmap(apply_lane)(states, aid, prm)
+    sel = {k: alive.reshape((-1,) + (1,) * (v.ndim - 1))
+           for k, v in states.items()}
+    merged = {k: jnp.where(sel[k], succ[k], v) for k, v in states.items()}
+    iok = jax.vmap(inv)(succ)
+    return merged, alive, aid, prm, iok, succ
+
+
+init_dense = [codec.encode(st) for st in spec.init_states()]
+init = {k: jnp.asarray(np.repeat(np.stack([d[k] for d in init_dense])[:1],
+                                 4096, axis=0)) for k in init_dense[0]}
+
+key = jax.random.PRNGKey(0)
+key, sub = jax.random.split(key)
+keys = jax.random.split(sub, 32)
+
+states = init
+replay = {k: np.asarray(v[W_TRACK]) for k, v in init.items()}
+mat_fns = {}
+
+
+def mat_one(st, aid, prm):
+    f = mat_fns.get(aid)
+    if f is None:
+        f = jax.jit(jax.vmap(fns[aid], in_axes=(0, 0)))
+        mat_fns[aid] = f
+    batch = {k: np.asarray(v)[None] for k, v in st.items()}
+    succ, en = f(batch, jnp.asarray([prm], I32))
+    return ({k: np.asarray(v)[0] for k, v in succ.items()
+             if not k.startswith("_")}, bool(np.asarray(en)[0]))
+
+
+for i in range(21):
+    states, alive, aid, prm, iok, succ = step_fn(states, keys[i])
+    a, p = int(aid[W_TRACK]), int(prm[W_TRACK])
+    al, ok = bool(alive[W_TRACK]), bool(iok[W_TRACK])
+    dev = {k: np.asarray(v[W_TRACK]) for k, v in states.items()}
+    replay, ren = mat_one(replay, a, p)
+    diffs = [k for k in dev if not np.array_equal(dev[k], replay[k])]
+    print(f"step {i}: {ACTION_NAMES[a]}[{p}] alive={al} inv_ok={ok} "
+          f"replay_en={ren} diffs={diffs}")
+    if diffs:
+        for k in diffs[:4]:
+            print(f"  {k}:\n    dev:    {dev[k]}\n    replay: {replay[k]}")
+        break
+if not diffs:
+    print("no divergence in 21 steps; device final inv:",
+          bool(inv({k: jnp.asarray(v) for k, v in dev.items()})))

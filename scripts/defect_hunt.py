@@ -1,0 +1,52 @@
+"""Defect-reproduction experiment: hunt the state-transfer data-loss
+violation (reference README:11-18, state_transfer_violation_trace.txt)
+with the device simulator on the defect fixture config.
+
+Usage: python scripts/defect_hunt.py [walkers] [depth] [max_seconds] [seed]
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+walkers = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+depth = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+max_seconds = float(sys.argv[3]) if len(sys.argv) > 3 else 600
+seed = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.engine.device_sim import DeviceSimulator
+
+REFERENCE = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+import jax
+print(f"backend: {jax.default_backend()}", file=sys.stderr)
+
+t0 = time.time()
+sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=32, max_msgs=48)
+print(f"build: {time.time()-t0:.1f}s", file=sys.stderr)
+
+t0 = time.time()
+res = sim.run(num=10**9, depth=depth, seed=seed,
+              max_seconds=max_seconds,
+              log=lambda m: print(f"hunt: {m} ({time.time()-t0:.0f}s)",
+                                  file=sys.stderr))
+print(f"\nelapsed {res.elapsed:.1f}s, walks {res.walks}, steps {res.steps}")
+print(f"ok={res.ok} violated={res.violated_invariant}")
+if res.trace:
+    print(f"trace length {len(res.trace)}")
+    for te in res.trace:
+        print(f"  {te.position}: {te.action_name}")
+    last = res.trace[-1].state
+    print("final logs:", last["rep_log"])
+    print("acked:", last["aux_client_acked"])

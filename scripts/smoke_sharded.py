@@ -1,0 +1,45 @@
+"""Smoke the ShardedBFS driver on the virtual 8-device CPU mesh:
+depth-limited run must match the single-device DeviceBFS level sizes."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+from jax.sharding import Mesh
+from tests.conftest import vsr_spec
+from tpuvsr.engine.device_bfs import DeviceBFS
+from tpuvsr.parallel.sharded_bfs import ShardedBFS
+
+DEPTH = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+
+spec = vsr_spec()
+mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+sbfs = ShardedBFS(spec, mesh, tile=16, bucket_cap=512,
+                  next_capacity=1 << 10, fpset_capacity=1 << 12)
+res = sbfs.run(max_depth=DEPTH, log=print)
+print("sharded:", res.ok, res.distinct_states, res.states_generated,
+      res.error, "levels:", sbfs.level_sizes)
+
+eng = DeviceBFS(spec, tile_size=64)
+res1 = eng.run(max_depth=DEPTH, log=print)
+print("single :", res1.ok, res1.distinct_states, res1.states_generated,
+      res1.error, "levels:", eng.level_sizes)
+assert sbfs.level_sizes == eng.level_sizes, "level sizes differ"
+assert res.distinct_states == res1.distinct_states
+print("MATCH")

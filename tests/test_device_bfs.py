@@ -249,3 +249,30 @@ def test_fpset_insert_duplicates_single_fresh():
     # nothing fresh on re-insert
     _, fresh2, _ = insert_batch(table, fps, mask)
     assert not np.asarray(fresh2).any()
+
+
+# ---------------------------------------------------------------------
+# checkpoint/resume
+# ---------------------------------------------------------------------
+def test_checkpoint_resume_reaches_same_frontier(tmp_path):
+    """Kill-and-resume: a run checkpointed at a level boundary must,
+    after resuming in a FRESH engine, reach the same per-level frontier
+    sizes and distinct count as an uninterrupted run (SURVEY.md §5
+    checkpoint/resume; reference README:20 multi-day guidance)."""
+    ckpt = str(tmp_path / "vsr.ckpt")
+    spec = vsr_spec()
+    eng1 = DeviceBFS(spec, tile_size=64)
+    res1 = eng1.run(max_depth=5, checkpoint_path=ckpt)
+    assert res1.error          # depth-limited, not fixpoint
+    sizes_at_kill = list(eng1.level_sizes)
+
+    # "crash": new engine object, resume from disk, continue deeper
+    eng2 = DeviceBFS(vsr_spec(), tile_size=64)
+    res2 = eng2.run(max_depth=9, resume_from=ckpt)
+    # oracle: one uninterrupted run to the same depth
+    eng3 = DeviceBFS(vsr_spec(), tile_size=64)
+    res3 = eng3.run(max_depth=9)
+    assert eng2.level_sizes == eng3.level_sizes
+    assert eng2.level_sizes[:len(sizes_at_kill)] == sizes_at_kill
+    assert res2.distinct_states == res3.distinct_states
+    assert res2.states_generated == res3.states_generated

@@ -15,10 +15,19 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
   -engine E        auto | device | interp (default auto: the jit+vmap
                    device engine for specs with a compiled kernel, the
                    interpreter otherwise)
+  -fpset NAME      fingerprint-set implementation, mirroring TLC's
+                   pluggable-FPSet class flag: auto (default) | hbm
+                   (the HBM-resident device table — forces the device
+                   engine) | host (the interpreter's in-memory set —
+                   forces the interpreter engine)
   -maxstates N     stop BFS after N distinct states
   -deadlock        enable deadlock reporting (note: TLC's flag of the
                    same name *disables* its default-on check; the
                    reference corpus only runs deadlock-off)
+  -checkpoint N    write an engine snapshot every N minutes (device
+                   BFS; TLC's -checkpoint)
+  -checkpointdir P snapshot directory (default: <spec>.ckpt)
+  -recover PATH    resume a BFS run from a snapshot (TLC's -recover)
   -json            emit a one-line JSON result summary
 """
 
@@ -44,14 +53,31 @@ def build_parser():
     p.add_argument("-seed", type=int, default=0)
     p.add_argument("-engine", choices=["auto", "device", "interp"],
                    default="auto")
+    p.add_argument("-fpset", choices=["auto", "hbm", "host"],
+                   default="auto")
     p.add_argument("-maxstates", type=int, default=None)
     p.add_argument("-deadlock", action="store_true")
+    p.add_argument("-checkpoint", type=float, default=None,
+                   metavar="MINUTES")
+    p.add_argument("-checkpointdir", default=None)
+    p.add_argument("-recover", default=None, metavar="PATH")
     p.add_argument("-json", action="store_true")
     p.add_argument("-maxseconds", type=float, default=None)
     return p
 
 
-def _pick_engine(requested, spec):
+def _pick_engine(requested, fpset, spec):
+    # -fpset mirrors TLC's pluggable FPSet class selection: the HBM
+    # table only exists in the device engine, the host set only in the
+    # interpreter (BASELINE.json north_star gating)
+    if fpset == "hbm":
+        if requested == "interp":
+            raise SystemExit("-fpset hbm requires the device engine")
+        return "device"
+    if fpset == "host":
+        if requested == "device":
+            raise SystemExit("-fpset host requires -engine interp")
+        return "interp"
     if requested != "auto":
         return requested
     # the compiled device kernel covers the root VSR module (C=1);
@@ -69,7 +95,7 @@ def main(argv=None):
 
     cfg_path = args.config or os.path.splitext(args.spec)[0] + ".cfg"
     spec = load_spec(args.spec, cfg_path)
-    engine = _pick_engine(args.engine, spec)
+    engine = _pick_engine(args.engine, args.fpset, spec)
     t0 = time.time()
 
     def log(msg):
@@ -96,10 +122,22 @@ def main(argv=None):
                    "elapsed_s": round(res.elapsed, 3)}
     else:
         if engine == "device":
-            from ..engine.device_bfs import device_bfs_check
-            res = device_bfs_check(spec, max_states=args.maxstates,
-                                   check_deadlock=args.deadlock, log=log)
+            from ..engine.device_bfs import DeviceBFS
+            ckpt_dir = args.checkpointdir or (
+                os.path.splitext(args.spec)[0] + ".ckpt")
+            eng = DeviceBFS(spec)
+            res = eng.run(
+                max_states=args.maxstates, max_seconds=args.maxseconds,
+                check_deadlock=args.deadlock, log=log,
+                checkpoint_path=(ckpt_dir if args.checkpoint or
+                                 args.recover else None),
+                checkpoint_every=(args.checkpoint * 60.0
+                                  if args.checkpoint else None),
+                resume_from=args.recover)
         else:
+            if args.checkpoint or args.recover:
+                log("checkpoint/recover is a device-engine feature; "
+                    "ignored for the interpreter")
             from ..engine.bfs import bfs_check
             res = bfs_check(spec, check_deadlock=args.deadlock,
                             max_states=args.maxstates, log=log)

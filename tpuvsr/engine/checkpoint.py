@@ -1,12 +1,14 @@
 """Checker-level checkpoint/resume (SURVEY.md §5): snapshot the BFS
-frontier, fingerprint table, visited-state store, and counters so
-multi-day runs survive preemption — the analog of TLC's queue/FPSet
-checkpointing implied by the reference's 500 GB multi-day guidance
-(README:20).
+engine at a level boundary — fingerprint table, live frontier, host
+trace-pointer store, and counters — so multi-day runs survive
+preemption, the analog of TLC's queue/FPSet checkpointing implied by
+the reference's 500 GB multi-day guidance (README:20).
 
-Format: one directory with numbered .npz chunk files plus a manifest;
-written atomically (tmp dir + rename) so a crash mid-write leaves the
-previous checkpoint intact.
+A checkpoint is one directory holding .npz payloads plus a JSON
+manifest, written atomically (tmp dir + rename) so a crash mid-write
+leaves the previous checkpoint intact.  Level boundaries are the one
+clean point of the device engine: the next-frontier buffers are empty,
+so the snapshot is exactly (FPSet, frontier, trace pointers, counters).
 """
 
 from __future__ import annotations
@@ -17,39 +19,44 @@ import shutil
 
 import numpy as np
 
+FORMAT_VERSION = 2
 
-FORMAT_VERSION = 1
 
+def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
+                    h_action, h_param, init_dense, level_sizes, depth,
+                    fp_count, states_generated, max_msgs, expand_mults,
+                    elapsed):
+    """Write a complete engine snapshot to `path` (atomic).
 
-def save_checkpoint(path, *, table, store, frontier, level_base, depth,
-                    level_sizes, fp_count, fp_cap, states_generated,
-                    max_msgs, elapsed):
-    """Write a complete engine snapshot to `path` (atomic)."""
-    tmp = path + ".tmp"
+    `frontier` rows beyond `n_front` are dropped; `h_*` are the
+    concatenated host trace-pointer arrays; `init_dense` is the dense
+    encoding of the (deduped) initial states, in gid order."""
+    tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    np.savez(os.path.join(tmp, "fpset.npz"),
-             tags=np.asarray(table["tags"]),
-             rows=np.asarray(table["rows"]))
-    np.savez(os.path.join(tmp, "frontier.npz"), **frontier)
-    for i, chunk in enumerate(store.chunks):
-        np.savez(os.path.join(tmp, f"chunk{i:05d}.npz"), **chunk)
+    np.savez_compressed(os.path.join(tmp, "fpset.npz"),
+                        slots=np.asarray(slots))
+    np.savez_compressed(
+        os.path.join(tmp, "frontier.npz"),
+        **{k: np.asarray(v)[:n_front] for k, v in frontier.items()})
+    np.savez_compressed(os.path.join(tmp, "trace.npz"),
+                        parent=h_parent, action=h_action, param=h_param)
+    np.savez_compressed(
+        os.path.join(tmp, "init.npz"),
+        **{k: np.stack([np.asarray(d[k]) for d in init_dense])
+           for k in init_dense[0]})
     manifest = {
         "format": FORMAT_VERSION,
-        "n_chunks": len(store.chunks),
-        "offsets": store.offsets,
-        "parents": [[p if p is not None else -1,
-                     a if a is not None else -1]
-                    for p, a in store.parents],
-        "level_base": level_base,
-        "depth": depth,
-        "level_sizes": level_sizes,
-        "fp_count": fp_count,
-        "fp_cap": fp_cap,
-        "states_generated": states_generated,
-        "max_msgs": max_msgs,
-        "elapsed": elapsed,
+        "n_front": int(n_front),
+        "n_init": len(init_dense),
+        "level_sizes": [int(x) for x in level_sizes],
+        "depth": int(depth),
+        "fp_count": int(fp_count),
+        "states_generated": int(states_generated),
+        "max_msgs": int(max_msgs),
+        "expand_mults": [int(x) for x in expand_mults],
+        "elapsed": float(elapsed),
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
@@ -59,29 +66,33 @@ def save_checkpoint(path, *, table, store, frontier, level_base, depth,
 
 
 def load_checkpoint(path):
-    """Read a snapshot; returns a dict of the save_checkpoint kwargs."""
+    """Read a snapshot; returns a dict mirroring save_checkpoint."""
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     if manifest["format"] != FORMAT_VERSION:
-        raise ValueError(f"checkpoint format {manifest['format']} "
-                         f"unsupported")
+        raise ValueError(
+            f"checkpoint format {manifest['format']} unsupported "
+            f"(want {FORMAT_VERSION})")
     fp = np.load(os.path.join(path, "fpset.npz"))
-    table = {"tags": fp["tags"], "rows": fp["rows"]}
     fr = np.load(os.path.join(path, "frontier.npz"))
-    frontier = {k: fr[k] for k in fr.files}
-    chunks = []
-    for i in range(manifest["n_chunks"]):
-        c = np.load(os.path.join(path, f"chunk{i:05d}.npz"))
-        chunks.append({k: c[k] for k in c.files})
-    parents = [(None if p == -1 else p, None if a == -1 else a)
-               for p, a in manifest["parents"]]
+    tr = np.load(os.path.join(path, "trace.npz"))
+    ini = np.load(os.path.join(path, "init.npz"))
+    n_init = manifest["n_init"]
+    init_dense = [{k: ini[k][i] for k in ini.files}
+                  for i in range(n_init)]
     return {
-        "table": table, "frontier": frontier, "chunks": chunks,
-        "offsets": manifest["offsets"], "parents": parents,
-        "level_base": manifest["level_base"], "depth": manifest["depth"],
+        "slots": fp["slots"],
+        "frontier": {k: fr[k] for k in fr.files},
+        "n_front": manifest["n_front"],
+        "h_parent": tr["parent"],
+        "h_action": tr["action"],
+        "h_param": tr["param"],
+        "init_dense": init_dense,
         "level_sizes": manifest["level_sizes"],
-        "fp_count": manifest["fp_count"], "fp_cap": manifest["fp_cap"],
+        "depth": manifest["depth"],
+        "fp_count": manifest["fp_count"],
         "states_generated": manifest["states_generated"],
         "max_msgs": manifest["max_msgs"],
+        "expand_mults": manifest["expand_mults"],
         "elapsed": manifest["elapsed"],
     }

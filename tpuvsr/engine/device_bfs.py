@@ -353,59 +353,97 @@ class DeviceBFS:
                 jnp.zeros((cap,), I32))
 
     def run(self, max_states=None, max_depth=None, max_seconds=None,
-            check_deadlock=False, log=None,
-            progress_every=10.0) -> CheckResult:
+            check_deadlock=False, log=None, progress_every=10.0,
+            checkpoint_path=None, checkpoint_every=None,
+            resume_from=None) -> CheckResult:
         spec, codec = self.spec, self.codec  # codec only for init encode
         res = CheckResult()
         t0 = time.time()
-        fp_cap = self.fpset_capacity
-        table = empty_table(fp_cap)
 
         def emit(msg):
             if log:
                 log(msg)
 
-        # --- register init states (host path, tiny) -------------------
-        init_states = list(spec.init_states())
-        init_dense = [codec.encode(st) for st in init_states]
-        init_batch = {k: np.stack([d[k] for d in init_dense])
-                      for k in init_dense[0]}
-        fps = np.asarray(self.kern.fingerprint_batch(init_batch))
-        keep, seen = [], set()
-        for i in range(len(init_dense)):
-            key = tuple(fps[i])
-            if key not in seen:
-                seen.add(key)
-                keep.append(i)
-        init_batch = {k: v[keep] for k, v in init_batch.items()}
-        self._init_states = [init_states[i] for i in keep]
-        n0 = len(keep)
-        table, _, _ = insert_batch(
-            table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
-        fp_count = n0
-        # host trace store: gid -> (parent gid, action, param)
-        self._h_parent = [np.full(n0, -1, np.int64)]
-        self._h_action = [np.full(n0, -1, np.int32)]
-        self._h_param = [np.zeros(n0, np.int32)]
-        for i in range(n0):
-            bad = spec.check_invariants(self._init_states[i])
-            if bad:
-                res.ok = False
-                res.violated_invariant = bad
-                res.trace = self._trace(i)
-                return self._finish(res, t0, 0, fp_count)
-        res.states_generated += len(init_dense)
+        if resume_from is not None:
+            # --- resume from a level-boundary snapshot ----------------
+            from .checkpoint import load_checkpoint
+            ck = load_checkpoint(resume_from)
+            if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
+                    list(ck["expand_mults"]) != list(self.expand_mults):
+                self.expand_mults = list(ck["expand_mults"])
+                self._build(ck["max_msgs"])
+                codec = self.codec
+            table = {"slots": jnp.asarray(ck["slots"])}
+            fp_cap = int(ck["slots"].shape[0])
+            self._init_dense = ck["init_dense"]
+            self._init_states = [codec.decode(d)
+                                 for d in ck["init_dense"]]
+            self._h_parent = [ck["h_parent"]]
+            self._h_action = [ck["h_action"]]
+            self._h_param = [ck["h_param"]]
+            self.level_sizes = list(ck["level_sizes"])
+            depth = ck["depth"]
+            fp_count = ck["fp_count"]
+            res.states_generated = ck["states_generated"]
+            t0 -= ck["elapsed"]            # keep cumulative wall clock
+            n_front = ck["n_front"]
+            f_cap = max(self.next_cap, n_front)
+            front, fpar, fact, fprm = self._alloc_bufs(f_cap)
+            front = {k: front[k].at[:n_front].set(
+                jnp.asarray(ck["frontier"][k])) for k in front}
+            bufs = self._alloc_bufs(self.next_cap)
+            level_base = sum(self.level_sizes[:-1])
+            last_progress = time.time()
+            emit(f"resumed from {resume_from}: depth {depth}, "
+                 f"{fp_count} distinct, frontier {n_front}")
+        else:
+            fp_cap = self.fpset_capacity
+            table = empty_table(fp_cap)
 
-        # --- device frontier + next buffers ---------------------------
-        f_cap = max(self.next_cap, n0)
-        front, fpar, fact, fprm = self._alloc_bufs(f_cap)
-        front = {k: front[k].at[:n0].set(init_batch[k]) for k in front}
-        bufs = self._alloc_bufs(self.next_cap)
-        n_front = n0
-        level_base = 0          # gid of frontier[0]
-        depth = 0
-        last_progress = t0
-        self.level_sizes = [n0]
+            # --- register init states (host path, tiny) ---------------
+            init_states = list(spec.init_states())
+            init_dense = [codec.encode(st) for st in init_states]
+            init_batch = {k: np.stack([d[k] for d in init_dense])
+                          for k in init_dense[0]}
+            fps = np.asarray(self.kern.fingerprint_batch(init_batch))
+            keep, seen = [], set()
+            for i in range(len(init_dense)):
+                key = tuple(fps[i])
+                if key not in seen:
+                    seen.add(key)
+                    keep.append(i)
+            init_batch = {k: v[keep] for k, v in init_batch.items()}
+            self._init_states = [init_states[i] for i in keep]
+            self._init_dense = [init_dense[i] for i in keep]
+            n0 = len(keep)
+            table, _, _ = insert_batch(
+                table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+            fp_count = n0
+            # host trace store: gid -> (parent gid, action, param)
+            self._h_parent = [np.full(n0, -1, np.int64)]
+            self._h_action = [np.full(n0, -1, np.int32)]
+            self._h_param = [np.zeros(n0, np.int32)]
+            for i in range(n0):
+                bad = spec.check_invariants(self._init_states[i])
+                if bad:
+                    res.ok = False
+                    res.violated_invariant = bad
+                    res.trace = self._trace(i)
+                    return self._finish(res, t0, 0, fp_count)
+            res.states_generated += len(init_dense)
+
+            # --- device frontier + next buffers -----------------------
+            f_cap = max(self.next_cap, n0)
+            front, fpar, fact, fprm = self._alloc_bufs(f_cap)
+            front = {k: front[k].at[:n0].set(init_batch[k])
+                     for k in front}
+            bufs = self._alloc_bufs(self.next_cap)
+            n_front = n0
+            level_base = 0          # gid of frontier[0]
+            depth = 0
+            last_progress = t0
+            self.level_sizes = [n0]
+        last_checkpoint = time.time()
 
         while n_front > 0:
             if max_depth is not None and depth >= max_depth:
@@ -440,8 +478,17 @@ class DeviceBFS:
                     vstate = self._materialize_one(parent_dense, va, vprm)
                     bad = spec.check_invariants(
                         self.codec.decode(vstate))
+                    if bad is None:
+                        # device said violated, interpreter disagrees:
+                        # engine bug — fail loudly, don't fabricate a
+                        # counterexample (see device_sim for rationale)
+                        raise TLAError(
+                            "device/interpreter divergence: device "
+                            "invariant kernel reported a violation the "
+                            "interpreter accepts (parent gid "
+                            f"{gid}, action {ACTION_NAMES[va]})")
                     res.ok = False
-                    res.violated_invariant = bad or self.inv_names[0]
+                    res.violated_invariant = bad
                     res.trace = self._trace(gid, extra=(va, vprm))
                     res.diameter = depth
                     return self._finish(res, t0, depth, fp_count)
@@ -508,6 +555,26 @@ class DeviceBFS:
             front, bufs = nb, (front, fpar, fact, fprm)
             fpar, fact, fprm = nbp, nba, nbprm
             n_front = n_next
+            if checkpoint_path and n_next and (
+                    checkpoint_every is None
+                    or time.time() - last_checkpoint >= checkpoint_every):
+                from .checkpoint import save_checkpoint
+                save_checkpoint(
+                    checkpoint_path,
+                    slots=table["slots"], frontier=front, n_front=n_next,
+                    h_parent=np.concatenate(self._h_parent),
+                    h_action=np.concatenate(self._h_action),
+                    h_param=np.concatenate(self._h_param),
+                    init_dense=self._init_dense,
+                    level_sizes=self.level_sizes, depth=depth,
+                    fp_count=fp_count,
+                    states_generated=res.states_generated,
+                    max_msgs=self.codec.shape.MAX_MSGS,
+                    expand_mults=self.expand_mults,
+                    elapsed=time.time() - t0)
+                last_checkpoint = time.time()
+                emit(f"checkpoint written to {checkpoint_path} "
+                     f"(depth {depth}, {fp_count} distinct)")
             if stop:
                 res.error = stop
                 break

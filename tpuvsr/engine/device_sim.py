@@ -76,10 +76,28 @@ class DeviceSimulator:
                 outs.append(jax.vmap(lambda ln, g=g: g(st, ln))(lanes))
             return jnp.concatenate(outs)
 
-        branches = [lambda st, p, f=f: f(st, p)[0] for f in fns]
+        def apply_chosen(states, aid, prm):
+            """Per-walker successor for the chosen (action, param).
 
-        def apply_lane(st, aid, prm):
-            return jax.lax.switch(aid, branches, st, prm)
+            Explicit compute-all-actions + mask-select.  A vmapped
+            ``lax.switch`` lowers to the same all-branches select_n, but
+            that lowering produced wrong bag contents on the TPU backend
+            (headers lost while present/count landed — caught by the
+            interpreter-confirmation check); the hand-rolled select is
+            the same cost and lowers through plain jnp.where."""
+            out = None
+            for a, f in enumerate(fns):
+                s_a, _en = jax.vmap(f, in_axes=(0, 0))(states, prm)
+                m = aid == a
+                if out is None:
+                    out = {k: jnp.where(
+                        m.reshape((-1,) + (1,) * (v.ndim - 1)), v, states[k])
+                        for k, v in s_a.items() if not k.startswith("_")}
+                else:
+                    out = {k: jnp.where(
+                        m.reshape((-1,) + (1,) * (s_a[k].ndim - 1)),
+                        s_a[k], v) for k, v in out.items()}
+            return out
 
         def chunk_fn(states, was_alive, keys):
             def step(carry, key):
@@ -90,7 +108,7 @@ class DeviceSimulator:
                 alive = en.any(axis=1)
                 aid = lane_aid[lane]
                 prm = lane_prm[lane]
-                succ = jax.vmap(apply_lane)(states, aid, prm)
+                succ = apply_chosen(states, aid, prm)
                 sel = {k: alive.reshape((-1,) + (1,) * (v.ndim - 1))
                        for k, v in states.items()}
                 states = {k: jnp.where(sel[k], succ[k], v)
@@ -202,8 +220,21 @@ class DeviceSimulator:
                     w, ds = int(bad[0]), int(bad[1])
                     res.ok = False
                     res.trace = self._replay(init, hists, w, d + ds + 1)
-                    res.violated_invariant = spec.check_invariants(
-                        res.trace[-1].state) or self.inv_names[0]
+                    confirmed = spec.check_invariants(res.trace[-1].state)
+                    if confirmed is None:
+                        # The device invariant kernel flagged a state the
+                        # interpreter (the semantic oracle) accepts: an
+                        # engine bug, never a spec violation — fail loudly
+                        # rather than emit a bogus counterexample.
+                        from ..core.values import TLAError
+                        err = TLAError(
+                            "device/interpreter divergence: device "
+                            "invariant kernel reported a violation at "
+                            f"walker {w} depth {d + ds + 1}, but the "
+                            "interpreter accepts the replayed state")
+                        err.trace = res.trace
+                        raise err
+                    res.violated_invariant = confirmed
                     res.elapsed = time.time() - t0
                     return res
                 states, was_alive = nstates, alive

@@ -1,0 +1,56 @@
+"""Model registry: which reference modules have a compiled device
+kernel, and how to build one from a bound spec.
+
+The engines (device_bfs, device_sim, sharded_bfs) are kernel-agnostic:
+they consume the kernel interface (action_names, lane tables, guard/
+action fns, step_all, fingerprint*, invariant_fn) and the codec
+interface (encode/decode/zero_state/pad_msgs/MSG_KEYS/shape).  This
+module is the one place that maps a module name to an implementation —
+the hand-written kernels today, the ``lower/`` IR pipeline when specs
+gain generated kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def value_perm_table(spec, codec):
+    """spec.symmetry_perms (ModelValue maps) -> [P, V+1] id table with
+    the identity first (kernels take the min over rows)."""
+    V = codec.shape.V
+    rows = [np.arange(V + 1, dtype=np.int32)]
+    for p in spec.symmetry_perms:
+        row = np.arange(V + 1, dtype=np.int32)
+        for mv_from, mv_to in p.items():
+            row[codec.value_id[mv_from]] = codec.value_id[mv_to]
+        rows.append(row)
+    return np.stack(rows)
+
+
+def has_device_model(spec) -> bool:
+    """True if a compiled device kernel exists for this module."""
+    try:
+        _resolve(spec.module.name)
+        return True
+    except KeyError:
+        return False
+
+
+def make_model(spec, max_msgs=None):
+    """Build (codec, kernel) for a bound spec."""
+    codec_cls, kern_cls = _resolve(spec.module.name)
+    codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
+    return codec, kern_cls(codec, perms=value_perm_table(spec, codec))
+
+
+def _resolve(name):
+    if name == "VSR":
+        from .vsr import VSRCodec
+        from .vsr_kernel import VSRKernel
+        return VSRCodec, VSRKernel
+    if name == "VR_STATE_TRANSFER":
+        from .st03 import ST03Codec
+        from .st03_kernel import ST03Kernel
+        return ST03Codec, ST03Kernel
+    raise KeyError(name)

@@ -142,3 +142,535 @@ def make_sharded_tables(mesh, axis, capacity_per_device):
     tabs = {"slots": jnp.zeros((n, capacity_per_device, 5), U32)}
     sh = NamedSharding(mesh, P(axis))
     return jax.device_put(tabs, sh)
+
+
+# ======================================================================
+# Multi-chip BFS driver: sharded frontier run to fixpoint
+# ======================================================================
+#
+# The full distributed BFS loop (SURVEY.md §5 "distributed communication
+# backend"; BASELINE.json configs[4]).  Unlike make_sharded_expand above
+# (which keeps successor states on their producer and ships only
+# fingerprints + a verdict round-trip), the driver routes each fresh
+# successor STATE to the device that owns its fingerprint, in the same
+# single all_to_all as the fingerprint itself:
+#
+#   * the frontier is hash-partitioned: state S lives on device
+#     route(fp(S)) % D — so load stays balanced for free and dedup,
+#     storage, and the next level's expansion of S are all owner-local;
+#   * per tile: expand all lanes -> fingerprint -> invariant -> local
+#     dedup -> bucket (state + parent gid + action + param) by owner ->
+#     ONE all_to_all -> owner inserts into its FPSet shard and scatters
+#     the fresh rows straight into its next-frontier buffer;
+#   * abort protocol: a tile commits nothing unless every device agrees
+#     — sender-side flags (violation, bag overflow, layout slot error,
+#     bucket overflow) are psum'd BEFORE the exchange, receiver-side
+#     capacity (next-buffer headroom) is psum'd AFTER the exchange but
+#     before any insert; on abort the level pauses with a reason code,
+#     the host grows the relevant structure and re-enters the tile.
+#     Within a committed tile, insert and scatter are atomic per lane
+#     (claim-based insert: the lane that wins the slot is the one whose
+#     row is scattered), so re-entry after an in-insert FPSet probe
+#     overflow loses nothing: winners dedup on re-run, losers get a
+#     bigger table.
+#
+# Trace pointers (parent gid, action, lane param) ride with the state
+# rows; the host keeps only those per level (10 B/state) and
+# reconstructs counterexamples by replaying the recorded action chain —
+# exactly the single-device DeviceBFS protocol.
+
+RUNNING = 0
+R_VIOLATION = 2
+R_BAG_GROW = 3
+R_FPSET_GROW = 4
+R_NEXT_GROW = 5
+R_SLOT_ERR = 6
+R_BUCKET_GROW = 8
+
+
+def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
+                       tile: int, bucket_cap: int):
+    """Build the jitted one-tile sharded BFS step.
+
+    step(tables, frontier, n_front, nb, nbp, nba, nbprm, nn, base_gid)
+      -> (tables, nb, nbp, nba, nbprm, nn, reason, viol, gen, dist,
+          fatal)
+    Every array is sharded over `axis`; scalars come back as [D] arrays
+    (one per device; identical where globally agreed)."""
+    n_dev = mesh.shape[axis]
+    L = kern.n_lanes
+    T = tile
+    lane_aid = jnp.asarray(kern.lane_action)
+    lane_prm = jnp.asarray(kern.lane_param)
+    from ..models.vsr import ERR_BAG_OVERFLOW
+
+    def step_shard(tables, frontier, n_front, start_t,
+                   nb, nbp, nba, nbprm, nn0, base_gid):
+        tables = {k: v[0] for k, v in tables.items()}
+        N = nbp.shape[0]
+        n_loc = n_front[0]
+        n_max = jax.lax.pmax(n_loc, axis)
+        n_tiles = (n_max + T - 1) // T
+
+        def cond(c):
+            return (c["t"] < n_tiles) & (c["reason"] == RUNNING)
+
+        def body(c):
+            slots = c["slots"]
+            nb, nbp, nba, nbprm = c["nb"], c["nbp"], c["nba"], c["nbprm"]
+            nn = c["nn"]
+            t = c["t"]
+            base = t * T
+            sidx = base + jnp.arange(T, dtype=jnp.int32)
+            valid = sidx < n_loc
+            tile_st = {k: v[jnp.clip(sidx, 0, v.shape[0] - 1)]
+                       for k, v in frontier.items()}
+            succs, en = jax.vmap(kern.step_all)(tile_st)
+            en = en & valid[:, None]
+            flat = {k: v.reshape((T * L,) + v.shape[2:])
+                    for k, v in succs.items()}
+            en_f = en.reshape(-1)
+            n_en = en_f.sum()
+            fps = jax.vmap(kern.fingerprint)(flat)
+            iok = jax.vmap(inv_fn)(flat)
+            errv = jnp.where(en_f, flat["err"], 0)
+            viol_l = en_f & ~iok & (errv == 0)
+            bag_err = ((errv & ERR_BAG_OVERFLOW) != 0).any()
+            slot_err = ((errv & ~ERR_BAG_OVERFLOW) != 0).any()
+
+            # first violating lane, as (parent gid, action, param)
+            vidx = jnp.argmax(viol_l)
+            vinfo = jnp.stack([
+                base_gid[0] + base + (vidx // L).astype(jnp.int32),
+                lane_aid[vidx], lane_prm[vidx]])
+            viol = jnp.where(viol_l.any() & (c["viol"][0] < 0), vinfo,
+                             c["viol"])
+
+            # local dedup, ownership bucketing (state + meta ride along)
+            perm, cand = dedup_batch(fps, en_f)
+            fps_s = fps[perm]
+            owner = (route(fps_s) % jnp.uint32(n_dev)).astype(jnp.int32)
+            meta_p = base_gid[0] + (perm // L).astype(jnp.int32) + base
+            meta_a = lane_aid[perm]
+            meta_m = lane_prm[perm]
+
+            cap = bucket_cap
+            b_fps = jnp.zeros((n_dev, cap, 4), U32)
+            b_mask = jnp.zeros((n_dev, cap), bool)
+            b_p = jnp.zeros((n_dev, cap), jnp.int32)
+            b_a = jnp.zeros((n_dev, cap), jnp.int32)
+            b_m = jnp.zeros((n_dev, cap), jnp.int32)
+            b_st = {k: jnp.zeros((n_dev, cap) + v.shape[1:], v.dtype)
+                    for k, v in flat.items()}
+            ovf_b = jnp.asarray(False)
+            for d in range(n_dev):
+                m = cand & (owner == d)
+                pos = jnp.cumsum(m) - 1
+                ovf_b = ovf_b | ((pos[-1] + 1 > cap) & m.any())
+                idx = jnp.where(m & (pos < cap), pos, cap)
+                b_fps = b_fps.at[d, idx].set(fps_s, mode="drop")
+                b_mask = b_mask.at[d, idx].set(m, mode="drop")
+                b_p = b_p.at[d, idx].set(meta_p, mode="drop")
+                b_a = b_a.at[d, idx].set(meta_a, mode="drop")
+                b_m = b_m.at[d, idx].set(meta_m, mode="drop")
+                for k in b_st:
+                    b_st[k] = b_st[k].at[d, idx].set(
+                        flat[k][perm], mode="drop")
+
+            # global pre-exchange abort vote
+            flags = jnp.stack([viol_l.any(), bag_err, slot_err, ovf_b]
+                              ).astype(jnp.int32)
+            gflags = jax.lax.psum(flags, axis) > 0
+            abort_pre = gflags.any()
+
+            # ONE exchange moves fingerprints + states + trace meta
+            a2a = lambda x: jax.lax.all_to_all(x, axis, 0, 0, tiled=False)
+            i_fps = a2a(b_fps).reshape(n_dev * cap, 4)
+            i_mask = a2a(b_mask).reshape(n_dev * cap)
+            i_p = a2a(b_p).reshape(n_dev * cap)
+            i_a = a2a(b_a).reshape(n_dev * cap)
+            i_m = a2a(b_m).reshape(n_dev * cap)
+            i_st = {k: a2a(v).reshape((n_dev * cap,) + v.shape[2:])
+                    for k, v in b_st.items()}
+
+            # receiver-side capacity vote (cross-sender dedup can only
+            # shrink the count, so this bound is safe)
+            perm2, cand2 = dedup_batch(i_fps, i_mask)
+            n_inc = cand2.sum()
+            room = (N - nn) >= n_inc
+            abort_room = jax.lax.psum(
+                (~room).astype(jnp.int32), axis) > 0
+            commit = ~abort_pre & ~abort_room
+
+            new_tab, fresh, probe_ovf = insert_core(
+                tables, i_fps[perm2], cand2 & commit)
+            slots2 = new_tab["slots"]
+            dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1, N
+                             ).astype(jnp.int32)
+            src = perm2
+            for k in nb:
+                nb[k] = nb[k].at[dest].set(i_st[k][src], mode="drop")
+            nbp = nbp.at[dest].set(i_p[src], mode="drop")
+            nba = nba.at[dest].set(i_a[src], mode="drop")
+            nbprm = nbprm.at[dest].set(i_m[src], mode="drop")
+            n_fresh = fresh.sum()
+
+            # committed-but-unresolved probes pause the level for table
+            # growth; resolved lanes landed atomically so re-entry of
+            # the same tile only re-dedups them (nothing lost)
+            g_povf = jax.lax.psum(
+                (commit & probe_ovf).astype(jnp.int32), axis) > 0
+            reason = jnp.where(
+                gflags[0], R_VIOLATION,
+                jnp.where(gflags[2], R_SLOT_ERR,
+                          jnp.where(gflags[1], R_BAG_GROW,
+                                    jnp.where(gflags[3], R_BUCKET_GROW,
+                                              jnp.where(abort_room,
+                                                        R_NEXT_GROW,
+                                                        RUNNING)))))
+            reason = jnp.where((reason == RUNNING) & g_povf,
+                               R_FPSET_GROW, reason)
+            return {
+                "t": jnp.where(commit & ~g_povf, t + 1, t),
+                "reason": jnp.where(c["reason"] == RUNNING, reason,
+                                    c["reason"]),
+                "viol": viol,
+                "slots": slots2,
+                "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
+                "nn": nn + jnp.where(commit, n_fresh, 0),
+                "gen": c["gen"] + jnp.where(commit & ~g_povf, n_en, 0),
+            }
+
+        init = {
+            "t": start_t[0],
+            "reason": jnp.asarray(RUNNING, jnp.int32),
+            "viol": jnp.full((3,), -1, jnp.int32),
+            "slots": tables["slots"],
+            "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
+            "nn": nn0[0],
+            "gen": jnp.asarray(0, jnp.int32),
+        }
+        out = jax.lax.while_loop(cond, body, init)
+        one = lambda x: x[None]
+        return ({"slots": out["slots"][None]},
+                out["nb"], out["nbp"], out["nba"], out["nbprm"],
+                one(out["nn"]), one(out["t"]), one(out["reason"]),
+                out["viol"][None], one(out["gen"]))
+
+    sp = P(axis)
+    step = jax.jit(jax.shard_map(
+        step_shard, mesh=mesh,
+        in_specs=(sp,) * 10,
+        out_specs=(sp,) * 10,
+        check_vma=False))
+    return step
+
+
+class ShardedBFS:
+    """Host driver: run the sharded level kernel to fixpoint.
+
+    The multi-chip counterpart of engine.device_bfs.DeviceBFS — same
+    pause/grow/re-enter protocol, same host-side trace-pointer store and
+    replay-based counterexample reconstruction; the frontier and the
+    fingerprint set are hash-partitioned over the mesh axis and states
+    migrate to their owner in the in-level all_to_all."""
+
+    def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
+                 tile=32, bucket_cap=512, next_capacity=1 << 12,
+                 fpset_capacity=1 << 14):
+        from ..engine.device_bfs import _value_perm_table
+        from ..models.vsr import VSRCodec
+        from ..models.vsr_kernel import VSRKernel
+        self.spec = spec
+        self.mesh = mesh
+        self.axis = axis
+        self.D = mesh.shape[axis]
+        self.tile = tile
+        self.bucket_cap = bucket_cap
+        self.N = next_capacity          # per-device frontier capacity
+        self.fp_cap = fpset_capacity    # per-device FPSet slots
+        self.inv_names = list(spec.cfg.invariants)
+        self._mat = {}
+        self._codec_ctor = lambda mm: VSRCodec(spec.ev.constants,
+                                               max_msgs=mm)
+        self._kern_ctor = lambda codec: VSRKernel(
+            codec, perms=_value_perm_table(spec, codec))
+        self._build(max_msgs)
+
+    def _build(self, max_msgs):
+        self.codec = self._codec_ctor(max_msgs)
+        self.kern = self._kern_ctor(self.codec)
+        self._inv = self.kern.invariant_fn(self.inv_names)
+        self._mat = {}
+        self._step = make_sharded_level(self.kern, self._inv, self.mesh,
+                                        self.axis, self.tile,
+                                        self.bucket_cap)
+        self._sh = NamedSharding(self.mesh, P(self.axis))
+
+    # borrowed single-device helpers (same attribute contract)
+    from ..engine.device_bfs import DeviceBFS as _DB
+    _materialize_one = _DB._materialize_one
+    _trace = _DB._trace
+    _fetch_row = _DB._fetch_row
+    del _DB
+
+    def _put(self, arr):
+        return jax.device_put(arr, self._sh)
+
+    def _alloc_frontier(self, cap):
+        zero = self.codec.zero_state()
+        D = self.D
+        nb = {k: self._put(np.zeros((D * cap,) + np.shape(v), np.int32))
+              for k, v in zero.items()}
+        z = lambda: self._put(np.zeros((D * cap,), np.int32))
+        return nb, z(), z(), z()
+
+    def _pull_rows(self, garr, counts):
+        """Gather per-device live rows of a [D*cap, ...] global array."""
+        cap = garr.shape[0] // self.D
+        host = np.asarray(garr)
+        return np.concatenate(
+            [host[d * cap:d * cap + int(counts[d])]
+             for d in range(self.D)], axis=0)
+
+    def _grow_global(self, garr, old_cap, new_cap):
+        host = np.asarray(garr)
+        D = self.D
+        host = host.reshape((D, old_cap) + host.shape[1:])
+        pad = np.zeros((D, new_cap - old_cap) + host.shape[2:],
+                       host.dtype)
+        out = np.concatenate([host, pad], axis=1)
+        return self._put(out.reshape((D * new_cap,) + host.shape[2:]))
+
+    def run(self, max_depth=None, max_states=None, max_seconds=None,
+            log=None) -> "CheckResult":
+        import time as _time
+        from ..core.values import TLAError
+        from ..engine.bfs import CheckResult
+        from ..engine.fpset import grow as fp_grow
+        from ..models.vsr_kernel import ACTION_NAMES
+        spec, codec = self.spec, self.codec
+        D = self.D
+        res = CheckResult()
+        t0 = _time.time()
+
+        def emit(msg):
+            if log:
+                log(msg)
+
+        tables = make_sharded_tables(self.mesh, self.axis, self.fp_cap)
+        sharded_ins = make_sharded_insert(self.mesh, self.axis)
+
+        # --- init states: dedup, assign to owner devices --------------
+        init_states = list(spec.init_states())
+        dense = [codec.encode(st) for st in init_states]
+        batch = {k: np.stack([d[k] for d in dense]) for k in dense[0]}
+        fps = np.asarray(self.kern.fingerprint_batch(batch))
+        keep, seen = [], set()
+        for i in range(len(dense)):
+            t = tuple(fps[i])
+            if t not in seen:
+                seen.add(t)
+                keep.append(i)
+        owners = (np.asarray(route(jnp.asarray(fps[keep])))
+                  % np.uint32(D)).astype(int)
+        order = np.argsort(owners, kind="stable")
+        keep = [keep[i] for i in order]
+        owners = owners[order]
+        self._init_states = [init_states[i] for i in keep]
+        n0 = len(keep)
+        counts0 = np.bincount(owners, minlength=D)
+
+        F = self.N
+        front, _p0, _a0, _m0 = self._alloc_frontier(F)
+        self._dev_distinct = counts0.astype(np.int64).copy()
+        host_front = {k: np.array(v) for k, v in front.items()}
+        pos = 0
+        for d in range(D):
+            for j in range(int(counts0[d])):
+                row = dense[keep[pos]]
+                for k in host_front:
+                    host_front[k][d * F + j] = row[k]
+                pos += 1
+        front = {k: self._put(v) for k, v in host_front.items()}
+        n_front = self._put(counts0.astype(np.int32))
+        tables, _fr, ovf = sharded_ins(
+            tables, jnp.asarray(fps[keep]),
+            jnp.ones((n0,), bool))
+        assert not bool(np.asarray(ovf).any())
+        fp_count = n0
+
+        self._h_parent = [np.full(n0, -1, np.int64)]
+        self._h_action = [np.full(n0, -1, np.int32)]
+        self._h_param = [np.zeros(n0, np.int32)]
+        self.level_sizes = [n0]
+        base_dev = np.concatenate([[0], np.cumsum(counts0)[:-1]])
+        for i, st in enumerate(self._init_states):
+            bad = spec.check_invariants(st)
+            if bad:
+                res.ok = False
+                res.violated_invariant = bad
+                res.trace = self._trace(i)
+                return self._finish(res, t0, 0, fp_count)
+        res.states_generated += len(dense)
+
+        depth = 0
+        last_progress = t0
+        while int(np.asarray(n_front).sum()) > 0:
+            if max_depth is not None and depth >= max_depth:
+                res.error = f"depth limit {max_depth} reached"
+                break
+            depth += 1
+            nb, nbp, nba, nbprm = self._alloc_frontier(self.N)
+            nn = self._put(np.zeros(D, np.int32))
+            start_t = self._put(np.zeros(D, np.int32))
+            base_gid = self._put(base_dev.astype(np.int32))
+            while True:
+                (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
+                 viol_out, gen_out) = self._step(
+                    tables, front, n_front, start_t,
+                    nb, nbp, nba, nbprm, nn, base_gid)
+                reason = int(np.asarray(reason_out)[0])
+                start_t = t_out
+                if reason == RUNNING:
+                    break
+                if reason == R_VIOLATION:
+                    vrows = np.asarray(viol_out)
+                    sel = vrows[vrows[:, 0] >= 0][0]
+                    gid, va, vprm = (int(x) for x in sel)
+                    res.ok = False
+                    res.trace = self._trace(gid, extra=(va, vprm))
+                    bad = spec.check_invariants(res.trace[-1].state)
+                    if bad is None:
+                        raise TLAError(
+                            "device/interpreter divergence in sharded "
+                            "BFS: interpreter accepts the replayed "
+                            f"violation state (action {ACTION_NAMES[va]})")
+                    res.violated_invariant = bad
+                    res.diameter = depth
+                    return self._finish(res, t0, depth, fp_count)
+                if reason == R_SLOT_ERR:
+                    raise TLAError(
+                        "dense-layout slot collision in sharded BFS "
+                        "(see models/vsr.py docstring)")
+                if reason == R_BAG_GROW:
+                    old = self.codec.shape.MAX_MSGS
+                    self._build(old * 2)
+
+                    # pad the message-table axis of every state array
+                    def pad_msgs_global(g_dict, cap):
+                        host = {k: np.asarray(v).reshape(
+                            (D, cap) + v.shape[1:])
+                            for k, v in g_dict.items()}
+                        out = {}
+                        for k, v in host.items():
+                            if k in self.codec.MSG_KEYS:
+                                shape = list(v.shape)
+                                shape[2] = (self.codec.shape.MAX_MSGS
+                                            - old)
+                                v = np.concatenate(
+                                    [v, np.zeros(shape, v.dtype)],
+                                    axis=2)
+                            out[k] = self._put(v.reshape(
+                                (D * cap,) + v.shape[2:]))
+                        return out
+                    front = pad_msgs_global(front, F)
+                    nb = pad_msgs_global(nb, self.N)
+                    emit(f"message table grown to "
+                         f"{self.codec.shape.MAX_MSGS} (recompiling)")
+                elif reason == R_BUCKET_GROW:
+                    self.bucket_cap *= 2
+                    self._step = make_sharded_level(
+                        self.kern, self._inv, self.mesh, self.axis,
+                        self.tile, self.bucket_cap)
+                    emit(f"exchange bucket grown to {self.bucket_cap} "
+                         f"(recompiling)")
+                elif reason == R_NEXT_GROW:
+                    new_n = self.N * 2
+                    nb = {k: self._grow_global(v, self.N, new_n)
+                          for k, v in nb.items()}
+                    nbp = self._grow_global(nbp, self.N, new_n)
+                    nba = self._grow_global(nba, self.N, new_n)
+                    nbprm = self._grow_global(nbprm, self.N, new_n)
+                    self.N = new_n
+                    emit(f"next-frontier grown to {new_n}/device")
+                elif reason == R_FPSET_GROW:
+                    slots = np.asarray(tables["slots"])
+                    grown = [fp_grow({"slots": jnp.asarray(slots[d])}
+                                     )["slots"] for d in range(D)]
+                    self.fp_cap = int(grown[0].shape[0])
+                    tables = {"slots": self._put(np.stack(
+                        [np.asarray(g) for g in grown]))}
+                    emit(f"FPSet shards grown to {self.fp_cap}/device")
+                else:
+                    raise TLAError(f"unknown sharded reason {reason}")
+
+            nn_h = np.asarray(nn)
+            gen_h = int(np.asarray(gen_out).sum())
+            res.states_generated += gen_h
+            n_next = int(nn_h.sum())
+            fp_count += n_next
+            if n_next:
+                self._h_parent.append(
+                    self._pull_rows(nbp, nn_h).astype(np.int64))
+                self._h_action.append(self._pull_rows(nba, nn_h))
+                self._h_param.append(self._pull_rows(nbprm, nn_h))
+                self.level_sizes.append(n_next)
+                self._dev_distinct += nn_h
+            # gid bases of the new frontier (device-order concatenation)
+            base_dev = (sum(self.level_sizes[:-1])
+                        + np.concatenate([[0], np.cumsum(nn_h)[:-1]]))
+            front = nb
+            F = self.N
+            n_front = nn
+
+            now = _time.time()
+            if now - last_progress >= 10.0 and log:
+                last_progress = now
+                emit(f"depth {depth}: {fp_count} distinct, "
+                     f"{res.states_generated} generated, "
+                     f"{fp_count / (now - t0):.0f} distinct/s")
+            if max_seconds and now - t0 > max_seconds:
+                res.error = f"time budget {max_seconds}s reached"
+                break
+            if max_states and fp_count >= max_states:
+                res.error = f"state limit {max_states} reached"
+                break
+            # proactive shard growth keeps in-level probe overflow rare
+            if self._dev_distinct.max() > 0.4 * self.fp_cap:
+                slots = np.asarray(tables["slots"])
+                grown = [fp_grow({"slots": jnp.asarray(slots[d])}
+                                 )["slots"] for d in range(D)]
+                self.fp_cap = int(grown[0].shape[0])
+                tables = {"slots": self._put(np.stack(
+                    [np.asarray(g) for g in grown]))}
+                emit(f"FPSet shards grown to {self.fp_cap}/device")
+
+        res.diameter = depth
+        return self._finish(res, t0, depth, fp_count)
+
+    @staticmethod
+    def _finish(res, t0, depth, fp_count):
+        import time as _time
+        res.distinct_states = fp_count
+        res.elapsed = _time.time() - t0
+        return res
+
+
+def make_sharded_insert(mesh: Mesh, axis: str):
+    """Insert a replicated fingerprint batch into the owning shards
+    (used to register init states)."""
+    n_dev = mesh.shape[axis]
+
+    def ins(tables, fps, mask):
+        tables = {k: v[0] for k, v in tables.items()}
+        me = jax.lax.axis_index(axis)
+        mine = mask & ((route(fps) % jnp.uint32(n_dev)).astype(jnp.int32)
+                       == me)
+        tables, fresh, ovf = insert_core(tables, fps, mine)
+        return ({k: v[None] for k, v in tables.items()},
+                jnp.asarray([fresh.sum()]), jnp.asarray([ovf]))
+
+    return jax.jit(jax.shard_map(
+        ins, mesh=mesh, in_specs=(P(axis), P(), P()),
+        out_specs=(P(axis), P(axis), P(axis)), check_vma=False))
