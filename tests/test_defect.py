@@ -1,0 +1,131 @@
+"""The acceptance oracle: the state-transfer data-loss defect.
+
+The reference records a 24-state counterexample
+(state_transfer_violation_trace.txt) of `AcknowledgedWriteNotLost`
+(VSR.tla:945-950) under the defect fixture constants (README:13-18;
+examples/VSR_defect.cfg): an acked value is lost when `SendGetState`'s
+truncation (VSR.tla:491-516) interleaves with a view change and the
+final `ReceiveSV` (VSR.tla:773-793) installs an empty log on every
+replica.  These tests replay that recorded trace through (a) the
+interpreter's successor enumeration and (b) the dense device kernel,
+asserting both reproduce the violation exactly — the framework's
+semantics-level regression oracle for the defect.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.core.values import ModelValue
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.frontend.trace_parse import parse_trace_file, replay_trace
+
+pytestmark = requires_reference
+
+TRACE = "/root/reference/state_transfer_violation_trace.txt"
+DEFECT_CFG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "VSR_defect.cfg")
+
+
+@pytest.fixture(scope="module")
+def defect_spec():
+    mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+    cfg = parse_cfg_file(DEFECT_CFG)
+    return SpecModel(mod, cfg)
+
+
+@pytest.fixture(scope="module")
+def golden(defect_spec):
+    entries = parse_trace_file(TRACE, defect_spec)
+    states = replay_trace(defect_spec, entries)
+    return entries, states
+
+
+def test_golden_trace_parses(defect_spec, golden):
+    entries, _ = golden
+    assert len(entries) == 24
+    assert entries[0].action_name is None
+    names = [e.action_name for e in entries[1:]]
+    assert names[0] == "ReceiveClientRequest"
+    assert "SendGetState" in names          # the truncation step
+    assert names[-1] == "ReceiveSV"         # the log wipe
+    # recorded positions are 1..24
+    assert [e.position for e in entries] == list(range(1, 25))
+
+
+def test_golden_trace_replays_to_violation(defect_spec, golden):
+    """Every recorded TLC transition must be reproducible by the
+    interpreter, and the final state must violate exactly the defect
+    invariant with the recorded shape: all logs empty, v1 acked."""
+    _, states = golden
+    final = states[-1]
+    assert defect_spec.check_invariants(final) == "AcknowledgedWriteNotLost"
+    v1 = ModelValue("v1")
+    assert final["aux_client_acked"].apply(v1) is True
+    for r in sorted(final["replicas"]):
+        assert len(final["rep_log"].apply(r)) == 0
+    # the weaker invariant must also flag it
+    assert not defect_spec.eval_predicate(
+        "AcknowledgedWritesExistOnMajority", final)
+    # ... and every intermediate state must satisfy the invariant (the
+    # violation appears only at the last step)
+    for st in states[:-1]:
+        assert defect_spec.check_invariants(st) is None
+
+
+@pytest.mark.slow
+def test_golden_trace_device_kernel_confirms(defect_spec, golden):
+    """Walk the dense device kernel along the same 23 actions: at every
+    step some enabled lane of the recorded action must produce exactly
+    the recorded successor, and the device invariant kernel must flag
+    the final state."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuvsr.engine.device_bfs import _value_perm_table
+    from tpuvsr.models.vsr import VSRCodec
+    from tpuvsr.models.vsr_kernel import ACTION_NAMES, VSRKernel
+
+    entries, states = golden
+    codec = VSRCodec(defect_spec.ev.constants, max_msgs=48)
+    kern = VSRKernel(codec, perms=_value_perm_table(defect_spec, codec))
+    fns = kern._action_fns()
+    lane_aid = np.asarray(kern.lane_action)
+    lane_prm = np.asarray(kern.lane_param)
+    batched = {}
+
+    def apply_all(aid, dense):
+        fn = batched.get(aid)
+        if fn is None:
+            fn = jax.jit(jax.vmap(fns[aid], in_axes=(None, 0)))
+            batched[aid] = fn
+        prms = jnp.asarray(lane_prm[lane_aid == aid])
+        return fn(dense, prms)
+
+    cur = codec.encode(states[0])
+    for e, target in zip(entries[1:], states[1:]):
+        aid = ACTION_NAMES.index(e.action_name)
+        dense = {k: jnp.asarray(v) for k, v in cur.items()}
+        succ, en = apply_all(aid, dense)
+        en = np.asarray(en)
+        found = None
+        for i in np.nonzero(en)[0]:
+            cand = {k: np.asarray(v[i]) for k, v in succ.items()
+                    if not k.startswith("_")}
+            if codec.decode(cand) == target:
+                found = cand
+                break
+        assert found is not None, \
+            f"device kernel: no {e.action_name} lane reproduces " \
+            f"trace position {e.position}"
+        cur = found
+
+    inv = jax.jit(kern.invariant_fn(["AcknowledgedWriteNotLost"]))
+    assert not bool(inv({k: jnp.asarray(v) for k, v in cur.items()}))
+    # and a non-defect state (init) passes
+    init = codec.encode(states[0])
+    assert bool(inv({k: jnp.asarray(v) for k, v in init.items()}))
