@@ -80,18 +80,17 @@ def _pick_engine(requested, fpset, spec):
         return "interp"
     if requested != "auto":
         return requested
-    # the compiled device kernel covers the root VSR module (C=1);
-    # everything else runs on the interpreter
-    if spec.module.name == "VSR" and \
-            spec.ev.constants.get("ClientCount") == 1:
-        return "device"
-    return "interp"
+    # modules with a compiled device kernel (models/registry.py) run on
+    # the device engine; everything else on the interpreter
+    from ..models.registry import has_device_model
+    return "device" if has_device_model(spec) else "interp"
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
     from ..engine.spec import load_spec
     from ..engine.trace import format_trace
+    from ..platform_select import ensure_backend
 
     cfg_path = args.config or os.path.splitext(args.spec)[0] + ".cfg"
     spec = load_spec(args.spec, cfg_path)
@@ -101,6 +100,9 @@ def main(argv=None):
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
 
+    if engine == "device":
+        backend = ensure_backend(log)
+        log(f"backend: {backend}")
     log(f"spec {spec.module.name}, engine {engine}, "
         f"{'simulation' if args.simulate else 'BFS'}")
 
@@ -131,8 +133,13 @@ def main(argv=None):
                 check_deadlock=args.deadlock, log=log,
                 checkpoint_path=(ckpt_dir if args.checkpoint or
                                  args.recover else None),
+                # checkpoint_every=None means "every level boundary";
+                # a resumed run without an explicit -checkpoint gets
+                # TLC's default 30-minute cadence instead of an
+                # unrequested full snapshot per level
                 checkpoint_every=(args.checkpoint * 60.0
-                                  if args.checkpoint else None),
+                                  if args.checkpoint else
+                                  30 * 60.0 if args.recover else None),
                 resume_from=args.recover)
         else:
             if args.checkpoint or args.recover:

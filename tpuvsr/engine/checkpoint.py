@@ -5,27 +5,58 @@ preemption, the analog of TLC's queue/FPSet checkpointing implied by
 the reference's 500 GB multi-day guidance (README:20).
 
 A checkpoint is one directory holding .npz payloads plus a JSON
-manifest, written atomically (tmp dir + rename) so a crash mid-write
-leaves the previous checkpoint intact.  Level boundaries are the one
-clean point of the device engine: the next-frontier buffers are empty,
-so the snapshot is exactly (FPSet, frontier, trace pointers, counters).
+manifest, written atomically: the new snapshot is staged in a tmp dir,
+the previous checkpoint is renamed aside to ``<path>.old`` (rename is
+instant, unlike the rmtree of a multi-GB snapshot), the tmp dir is
+renamed into place, and only then is ``.old`` deleted — so a crash or
+preemption at any point leaves either the previous or the new snapshot
+loadable (``load_checkpoint`` falls back to ``.old``).  Level
+boundaries are the one clean point of the device engine: the
+next-frontier buffers are empty, so the snapshot is exactly (FPSet,
+frontier, trace pointers, counters).
+
+The manifest records a digest of the spec identity (module name,
+constants, invariants, view/symmetry) so ``-recover`` with a mismatched
+spec or .cfg is rejected instead of silently resuming with
+incompatible fingerprints (TLC likewise errors on recover mismatch).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 
 import numpy as np
 
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3
+
+
+def spec_digest(spec) -> str:
+    """Stable identity of (module, constants, invariants, view,
+    symmetry) for recover-mismatch detection."""
+    from ..core.values import fmt
+    parts = [spec.module.name]
+    for name in sorted(spec.ev.constants):
+        parts.append(f"{name}={fmt(spec.ev.constants[name])}")
+    parts.append("inv:" + ",".join(sorted(spec.cfg.invariants)))
+    parts.append(f"view:{spec.cfg.view}")
+    # the full permutation content, not just on/off: resuming under a
+    # different SYMMETRY set means a different canonicalization and an
+    # incompatible fingerprint space
+    perms = sorted(
+        ",".join(f"{fmt(a)}>{fmt(b)}" for a, b in sorted(
+            p.items(), key=lambda kv: fmt(kv[0])))
+        for p in spec.symmetry_perms)
+    parts.append("symm:" + ";".join(perms))
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
-                    elapsed):
+                    elapsed, digest=None):
     """Write a complete engine snapshot to `path` (atomic).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
@@ -57,22 +88,46 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         "max_msgs": int(max_msgs),
         "expand_mults": [int(x) for x in expand_mults],
         "elapsed": float(elapsed),
+        "spec_digest": digest,
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
+    old = path + ".old"
+    if os.path.isdir(old):
+        shutil.rmtree(old)
     if os.path.isdir(path):
-        shutil.rmtree(path)
+        os.rename(path, old)
     os.rename(tmp, path)
+    if os.path.isdir(old):
+        shutil.rmtree(old)
 
 
-def load_checkpoint(path):
-    """Read a snapshot; returns a dict mirroring save_checkpoint."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+def load_checkpoint(path, expect_digest=None):
+    """Read a snapshot; returns a dict mirroring save_checkpoint.
+
+    Falls back to ``<path>.old`` when the primary is missing or
+    unreadable (a crash between the rename-aside and rename-into-place
+    of ``save_checkpoint``)."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        old = path + ".old"
+        if not os.path.isdir(old):
+            raise
+        path = old
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
     if manifest["format"] != FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {manifest['format']} unsupported "
             f"(want {FORMAT_VERSION})")
+    if expect_digest is not None and manifest.get("spec_digest") and \
+            manifest["spec_digest"] != expect_digest:
+        raise ValueError(
+            "checkpoint was written by a different spec/.cfg "
+            f"(digest {manifest['spec_digest']}, this run "
+            f"{expect_digest}); refusing to resume")
     fp = np.load(os.path.join(path, "fpset.npz"))
     fr = np.load(os.path.join(path, "frontier.npz"))
     tr = np.load(os.path.join(path, "trace.npz"))

@@ -1,0 +1,58 @@
+"""Backend selection guard.
+
+The image bakes ``JAX_PLATFORMS=axon`` plus a sitecustomize that
+registers the tunneled-TPU PJRT plugin whenever ``PALLAS_AXON_POOL_IPS``
+is set — and when the tunnel is down, *backend init hangs forever*,
+taking any plain-python entry point with it.  Call ``force_cpu()``
+before touching any engine module to pin the process to the CPU
+backend regardless of what sitecustomize already did; call
+``probe_tpu()`` to test the tunnel from a throwaway subprocess with a
+hard timeout (the only safe way to ask).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_backend(log=None, probe_timeout=60):
+    """Pick a live backend for this process.  CPU is honored directly;
+    anything else (explicit TPU/axon, or an unset environment where
+    JAX would autodetect an accelerator) is probed from a throwaway
+    subprocess first, falling back to CPU if backend init hangs or
+    fails (a dead tunnel hangs forever in-process).  Returns the
+    backend name in use."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu()
+        return "cpu"
+    n = probe_tpu(probe_timeout)
+    if n > 0:
+        return os.environ.get("JAX_PLATFORMS") or "autodetect"
+    if log:
+        log("accelerator backend unreachable; falling back to CPU")
+    force_cpu()
+    return "cpu-fallback"
+
+
+def probe_tpu(timeout=60):
+    """Return the number of TPU devices visible through the tunnel, or
+    0 if the probe fails/hangs (dead tunnel)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout)
+        if r.returncode == 0 and r.stdout.strip():
+            return int(r.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        pass
+    return 0
