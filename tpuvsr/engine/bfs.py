@@ -30,6 +30,7 @@ class CheckResult:
     trace: list = field(default_factory=list)
     elapsed: float = 0.0
     error: str = None
+    exchange: dict = None     # sharded-engine ICI exchange metrics
 
     @property
     def states_per_sec(self):

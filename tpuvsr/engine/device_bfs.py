@@ -47,8 +47,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.values import TLAError
-from ..models.vsr import ERR_BAG_OVERFLOW, VSRCodec
-from ..models.vsr_kernel import ACTION_NAMES, VSRKernel
+from ..models import registry
+from ..models.vsr import ERR_BAG_OVERFLOW
 from .bfs import CheckResult
 from .fpset import empty_table, grow, insert_batch, insert_core
 from .spec import SpecModel
@@ -76,17 +76,8 @@ R_SLOT_ERR = 6       # dense-layout slot collision (config limitation)
 R_DEADLOCK = 7       # a frontier state has no enabled successor
 R_EXPAND_GROW = 8    # per-action enabled-lane compaction buffer too small
 
-def _value_perm_table(spec, codec):
-    """spec.symmetry_perms (ModelValue maps) -> [P, V+1] id table with the
-    identity first (kernel takes the min over rows)."""
-    V = codec.shape.V
-    rows = [np.arange(V + 1, dtype=np.int32)]
-    for p in spec.symmetry_perms:
-        row = np.arange(V + 1, dtype=np.int32)
-        for mv_from, mv_to in p.items():
-            row[codec.value_id[mv_from]] = codec.value_id[mv_to]
-        rows.append(row)
-    return np.stack(rows)
+# Back-compat alias: the perm-table builder lives in the registry now.
+_value_perm_table = registry.value_perm_table
 
 
 class DeviceBFS:
@@ -103,18 +94,10 @@ class DeviceBFS:
         # per-action enabled-lane compaction capacity = tile * mult
         # (each action's cap auto-doubles on its own R_EXPAND_GROW;
         # pass a pre-calibrated per-action vector to skip the growth
-        # recompiles)
-        if expand_mults is not None:
-            self.expand_mults = dict(expand_mults) if isinstance(
-                expand_mults, dict) else list(expand_mults)
-            if isinstance(self.expand_mults, dict):
-                name_ix = {n: i for i, n in enumerate(ACTION_NAMES)}
-                base = [expand_mult] * len(ACTION_NAMES)
-                for n, m in self.expand_mults.items():
-                    base[name_ix[n]] = m
-                self.expand_mults = base
-        else:
-            self.expand_mults = [expand_mult] * len(ACTION_NAMES)
+        # recompiles); dict forms are resolved against the kernel's
+        # action names once the kernel exists (_build)
+        self.expand_mults = expand_mults
+        self._expand_mult_default = expand_mult
         self.inv_names = list(spec.cfg.invariants)
         self._build(max_msgs)
 
@@ -125,9 +108,17 @@ class DeviceBFS:
         """(Re)build codec, kernel, and the jitted level pass for a
         message-table bound; called again on bag growth."""
         spec = self.spec
-        self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
-        self.kern = VSRKernel(self.codec,
-                              perms=_value_perm_table(spec, self.codec))
+        self.codec, self.kern = registry.make_model(spec, max_msgs=max_msgs)
+        names = self.kern.action_names
+        if self.expand_mults is None:
+            self.expand_mults = [self._expand_mult_default] * len(names)
+        elif isinstance(self.expand_mults, dict):
+            base = [self._expand_mult_default] * len(names)
+            for n, m in self.expand_mults.items():
+                base[names.index(n)] = m
+            self.expand_mults = base
+        else:
+            self.expand_mults = list(self.expand_mults)
         self.L = self.kern.n_lanes
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}          # action id -> jitted single-action fn
@@ -155,7 +146,7 @@ class DeviceBFS:
             # carries the overflowing action so only it grows)
             caps = [min(T * kern._lane_count(nm),
                         max(64, T * self.expand_mults[a]))
-                    for a, nm in enumerate(ACTION_NAMES)]
+                    for a, nm in enumerate(kern.action_names)]
             total_E = sum(caps)
 
             def body(c):
@@ -192,7 +183,7 @@ class DeviceBFS:
                 ovf_i = jnp.asarray(False)
 
                 for aid, (name, fn, guard) in enumerate(
-                        zip(ACTION_NAMES, kern._action_fns(),
+                        zip(kern.action_names, kern._action_fns(),
                             kern._guard_fns())):
                     L_a = kern._lane_count(name)
                     TL = T * L_a
@@ -366,8 +357,9 @@ class DeviceBFS:
 
         if resume_from is not None:
             # --- resume from a level-boundary snapshot ----------------
-            from .checkpoint import load_checkpoint
-            ck = load_checkpoint(resume_from)
+            from .checkpoint import load_checkpoint, spec_digest
+            ck = load_checkpoint(resume_from,
+                                 expect_digest=spec_digest(spec))
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
                     list(ck["expand_mults"]) != list(self.expand_mults):
                 self.expand_mults = list(ck["expand_mults"])
@@ -486,7 +478,7 @@ class DeviceBFS:
                             "device/interpreter divergence: device "
                             "invariant kernel reported a violation the "
                             "interpreter accepts (parent gid "
-                            f"{gid}, action {ACTION_NAMES[va]})")
+                            f"{gid}, action {self.kern.action_names[va]})")
                     res.ok = False
                     res.violated_invariant = bad
                     res.trace = self._trace(gid, extra=(va, vprm))
@@ -510,7 +502,7 @@ class DeviceBFS:
                     self.expand_mults[aid] *= 2
                     self._level = jax.jit(self._make_level(),
                                           donate_argnums=(0, 4, 5, 6, 7))
-                    emit(f"expand buffer for {ACTION_NAMES[aid]} grown "
+                    emit(f"expand buffer for {self.kern.action_names[aid]} grown "
                          f"to tile x {self.expand_mults[aid]} "
                          f"(recompiling)")
                 elif reason == R_SLOT_ERR:
@@ -558,7 +550,7 @@ class DeviceBFS:
             if checkpoint_path and n_next and (
                     checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
-                from .checkpoint import save_checkpoint
+                from .checkpoint import save_checkpoint, spec_digest
                 save_checkpoint(
                     checkpoint_path,
                     slots=table["slots"], frontier=front, n_front=n_next,
@@ -571,7 +563,8 @@ class DeviceBFS:
                     states_generated=res.states_generated,
                     max_msgs=self.codec.shape.MAX_MSGS,
                     expand_mults=self.expand_mults,
-                    elapsed=time.time() - t0)
+                    elapsed=time.time() - t0,
+                    digest=spec_digest(spec))
                 last_checkpoint = time.time()
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
@@ -637,7 +630,7 @@ class DeviceBFS:
                           state=self.codec.decode(st))]
         for pos, (aid, prm) in enumerate(steps):
             st = self._materialize_one(st, aid, prm)
-            name = ACTION_NAMES[aid]
+            name = self.kern.action_names[aid]
             out.append(TraceEntry(position=pos + 2, action_name=name,
                                   location=loc.get(name),
                                   state=self.codec.decode(st)))

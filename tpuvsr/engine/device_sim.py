@@ -38,9 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.vsr import VSRCodec
-from ..models.vsr_kernel import ACTION_NAMES, VSRKernel
-from .device_bfs import _value_perm_table
+from ..models import registry
 from .simulate import SimResult
 from .spec import SpecModel
 from .trace import TraceEntry
@@ -49,21 +47,67 @@ I32 = jnp.int32
 
 
 class DeviceSimulator:
+    """``action_weights``: optional per-action sampling weights (dict
+    action-name -> weight, or array over kernel action order).  When set,
+    each step samples in two stages — an enabled *action* with
+    probability proportional to its weight, then a uniformly random
+    enabled lane within it — instead of TLC's uniform-over-successors
+    draw.  With an unbounded bag the successor list is dominated by
+    message-delivery lanes, so uniform-over-successors walks almost
+    never exercise rare guard-windows like the SendGetState truncation
+    (VSR.tla:491-516); action-stage weighting is the scheduler-bias
+    knob that makes deep defect hunts tractable.
+
+    ``swarm_sigma``: standard deviation of per-walker log-normal noise
+    multiplied onto the weights, resampled every walk round — a swarm
+    of differently-biased schedulers instead of one (diversifies the
+    explored interleaving distribution at zero cost).
+
+    ``guided``: importance splitting for rare-violation hunts.  At
+    every chunk boundary the walker population is resampled with
+    probability proportional to ``exp(beta * kern.hunt_score(state))``
+    — walkers that progressed toward the violation are cloned, walkers
+    that didn't are culled (their recorded histories are permuted
+    consistently, so a violating clone still replays into a full
+    counterexample trace).  A multilevel-splitting rare-event search
+    the reference's checker has no analog of; it trades the uniform
+    walk distribution for a massively higher hit rate on deep defects
+    like the state-transfer data loss."""
+
     def __init__(self, spec: SpecModel, max_msgs=None, walkers=256,
-                 chunk_steps=32):
+                 chunk_steps=32, action_weights=None, swarm_sigma=0.0,
+                 guided=False, split_beta=1.5):
         self.spec = spec
         self.W = walkers
         self.chunk = chunk_steps
         self.inv_names = list(spec.cfg.invariants)
+        self.swarm_sigma = float(swarm_sigma)
+        self._action_weights = action_weights
+        self.guided = bool(guided)
+        self.split_beta = float(split_beta)
+        self.log_w = None           # resolved against the kernel in _build
         self._build(max_msgs)
 
     def _build(self, max_msgs):
         spec = self.spec
-        self.codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
-        self.kern = VSRKernel(self.codec,
-                              perms=_value_perm_table(spec, self.codec))
-        inv = self.kern.invariant_fn(self.inv_names)
+        self.codec, self.kern = registry.make_model(spec, max_msgs=max_msgs)
         kern = self.kern
+        names = kern.action_names
+        aw = self._action_weights
+        if aw is None:
+            self.log_w = None
+        else:
+            if isinstance(aw, dict):
+                w = np.ones(len(names))
+                for name, x in aw.items():
+                    w[names.index(name)] = x
+            else:
+                w = np.asarray(aw, float)
+            if w.shape != (len(names),) or (w <= 0).any():
+                raise ValueError("action_weights must be positive, one "
+                                 "per action")
+            self.log_w = np.log(w)
+        inv = kern.invariant_fn(self.inv_names)
         lane_aid = jnp.asarray(kern.lane_action)
         lane_prm = jnp.asarray(kern.lane_param)
         guards = kern._guard_fns()
@@ -71,7 +115,7 @@ class DeviceSimulator:
 
         def guard_all(st):
             outs = []
-            for name, g in zip(ACTION_NAMES, guards):
+            for name, g in zip(names, guards):
                 lanes = jnp.arange(kern._lane_count(name), dtype=I32)
                 outs.append(jax.vmap(lambda ln, g=g: g(st, ln))(lanes))
             return jnp.concatenate(outs)
@@ -99,12 +143,28 @@ class DeviceSimulator:
                         s_a[k], v) for k, v in out.items()}
             return out
 
-        def chunk_fn(states, was_alive, keys):
+        weighted = self.log_w is not None
+        n_act = len(names)
+
+        def chunk_fn(states, was_alive, keys, logw):
             def step(carry, key):
                 states, was_alive, bad, dead, err_any, steps, d = carry
                 en = jax.vmap(guard_all)(states)          # [W, L]
-                u = jax.random.uniform(key, en.shape)
-                lane = jnp.argmax(jnp.where(en, u, -1.0), axis=1)
+                if weighted:
+                    # stage 1: enabled action ~ weights (Gumbel-max);
+                    # stage 2: uniform enabled lane within it
+                    k1, k2 = jax.random.split(key)
+                    act_en = jnp.zeros((en.shape[0], n_act), bool) \
+                        .at[:, lane_aid].max(en)
+                    g = jax.random.gumbel(k1, act_en.shape) + logw
+                    a_star = jnp.argmax(jnp.where(act_en, g, -jnp.inf),
+                                        axis=1)
+                    v = jax.random.uniform(k2, en.shape)
+                    in_act = en & (lane_aid[None, :] == a_star[:, None])
+                    lane = jnp.argmax(jnp.where(in_act, v, -1.0), axis=1)
+                else:
+                    u = jax.random.uniform(key, en.shape)
+                    lane = jnp.argmax(jnp.where(en, u, -1.0), axis=1)
                 alive = en.any(axis=1)
                 aid = lane_aid[lane]
                 prm = lane_prm[lane]
@@ -138,7 +198,42 @@ class DeviceSimulator:
             return states, alive, bad, dead, err_any, steps, hist
 
         self._chunk = jax.jit(chunk_fn)
+        if self.guided:
+            if not hasattr(kern, "hunt_score"):
+                raise ValueError(
+                    "guided simulation needs a kernel hunt_score")
+            self._score = jax.jit(jax.vmap(kern.hunt_score))
         self._mat = {}
+
+    def _resample(self, rng, states, was_alive, hists):
+        """Importance-splitting step: draw W walker indices with
+        probability ~ exp(beta * hunt_score), permute walker state AND
+        every recorded history chunk by the draw (clones inherit their
+        parent's past, so traces replay exactly)."""
+        scores = np.asarray(self._score(states)).astype(np.float64)
+        if scores.max() == scores.min():
+            return states, was_alive, hists, scores.max()
+        z = self.split_beta * (scores - scores.max())
+        p = np.exp(z)
+        p /= p.sum()
+        sel = jnp.asarray(rng.choice(self.W, size=self.W, p=p), jnp.int32)
+        states = {k: v[sel] for k, v in states.items()}
+        was_alive = was_alive[sel]
+        hists = [(ha[:, sel], hp[:, sel]) for ha, hp in hists]
+        return states, was_alive, hists, scores.max()
+
+    def _round_logw(self, key):
+        """Per-walker action log-weights for one walk round (base
+        weights + optional swarm noise), or a dummy scalar when
+        running TLC-uniform."""
+        if self.log_w is None:
+            return jnp.zeros(())
+        logw = jnp.asarray(self.log_w, jnp.float32)[None, :]
+        logw = jnp.broadcast_to(logw, (self.W, logw.shape[1]))
+        if self.swarm_sigma > 0.0:
+            noise = jax.random.normal(key, logw.shape) * self.swarm_sigma
+            logw = logw + noise
+        return logw
 
     def _grow_msgs(self, batches):
         """Double MAX_MSGS and pad the given dense batches."""
@@ -176,20 +271,24 @@ class DeviceSimulator:
             res.elapsed = time.time() - t0
             return res
         key = jax.random.PRNGKey(seed)
+        rng = np.random.default_rng(seed ^ 0x5EED)
         init = {k: jnp.asarray(v) for k, v in init.items()}
         stop = False
+        best_score = 0
         while res.walks < num and not stop:
             states = init
             was_alive = jnp.ones((self.W,), bool)
             hists = []          # [(ha [k, W], hp [k, W])] device arrays
             d = 0
+            key, wkey = jax.random.split(key)
+            logw = self._round_logw(wkey)
             while d < depth:
                 k = min(self.chunk, depth - d)
                 key, sub = jax.random.split(key)
                 keys = jax.random.split(sub, k)
                 while True:
                     (nstates, alive, bad, dead, err_any, steps,
-                     hist) = self._chunk(states, was_alive, keys)
+                     hist) = self._chunk(states, was_alive, keys, logw)
                     if bool(err_any):
                         # bag overflow inside the chunk: grow the table,
                         # pad saved entry states, redraw the chunk
@@ -239,13 +338,20 @@ class DeviceSimulator:
                     return res
                 states, was_alive = nstates, alive
                 d += k
+                if self.guided and d < depth:
+                    states, was_alive, hists, sc = self._resample(
+                        rng, states, was_alive, hists)
+                    best_score = max(best_score, int(sc))
                 if max_seconds and time.time() - t0 > max_seconds:
                     stop = True
                     break
             res.walks += self.W
             if log:
                 el = time.time() - t0
-                log(f"{res.walks} walks, {res.steps / el:.0f} steps/s")
+                extra = (f", best score {best_score}"
+                         if self.guided else "")
+                log(f"{res.walks} walks, {res.steps / el:.0f} steps/s"
+                    f"{extra}")
         res.elapsed = time.time() - t0
         return res
 
@@ -262,7 +368,7 @@ class DeviceSimulator:
             if aids[i] < 0:
                 break
             st = self._materialize_one(st, int(aids[i]), int(prms[i]))
-            name = ACTION_NAMES[aids[i]]
+            name = self.kern.action_names[aids[i]]
             out.append(TraceEntry(position=i + 2, action_name=name,
                                   location=loc.get(name),
                                   state=self.codec.decode(st)))
@@ -271,9 +377,14 @@ class DeviceSimulator:
 
 def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
                     walkers=256, max_msgs=None, check_deadlock=False,
-                    log=None, max_seconds=None, chunk_steps=32) -> SimResult:
+                    log=None, max_seconds=None, chunk_steps=32,
+                    action_weights=None, swarm_sigma=0.0,
+                    guided=False, split_beta=1.5) -> SimResult:
     sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers,
-                          chunk_steps=chunk_steps)
+                          chunk_steps=chunk_steps,
+                          action_weights=action_weights,
+                          swarm_sigma=swarm_sigma, guided=guided,
+                          split_beta=split_beta)
     return sim.run(num=num, depth=depth, seed=seed,
                    check_deadlock=check_deadlock, log=log,
                    max_seconds=max_seconds)

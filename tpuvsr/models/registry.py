@@ -12,7 +12,22 @@ gain generated kernels.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+import jax
+
+# Jitted kernels (level pass, sim chunk, sharded step) take minutes to
+# build on a single CPU core; persist compiled binaries across processes
+# so bench/CLI/tests/hunt scripts share one cache.  Lives here because
+# every engine imports the registry.
+if not jax.config.jax_compilation_cache_dir:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("TPUVSR_JAX_CACHE",
+                       os.path.expanduser("~/.cache/tpuvsr_jax")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 
 def value_perm_table(spec, codec):
@@ -29,11 +44,15 @@ def value_perm_table(spec, codec):
 
 
 def has_device_model(spec) -> bool:
-    """True if a compiled device kernel exists for this module."""
+    """True if a compiled device kernel exists for this module AND the
+    bound constants fit its dense layout (e.g. the VSR layout refuses
+    ClientCount != 1)."""
+    from ..core.values import TLAError
     try:
-        _resolve(spec.module.name)
+        codec_cls, _ = _resolve(spec.module.name)
+        codec_cls(spec.ev.constants)
         return True
-    except KeyError:
+    except (KeyError, TLAError):
         return False
 
 

@@ -101,6 +101,8 @@ def _lex_less(a, b):
 
 
 class VSRKernel:
+    action_names = ACTION_NAMES
+
     def __init__(self, codec: VSRCodec, perms: np.ndarray = None):
         self.codec = codec
         self.shape = s = codec.shape
@@ -1179,6 +1181,34 @@ class VSRKernel:
         # shipped cfg, but checkable as one
         return ((st["view"] == st["view"][0]).all()
                 & (st["status"] == NORMAL).all())
+
+    def hunt_score(self, st):
+        """Defect-proximity score for guided simulation (importance
+        splitting): how close is this state to losing an acknowledged
+        write (AcknowledgedWriteNotLost, VSR.tla:945-950)?
+
+        0 while nothing is acked; afterwards a shaped sum of milestones
+        along the truncation path (VSR.tla:64-86):
+          +2 per replica missing the worst acked value (reaches +2R at
+             the violation),
+          +1 if some Normal replica lags the max view while holding an
+             acked value (the SendGetState truncation candidate),
+          +1 if a GetState record is in the bag (truncation fired —
+             VSR.tla:496-516 truncates on SEND).
+        The intermediate milestones give the splitter gradient through
+        the view-change phase, where the missing-count alone is flat."""
+        acked = st["aux_acked"] == 2                      # [V]
+        has = self._replica_has_op(st)                    # [R, V]
+        missing = (~has).sum(axis=0)                      # [V]
+        worst = jnp.max(jnp.where(acked, missing, -1))
+        vmax = st["view"].max()
+        has_acked_val = (has & acked[None, :]).any(axis=1)   # [R]
+        lag = ((st["status"] == NORMAL) & (st["view"] < vmax)
+               & has_acked_val).any()
+        gs = ((st["m_present"] == 1)
+              & (st["m_hdr"][:, H_TYPE] == M_GETSTATE)).any()
+        score = 1 + 2 * worst + lag.astype(I32) + gs.astype(I32)
+        return jnp.where(acked.any(), score, 0).astype(I32)
 
     INVARIANT_FNS = {
         "AcknowledgedWriteNotLost": "inv_acknowledged_write_not_lost",

@@ -15,10 +15,18 @@ Design (the TPU answer to TLC's shared-memory worker pool):
   disjoint union of shards and no two devices ever race on a slot.
 
 The exchange uses fixed-capacity buckets (XLA needs static shapes); a
-bucket overflow is reported so the host can re-run the tile in halves.
-Fresh successor *states* stay on the producing device in this step; the
-ownership exchange moves only 16-byte fingerprints + lane indices, which
-is what makes the collective cheap relative to HBM traffic.
+bucket overflow pauses the level so the host can grow the bucket and
+re-enter.  The exchange ships whole dense states (plus 16-byte
+fingerprint and 12-byte trace meta) to their owner in ONE all_to_all —
+chosen over a fps-only + verdict-round-trip design because owner-side
+state residence is what keeps the frontier hash-balanced and the next
+level's expansion collective-free; the measured cost is reported per
+run as ``CheckResult.exchange`` (useful vs wire bytes — wire volume is
+static: full ``D x bucket_cap`` buckets move every tile regardless of
+occupancy).  A fps-first exchange that ships only accepted states
+would cut useful bytes by the duplicate fraction at the price of a
+second collective + owner-side re-materialization; revisit if ICI (not
+HBM) ever profiles as the bottleneck.
 """
 
 from __future__ import annotations
@@ -43,98 +51,6 @@ def route(fps):
     return (fps[..., 1] * jnp.uint32(0x9E3779B9)) ^ (fps[..., 3] >> 7)
 
 
-def make_sharded_expand(kern, inv_fn, mesh: Mesh, axis: str = "d",
-                        bucket_cap: int = None):
-    """Build the jitted one-level expand step over `mesh`.
-
-    Returns step(tables, frontier, valid) ->
-        (tables, fresh_local, owned_fps, n_fresh, viol_any, err_any, ovf)
-    where every output is sharded over `axis`:
-      - fresh_local [n_dev tiles..]: per-device mask over the *local*
-        lane space of successors that are globally fresh AND owned
-        locally is not returned (states stay put) — instead
-        `fresh_keep` marks local lanes accepted by their owners.
-    """
-    n_dev = mesh.shape[axis]
-    L = kern.n_lanes
-
-    def step_shard(tables, tile, valid):
-        # tables arrive with the sharded leading axis of size 1:
-        # {"slots": [1, cap, 5]}
-        # tile:   state pytree [B_local, ...];  valid: [B_local]
-        tables = {k: v[0] for k, v in tables.items()}
-        B = valid.shape[0]
-        succs, en = jax.vmap(kern.step_all)(tile)
-        en = en & valid[:, None]
-        flat = {k: v.reshape((B * L,) + v.shape[2:]) for k, v in succs.items()}
-        en = en.reshape(-1)
-        fps = jax.vmap(kern.fingerprint)(flat)
-        inv_ok = jax.vmap(inv_fn)(flat)
-        viol_any = (en & ~inv_ok).any()
-        err_any = (en & (flat["err"] != 0)).any()
-
-        # local pre-dedup shrinks the exchange
-        perm, cand = dedup_batch(fps, en)
-        fps_s = fps[perm]
-        owner = (route(fps_s) % jnp.uint32(n_dev)).astype(jnp.int32)
-
-        cap = bucket_cap or max(64, (B * L) // max(1, n_dev // 2))
-        bucket = jnp.zeros((n_dev, cap, 4), U32)
-        sent_mask = jnp.zeros((n_dev, cap), bool)
-        bsrc = jnp.zeros((n_dev, cap), jnp.int32)      # index into fps_s
-        ovf = jnp.asarray(False)
-        for d in range(n_dev):
-            m = cand & (owner == d)
-            pos = jnp.cumsum(m) - 1
-            ovf = ovf | (pos[-1] + 1 > cap) & m.any()
-            idx = jnp.where(m & (pos < cap), pos, cap)  # cap row = dropped
-            bucket = bucket.at[d, idx].set(fps_s, mode="drop")
-            sent_mask = sent_mask.at[d, idx].set(m, mode="drop")
-            bsrc = bsrc.at[d, idx].set(jnp.arange(B * L, dtype=jnp.int32),
-                                       mode="drop")
-        # exchange: row j of the result comes from device j
-        inc_bucket = jax.lax.all_to_all(bucket, axis, 0, 0, tiled=False)
-        inc_maskd = jax.lax.all_to_all(sent_mask, axis, 0, 0, tiled=False)
-
-        # dedup + insert what I own (across the n_dev incoming chunks)
-        inc_fps = inc_bucket.reshape(n_dev * cap, 4)
-        inc_mask = inc_maskd.reshape(n_dev * cap)
-        perm2, cand2 = dedup_batch(inc_fps, inc_mask)
-        tables, fresh2, probe_ovf = insert_core(
-            tables, inc_fps[perm2], cand2)
-        # verdicts back to producers: un-permute, un-exchange
-        verdict = jnp.zeros((n_dev * cap,), bool).at[perm2].set(fresh2)
-        verdict = jax.lax.all_to_all(
-            verdict.reshape(n_dev, cap), axis, 0, 0, tiled=False)
-        # map bucket rows back to local sorted-lane indices; row i of the
-        # returned verdict is device i's decision about the chunk *I*
-        # sent it, so it pairs with my sent_mask/bsrc rows
-        fresh_keep_s = jnp.zeros((B * L,), bool)
-        for d in range(n_dev):
-            fresh_keep_s = fresh_keep_s.at[bsrc[d]].max(
-                verdict[d] & sent_mask[d])
-        # un-sort to the original lane order
-        fresh_keep = jnp.zeros((B * L,), bool).at[perm].set(fresh_keep_s)
-        n_fresh = fresh_keep.sum()[None]    # [1] per device -> [n_dev]
-        # global any-reduction for the diagnostics so every device (and
-        # the replicated outputs) agree
-        def par_any(x):
-            return jax.lax.psum(x.astype(jnp.int32), axis) > 0
-        tables = {k: v[None] for k, v in tables.items()}
-        return (tables, flat, fps, fresh_keep, n_fresh, par_any(viol_any),
-                par_any(err_any), par_any(ovf | probe_ovf))
-
-    spec_d = P(axis)
-    spec_tab = P(axis)     # each device holds its own shard row
-    step = jax.jit(jax.shard_map(
-        step_shard, mesh=mesh,
-        in_specs=(spec_tab, spec_d, spec_d),
-        out_specs=(spec_tab, spec_d, spec_d, spec_d, spec_d, P(), P(), P()),
-        check_vma=False),
-        donate_argnums=(0,))
-    return step
-
-
 def make_sharded_tables(mesh, axis, capacity_per_device):
     """Global FPSet: one independent shard per device, stacked on the
     leading (sharded) axis."""
@@ -149,9 +65,7 @@ def make_sharded_tables(mesh, axis, capacity_per_device):
 # ======================================================================
 #
 # The full distributed BFS loop (SURVEY.md §5 "distributed communication
-# backend"; BASELINE.json configs[4]).  Unlike make_sharded_expand above
-# (which keeps successor states on their producer and ships only
-# fingerprints + a verdict round-trip), the driver routes each fresh
+# backend"; BASELINE.json configs[4]).  The driver routes each fresh
 # successor STATE to the device that owns its fingerprint, in the same
 # single all_to_all as the fingerprint itself:
 #
@@ -339,6 +253,10 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": nn + jnp.where(commit, n_fresh, 0),
                 "gen": c["gen"] + jnp.where(commit & ~g_povf, n_en, 0),
+                # exchange-occupancy metric: useful bucket rows this
+                # device shipped (the wire moves full static buckets)
+                "sent": c["sent"] + jnp.where(
+                    commit & ~g_povf, b_mask.sum().astype(jnp.int32), 0),
             }
 
         init = {
@@ -349,19 +267,20 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
             "nn": nn0[0],
             "gen": jnp.asarray(0, jnp.int32),
+            "sent": jnp.asarray(0, jnp.int32),
         }
         out = jax.lax.while_loop(cond, body, init)
         one = lambda x: x[None]
         return ({"slots": out["slots"][None]},
                 out["nb"], out["nbp"], out["nba"], out["nbprm"],
                 one(out["nn"]), one(out["t"]), one(out["reason"]),
-                out["viol"][None], one(out["gen"]))
+                out["viol"][None], one(out["gen"]), one(out["sent"]))
 
     sp = P(axis)
     step = jax.jit(jax.shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 10,
+        out_specs=(sp,) * 11,
         check_vma=False))
     return step
 
@@ -378,9 +297,6 @@ class ShardedBFS:
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=512, next_capacity=1 << 12,
                  fpset_capacity=1 << 14):
-        from ..engine.device_bfs import _value_perm_table
-        from ..models.vsr import VSRCodec
-        from ..models.vsr_kernel import VSRKernel
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
@@ -391,15 +307,12 @@ class ShardedBFS:
         self.fp_cap = fpset_capacity    # per-device FPSet slots
         self.inv_names = list(spec.cfg.invariants)
         self._mat = {}
-        self._codec_ctor = lambda mm: VSRCodec(spec.ev.constants,
-                                               max_msgs=mm)
-        self._kern_ctor = lambda codec: VSRKernel(
-            codec, perms=_value_perm_table(spec, codec))
         self._build(max_msgs)
 
     def _build(self, max_msgs):
-        self.codec = self._codec_ctor(max_msgs)
-        self.kern = self._kern_ctor(self.codec)
+        from ..models import registry
+        self.codec, self.kern = registry.make_model(self.spec,
+                                                    max_msgs=max_msgs)
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
@@ -448,7 +361,6 @@ class ShardedBFS:
         from ..core.values import TLAError
         from ..engine.bfs import CheckResult
         from ..engine.fpset import grow as fp_grow
-        from ..models.vsr_kernel import ACTION_NAMES
         spec, codec = self.spec, self.codec
         D = self.D
         res = CheckResult()
@@ -514,6 +426,33 @@ class ShardedBFS:
                 return self._finish(res, t0, 0, fp_count)
         res.states_generated += len(dense)
 
+        # exchange metrics: useful rows shipped vs static wire volume
+        # (all_to_all always moves full D x bucket_cap buckets).  Bytes
+        # are accumulated with the row size current at the time (the
+        # codec — and so the state row — grows on R_BAG_GROW)
+        def _row_bytes():
+            zero = self.codec.zero_state()
+            state_b = sum(int(np.prod(np.shape(v)) or 1) * 4
+                          for v in zero.values())
+            return state_b + 16 + 1 + 12      # + fps/mask/meta
+        exch_rows_useful = 0
+        exch_rows_wire = 0
+        exch_bytes_useful = 0
+        exch_bytes_wire = 0
+
+        def _attach_exchange(r):
+            r.exchange = {
+                "row_bytes": _row_bytes(),
+                "useful_rows": exch_rows_useful,
+                "useful_bytes": exch_bytes_useful,
+                "wire_rows": exch_rows_wire,
+                "wire_bytes": exch_bytes_wire,
+            }
+            emit(f"exchange: {exch_rows_useful} useful rows "
+                 f"({exch_bytes_useful / 1e6:.1f} MB) / "
+                 f"{exch_rows_wire} wire rows "
+                 f"({exch_bytes_wire / 1e6:.1f} MB)")
+
         depth = 0
         last_progress = t0
         while int(np.asarray(n_front).sum()) > 0:
@@ -527,10 +466,13 @@ class ShardedBFS:
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
                 (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
-                 viol_out, gen_out) = self._step(
+                 viol_out, gen_out, sent_out) = self._step(
                     tables, front, n_front, start_t,
                     nb, nbp, nba, nbprm, nn, base_gid)
                 reason = int(np.asarray(reason_out)[0])
+                sent = int(np.asarray(sent_out).sum())
+                exch_rows_useful += sent
+                exch_bytes_useful += sent * _row_bytes()
                 start_t = t_out
                 if reason == RUNNING:
                     break
@@ -545,9 +487,11 @@ class ShardedBFS:
                         raise TLAError(
                             "device/interpreter divergence in sharded "
                             "BFS: interpreter accepts the replayed "
-                            f"violation state (action {ACTION_NAMES[va]})")
+                            f"violation state (action "
+                            f"{self.kern.action_names[va]})")
                     res.violated_invariant = bad
                     res.diameter = depth
+                    _attach_exchange(res)
                     return self._finish(res, t0, depth, fp_count)
                 if reason == R_SLOT_ERR:
                     raise TLAError(
@@ -605,6 +549,10 @@ class ShardedBFS:
                 else:
                     raise TLAError(f"unknown sharded reason {reason}")
 
+            # committed tiles this level x full static bucket volume
+            wire = int(np.asarray(start_t).max()) * D * D * self.bucket_cap
+            exch_rows_wire += wire
+            exch_bytes_wire += wire * _row_bytes()
             nn_h = np.asarray(nn)
             gen_h = int(np.asarray(gen_out).sum())
             res.states_generated += gen_h
@@ -647,6 +595,7 @@ class ShardedBFS:
                 emit(f"FPSet shards grown to {self.fp_cap}/device")
 
         res.diameter = depth
+        _attach_exchange(res)
         return self._finish(res, t0, depth, fp_count)
 
     @staticmethod
