@@ -1,10 +1,17 @@
-"""Defect-reproduction experiment: hunt the state-transfer data-loss
+"""Defect-reproduction hunt: find the state-transfer data-loss
 violation (reference README:11-18, state_transfer_violation_trace.txt)
 with the device simulator on the defect fixture config.
 
-Usage: python scripts/defect_hunt.py [walkers] [depth] [max_seconds] [seed]
+Uses weighted two-stage action sampling + swarm scheduler noise
+(DeviceSimulator action_weights/swarm_sigma) — uniform-over-successors
+walks are dominated by message-delivery lanes and essentially never
+thread the SendGetState truncation window.
+
+Usage: python scripts/defect_hunt.py [walkers] [depth] [max_seconds]
+       [seed] [swarm_sigma]
 """
 
+import json
 import os
 import sys
 import time
@@ -12,10 +19,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from tpuvsr.platform_select import force_cpu
+if os.environ.get("TPUVSR_TPU") != "1":
+    force_cpu()
+
 walkers = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-depth = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+depth = int(sys.argv[2]) if len(sys.argv) > 2 else 48
 max_seconds = float(sys.argv[3]) if len(sys.argv) > 3 else 600
 seed = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+sigma = float(sys.argv[5]) if len(sys.argv) > 5 else 1.0
 
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
@@ -32,15 +44,20 @@ spec = SpecModel(mod, cfg)
 import jax
 print(f"backend: {jax.default_backend()}", file=sys.stderr)
 
+guided = os.environ.get("TPUVSR_HUNT_GUIDED", "1") == "1"
 t0 = time.time()
-sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=32, max_msgs=48)
-print(f"build: {time.time()-t0:.1f}s", file=sys.stderr)
+sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=8, max_msgs=48,
+                      action_weights={}, swarm_sigma=sigma,
+                      guided=guided)
+print(f"build: {time.time()-t0:.1f}s guided={guided} "
+      f"(compile on first chunk)", file=sys.stderr, flush=True)
 
 t0 = time.time()
 res = sim.run(num=10**9, depth=depth, seed=seed,
               max_seconds=max_seconds,
               log=lambda m: print(f"hunt: {m} ({time.time()-t0:.0f}s)",
                                   file=sys.stderr))
+ttv = time.time() - t0
 print(f"\nelapsed {res.elapsed:.1f}s, walks {res.walks}, steps {res.steps}")
 print(f"ok={res.ok} violated={res.violated_invariant}")
 if res.trace:
@@ -50,3 +67,24 @@ if res.trace:
     last = res.trace[-1].state
     print("final logs:", last["rep_log"])
     print("acked:", last["aux_client_acked"])
+    result = {"time_to_violation_s": round(ttv, 1),
+              "violated": res.violated_invariant,
+              "walkers": walkers, "depth": depth, "seed": seed,
+              "swarm_sigma": sigma, "guided": guided,
+              "walks": res.walks, "steps": res.steps,
+              "trace_len": len(res.trace),
+              "final_action": res.trace[-1].action_name,
+              "backend": jax.default_backend()}
+    print(json.dumps(result))
+    with open(os.path.join(REPO, "scripts", "hunt_result.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    from tpuvsr.engine.trace import format_trace, format_trace_te
+    with open(os.path.join(REPO, "scripts", "hunt_trace.txt"), "w") as f:
+        f.write(format_trace(res.trace))
+    # replayable artifact (frontend.trace_parse format).  Written to
+    # scripts/ — the committed golden at examples/found_violation_trace
+    # .txt is promoted manually after replay validation, so a later
+    # hunt with a different witness shape can't silently clobber it
+    with open(os.path.join(REPO, "scripts",
+                           "found_violation_trace.txt"), "w") as f:
+        f.write(format_trace_te(res.trace))

@@ -77,6 +77,32 @@ def test_golden_trace_replays_to_violation(defect_spec, golden):
         assert defect_spec.check_invariants(st) is None
 
 
+FOUND_TRACE = os.path.join(os.path.dirname(DEFECT_CFG),
+                           "found_violation_trace.txt")
+
+
+def test_found_violation_trace_replays(defect_spec):
+    """Our own recorded counterexample — found independently by the
+    guided importance-splitting hunt (scripts/defect_hunt.py;
+    wall-clock time-to-violation in scripts/hunt_result.json) — must
+    replay through the interpreter to the same violation shape as the
+    reference's: SendGetState truncation, final ReceiveSV, all logs
+    empty while a value is acked."""
+    entries = parse_trace_file(FOUND_TRACE, defect_spec)
+    names = [e.action_name for e in entries[1:]]
+    assert "SendGetState" in names
+    assert names[-1] == "ReceiveSV"
+    states = replay_trace(defect_spec, entries)
+    final = states[-1]
+    assert defect_spec.check_invariants(final) == "AcknowledgedWriteNotLost"
+    acked_vals = [v for v, b in final["aux_client_acked"].items if b]
+    assert acked_vals
+    for r in sorted(final["replicas"]):
+        assert len(final["rep_log"].apply(r)) == 0
+    for st in states[:-1]:
+        assert defect_spec.check_invariants(st) is None
+
+
 @pytest.mark.slow
 def test_golden_trace_device_kernel_confirms(defect_spec, golden):
     """Walk the dense device kernel along the same 23 actions: at every

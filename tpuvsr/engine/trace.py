@@ -36,6 +36,27 @@ def reconstruct_trace(sid, parents, states):
     return out
 
 
+def format_trace_te(trace, varnames=None) -> str:
+    """Emit a trace in the reference's ``_TEAction`` record format
+    (state_transfer_violation_trace.txt:3-26) — the format
+    frontend.trace_parse reads back, so recorded counterexamples become
+    replayable golden artifacts."""
+    blocks = []
+    for e in trace:
+        name = e.action_name or "Initial predicate"
+        loc = e.location or "Unknown location"
+        lines = ["[", " _TEAction |-> [",
+                 f"   position |-> {e.position},",
+                 f'   name |-> "{name}",',
+                 f'   location |-> "{loc}"', " ],"]
+        names = varnames or sorted(e.state)
+        lines.append(",\n".join(f"{n} |-> {fmt(e.state[n])}"
+                                for n in names))
+        lines.append("]")
+        blocks.append("\n".join(lines))
+    return "<<\n" + ",\n".join(blocks) + "\n>>\n"
+
+
 def format_trace(trace, varnames=None) -> str:
     lines = []
     for e in trace:
