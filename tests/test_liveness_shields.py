@@ -1,0 +1,177 @@
+"""AL05/CP06 liveness-shield tests (SURVEY.md §3.4, §2.7.3).
+
+The recovery-era specs carry `Blocked*` escape hatches
+(AL05:1108-1153, CP06:1317-1362) that neutralize spurious liveness
+counterexamples caused by state-space limiter constants.  These tests
+run the liveness checker with the shields live, prove they are
+load-bearing (stubbing them out turns the pass into a violation), and
+pin the documented AL05 `m.flag` evaluation fault (SURVEY.md §2.7.3:
+AL05's BlockedInRecovery reads a `flag` field its recovery responses
+don't carry — CP06's do — so a liveness run that reaches a
+Recovering-with-responses state faults, exactly as TLC would).
+"""
+
+import pytest
+
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.core.values import TLAError
+from tpuvsr.engine.liveness import liveness_check
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.interp.evalr import EMPTY_ENV, EvalCtx
+
+pytestmark = requires_reference
+
+ANALYSIS = f"{REFERENCE}/analysis"
+AL05 = f"{ANALYSIS}/05-replica-recovery/VR_REPLICA_RECOVERY_ASYNC_LOG"
+CP06 = f"{ANALYSIS}/06-replica-recovery-cp/VR_REPLICA_RECOVERY_CP"
+
+_COMMON = """
+    Normal = Normal
+    ViewChange = ViewChange
+    StateTransfer = StateTransfer
+    Recovering = Recovering
+    PrepareMsg = PrepareMsg
+    PrepareOkMsg = PrepareOkMsg
+    StartViewChangeMsg = StartViewChangeMsg
+    DoViewChangeMsg = DoViewChangeMsg
+    StartViewMsg = StartViewMsg
+    GetStateMsg = GetStateMsg
+    NewStateMsg = NewStateMsg
+    RecoveryMsg = RecoveryMsg
+    RecoveryResponseMsg = RecoveryResponseMsg
+    Nil = Nil
+    AnyDest = AnyDest
+"""
+
+AL05_LIVE_CFG = """CONSTANTS
+    ReplicaCount = 3
+    Values = {v1}
+    StartViewOnTimerLimit = 1
+    NoProgressChangeLimit = 1
+    CrashLimit = 0
+""" + _COMMON + """
+SPECIFICATION LivenessSpec
+PROPERTY
+ConvergenceToView
+OpEventuallyAllOrNothing
+"""
+
+CP06_EXTRA = """    GetCheckpointMsg = GetCheckpointMsg
+    NewCheckpointMsg = NewCheckpointMsg
+    NoOp = NoOp
+"""
+
+CP06_LIVE_CFG = """CONSTANTS
+    ReplicaCount = 3
+    Values = {v1}
+    StartViewOnTimerLimit = 1
+    NoProgressChangeLimit = 1
+    CrashLimit = 0
+""" + _COMMON + CP06_EXTRA + """
+SPECIFICATION LivenessSpec
+PROPERTY
+ConvergenceToView
+"""
+
+AL05_SAFE_CFG = """CONSTANTS
+    ReplicaCount = 3
+    Values = {v1}
+    StartViewOnTimerLimit = 1
+    NoProgressChangeLimit = 0
+    CrashLimit = 1
+""" + _COMMON + """
+INIT Init
+NEXT Next
+VIEW view
+INVARIANT
+AcknowledgedWriteNotLost
+"""
+
+CP06_SAFE_CFG = AL05_SAFE_CFG.replace(
+    "    Nil = Nil", "    Nil = Nil\n" + CP06_EXTRA.rstrip())
+
+
+def _stub_false(spec, name):
+    assert name in spec.module.defs
+    spec.module.defs[name].body = ("bool", False)
+
+
+@pytest.mark.slow
+def test_al05_shield_neutralizes_limiter_counterexample():
+    """With NoProgressChangeLimit=1 a paused next-primary blocks the
+    last view change forever; BlockedOnLastViewChange (inside
+    ExistsBlockedReplica, AL05:1127-1135) must neutralize the would-be
+    []<>AllReplicasMoveToSameView counterexample — and stubbing the
+    shield to FALSE must surface exactly that violation."""
+    mod = parse_module_file(f"{AL05}.tla")
+    spec = SpecModel(mod, parse_cfg_text(AL05_LIVE_CFG))
+    res = liveness_check(spec)
+    assert res.error is None
+    assert res.ok, res.property_name
+
+    mod2 = parse_module_file(f"{AL05}.tla")
+    spec2 = SpecModel(mod2, parse_cfg_text(AL05_LIVE_CFG))
+    _stub_false(spec2, "ExistsBlockedReplica")
+    res2 = liveness_check(spec2)
+    assert not res2.ok
+    assert res2.property_name == "ConvergenceToView"
+    # the counterexample must end in a cycle where some replica that
+    # can progress is stuck off the common view / not Normal
+    assert res2.trace
+
+
+def _recovery_state(tla, cfg_text, limit=4000):
+    """Explore until a state has a Recovering replica with at least one
+    received recovery response."""
+    mod = parse_module_file(tla)
+    spec = SpecModel(mod, parse_cfg_text(cfg_text))
+    rec_mv = spec.ev.constants["Recovering"]
+    frontier = list(spec.init_states())
+    seen = 0
+    while frontier and seen < limit:
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                seen += 1
+                for r in sorted(succ["replicas"]):
+                    if succ["rep_status"].apply(r) is rec_mv and \
+                            len(succ["rep_rec_recv"].apply(r)) > 0:
+                        return spec, succ
+                nxt.append(succ)
+        frontier = nxt
+    raise AssertionError("no Recovering-with-responses state found")
+
+
+@pytest.mark.slow
+def test_al05_blocked_in_recovery_m_flag_fault():
+    """SURVEY §2.7.3: AL05:1113 dereferences m.flag on recovery
+    responses that have no flag field; evaluating BlockedInRecovery on
+    a Recovering-with-responses state must fault (as TLC would when a
+    liveness run reaches it), while safety invariants never touch it."""
+    spec, st = _recovery_state(f"{AL05}.tla", AL05_SAFE_CFG)
+    d = spec.module.defs["BlockedInRecovery"]
+    with pytest.raises(TLAError, match="flag"):
+        spec.ev.eval(d.body, EMPTY_ENV, EvalCtx(st))
+    # safety checking of the same state is unaffected
+    assert spec.check_invariants(st) is None
+
+
+@pytest.mark.slow
+def test_cp06_blocked_in_recovery_evaluates_clean():
+    """CP06 recovery responses DO carry flag (CP06:404-431), so the
+    same shield evaluates without fault there."""
+    spec, st = _recovery_state(f"{CP06}.tla", CP06_SAFE_CFG)
+    d = spec.module.defs["BlockedInRecovery"]
+    val = spec.ev.eval(d.body, EMPTY_ENV, EvalCtx(st))
+    assert val in (True, False)
+
+
+@pytest.mark.slow
+def test_cp06_liveness_with_shields_live():
+    mod = parse_module_file(f"{CP06}.tla")
+    spec = SpecModel(mod, parse_cfg_text(CP06_LIVE_CFG))
+    res = liveness_check(spec)
+    assert res.error is None
+    assert res.ok, res.property_name

@@ -123,17 +123,46 @@ def _eval_pred(spec, expr, env, st):
     return spec.ev.eval(expr, env, EvalCtx(st)) is True
 
 
-def _fairness_names(spec):
-    """WF action names from the decomposed SPECIFICATION."""
-    names = []
+def _fairness_groups(spec):
+    """WF action groups from the decomposed SPECIFICATION.
+
+    The corpus uses two WF shapes: per-action ``WF_vars(SendDVC)``
+    lists (A01:793-806) and a single disjunction ``WF_vars(WFActions)``
+    with ``WFActions == A1 \\/ A2 \\/ ...`` (ST03:922-943, AL05, CP06)
+    — and VSR's ``WF_vars(Next)``.  WF of a disjunction is fair iff
+    some disjunct is taken infinitely often or the whole disjunction is
+    disabled infinitely often, so each WF formula becomes a *group* of
+    action names."""
+    action_names = {a.name for a in spec.actions}
+    groups = []
     for kind, _sub, act in spec.fairness:
         if kind != "wf":
             raise TLAError("only weak fairness appears in the corpus")
-        if act[0] == "id":
-            names.append(act[1])
-        else:
+        if act[0] != "id":
             raise TLAError(f"unsupported fairness action: {act!r}")
-    return names
+        name = act[1]
+        if name in action_names:
+            groups.append((name, frozenset([name])))
+            continue
+        d = spec.module.defs.get(name)
+        if d is None:
+            raise TLAError(f"WF action {name} not defined")
+        members = set()
+
+        def flat(e):
+            if e[0] == "or":
+                for x in e[1]:
+                    flat(x)
+            elif e[0] == "id" and e[1] in action_names:
+                members.add(e[1])
+            elif e[0] == "id" and e[1] in spec.module.defs:
+                flat(spec.module.defs[e[1]].body)
+            else:
+                raise TLAError(
+                    f"WF action {name} is not a disjunction of actions")
+        flat(d.body)
+        groups.append((name, frozenset(members)))
+    return groups
 
 
 def _tarjan_sccs(n_nodes, succ):
@@ -199,7 +228,7 @@ def liveness_check(spec: SpecModel, max_states=None,
         log(f"behavior graph: {len(states)} states, "
             f"{sum(len(e) for e in edges)} edges")
 
-    wf_names = _fairness_names(spec)
+    wf_groups = _fairness_groups(spec)
     n = len(states)
     # per-state: which WF actions have a real (state-changing) step
     enabled = [set() for _ in range(n)]
@@ -241,20 +270,20 @@ def liveness_check(spec: SpecModel, max_states=None,
 
             def cycle_fair(comp):
                 """A fair cycle exists within this (all-bad) SCC iff for
-                every WF action: some internal state-changing edge takes
-                it, or some SCC state has it disabled — strong
-                connectivity then stitches one cycle through all the
-                witnesses.  A singleton SCC is the stuttering lasso,
-                fair iff every WF action is disabled there."""
+                every WF group: some internal state-changing edge takes
+                a member, or some SCC state has the whole group disabled
+                — strong connectivity then stitches one cycle through
+                all the witnesses.  A singleton SCC is the stuttering
+                lasso, fair iff every WF group is disabled there."""
                 comp_set = set(comp)
                 taken = {a for u in comp for (a, t) in edges[u]
                          if t in comp_set and t != u}
-                for wf in wf_names:
-                    if wf in taken:
+                for _gname, members in wf_groups:
+                    if taken & members:
                         continue
-                    if all(wf in enabled[u] for u in comp):
-                        return False    # wf action always enabled,
-                                        # never taken: unfair
+                    if all(enabled[u] & members for u in comp):
+                        return False    # group always enabled, no
+                                        # member ever taken: unfair
                 return True
 
             # a violation needs BOTH a fair all-bad SCC and a lasso
