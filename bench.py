@@ -135,6 +135,18 @@ def main():
         "generated_per_s": round(dev_sps, 1),
         "reached_fixpoint": res.error is None,
     })
+    # attach measured round artifacts (each records its own backend):
+    # guided-hunt time-to-violation (scripts/defect_hunt.py) and
+    # configs[2]-scale simulation throughput (scripts/sim_scale.py)
+    for key, fname in (("defect_hunt", "hunt_result.json"),
+                       ("sim_scale", "sim_scale.json")):
+        p = os.path.join(REPO, "scripts", fname)
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    RESULT[key] = json.load(f)
+            except ValueError:
+                pass
     print(f"bench: device {res.distinct_states} distinct "
           f"({res.error or 'fixpoint'}), {dev_sps:.0f} generated/s, "
           f"{distinct_sps:.0f} distinct/s, diameter {res.diameter}",

@@ -1,0 +1,140 @@
+"""Defect-config BFS capacity analysis (SURVEY.md §7.3.8; VERDICT r2
+missing #6): measure bytes/state and FPSet cost from the actual dense
+layout, project HBM needs at defect scale, and write CAPACITY.md.
+
+Usage: python scripts/capacity.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import force_cpu
+if os.environ.get("TPUVSR_TPU") != "1":
+    force_cpu()
+
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.models.vsr import VSRCodec
+
+REFERENCE = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+
+def state_bytes(max_msgs):
+    codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
+    z = codec.zero_state()
+    per = {k: int(np.prod(np.shape(v)) or 1) * 4 for k, v in z.items()}
+    return sum(per.values()), per, codec.shape
+
+
+HBM_PER_CHIP = 16 << 30          # v5e
+CHIPS = 8
+FP_SLOT_BYTES = 20               # [cap, 5] uint32 FPSet slot
+LOAD = 0.5                       # max healthy FPSet load factor
+
+rows = []
+for M in (48, 64, 96, 128):
+    sb, per, shape = state_bytes(M)
+    rows.append((M, sb))
+
+sb48, per48, shape48 = state_bytes(48)
+
+fp_cap_total = int(CHIPS * HBM_PER_CHIP * 0.5 / FP_SLOT_BYTES * LOAD)
+
+out = f"""# CAPACITY — defect-config BFS sizing (VSR.tla, R=3, |Values|=3, timer=3)
+
+Derived from the actual dense layout (`models/vsr.py` `zero_state`)
+for the defect fixture (`examples/VSR_defect.cfg`); reference baseline:
+multiple days + >=500 GB disk on a large CPU box
+(/root/reference/README.md:20).
+
+## Bytes per dense state (int32 struct-of-arrays)
+
+| MAX_MSGS | bytes/state |
+|---|---|
+""" + "\n".join(f"| {m} | {b:,} |" for m, b in rows) + f"""
+
+Top contributors at MAX_MSGS=48 (bytes):
+""" + "\n".join(f"- `{k}`: {v:,}"
+                for k, v in sorted(per48.items(), key=lambda kv: -kv[1])[:6])
+out += f"""
+
+Shapes: R={shape48.R}, V={shape48.V}, MAX_OPS={shape48.MAX_OPS},
+MAX_VIEW={shape48.MAX_VIEW}.
+
+## HBM budget on a v5e-8 (16 GB/chip x 8)
+
+- **Fingerprints**: 20 B/slot (claim word + 128-bit fp).  At <= {LOAD:.0%}
+  load with half of HBM given to the FPSet, the 8-chip mesh holds
+  ~**{fp_cap_total / 1e9:.1f} B distinct states** — fingerprint capacity is
+  NOT the binding constraint at defect scale (TLC burned 500 GB of disk
+  largely on queue/state storage, not fingerprints).
+- **Dense frontier**: the binding constraint.  At ~{sb48 / 1024:.1f} KiB/state
+  (MAX_MSGS=48), one chip's spare ~6 GB holds ~**{6e9 / sb48 / 1e6:.1f} M
+  frontier states** ({CHIPS * 6e9 / sb48 / 1e6:.0f} M mesh-wide); a
+  defect-scale BFS level can exceed that.  Mitigations, in order:
+  1. the frontier/next buffers already stream in tiles — only the FPSet
+     must be resident; frontier tiles can page from host RAM over PCIe
+     at a cost proportional to bytes/state x generated/s;
+  2. bag-slot compression (the m_log plane is {per48['m_log']:,} B/state,
+     {per48['m_log'] / sb48:.0%} of the state — most slots carry no log;
+     a content-addressed side table of distinct logs would cut the
+     frontier footprint by roughly that fraction);
+  3. sharding the frontier over more hosts (DCN tier).
+- **Trace pointers**: 10 B/state on host; 1e9 states = 10 GB host RAM
+  (the 125 GB host holds ~12 B states).
+
+## Measured throughput anchors
+
+(From `BENCH_*.json` / `scripts/hunt_result.json` where available; the
+flagship BFS to the violation needs both a frontier-paging tier and a
+TPU-backend run, neither of which this round's dead TPU tunnel allowed
+— the numbers below are CPU-backend anchors.)
+"""
+
+bench_path = os.path.join(REPO, "BENCH_r02.json")
+if os.path.exists(bench_path):
+    with open(bench_path) as f:
+        b = json.load(f).get("parsed", {})
+    out += (f"\n- round-2 shrunken-flagship BFS: "
+            f"{b.get('value')} distinct/s, "
+            f"{b.get('generated_per_s')} generated/s "
+            f"({b.get('backend')}).\n")
+hunt_path = os.path.join(REPO, "scripts", "hunt_result.json")
+if os.path.exists(hunt_path):
+    with open(hunt_path) as f:
+        h = json.load(f)
+    out += (f"- guided-simulation time-to-violation on the defect "
+            f"fixture: {h.get('time_to_violation_s')} s "
+            f"({h.get('backend')}, {h.get('walkers')} walkers, "
+            f"seed {h.get('seed')}).\n")
+
+out += """
+## Projection to the <1 h north star (v5e-8)
+
+The exhaustive-BFS route needs ~1e9-1e10 distinct states (unmeasured —
+TLC's 500 GB disk / multi-day run bounds it loosely from above) at
+>=3 M distinct/s sustained to finish inside an hour; fingerprint
+capacity supports it, frontier paging is the engineering risk.  The
+simulation route (the reference's own recommendation, README:22) needs
+no FPSet at all and parallelizes perfectly: the guided
+importance-splitting hunt already reproduces the violation on CPU (see
+anchor above when present); on a v5e-8 the same walker program scales
+~linearly with lane count x clock, putting time-to-violation well
+under the hour target.
+"""
+
+with open(os.path.join(REPO, "CAPACITY.md"), "w") as f:
+    f.write(out)
+print(out)

@@ -1,0 +1,68 @@
+"""BASELINE configs[2] scale simulation: 1e6 random walks x depth 100
+(TLC-uniform successor sampling, invariants checked every step).
+
+Runs as many walks of the target shape as the time budget allows and
+records measured walks/s + the projected wall clock for the full 1e6
+— honest about backend and completion.  Writes scripts/sim_scale.json.
+
+Usage: python scripts/sim_scale.py [walkers] [max_seconds] [num_walks]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import force_cpu
+if os.environ.get("TPUVSR_TPU") != "1":
+    force_cpu()
+
+walkers = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+max_seconds = float(sys.argv[2]) if len(sys.argv) > 2 else 900
+num = int(sys.argv[3]) if len(sys.argv) > 3 else 10**6
+
+from tpuvsr.engine.device_sim import DeviceSimulator
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+
+REFERENCE = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+
+mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+cfg = parse_cfg_file(f"{REPO}/examples/VSR_defect.cfg")
+spec = SpecModel(mod, cfg)
+
+import jax
+backend = jax.default_backend()
+print(f"backend: {backend}", file=sys.stderr, flush=True)
+
+sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=25, max_msgs=64)
+t0 = time.time()
+res = sim.run(num=num, depth=100, seed=0, max_seconds=max_seconds,
+              log=lambda m: print(f"sim: {m} ({time.time()-t0:.0f}s)",
+                                  file=sys.stderr, flush=True))
+el = res.elapsed
+walks_per_s = res.walks / el if el > 0 else 0.0
+out = {
+    "target": {"num_walks": num, "depth": 100,
+               "config": "VSR defect fixture (R=3, |Values|=3, timer=3)"},
+    "walkers": walkers,
+    "walks_completed": res.walks,
+    "steps": res.steps,
+    "elapsed_s": round(el, 1),
+    "walks_per_s": round(walks_per_s, 2),
+    "steps_per_s": round(res.steps / el, 1) if el > 0 else 0.0,
+    "projected_s_for_1e6_walks": round(10**6 / walks_per_s, 1)
+    if walks_per_s else None,
+    "completed_target": res.walks >= num,
+    "ok": res.ok,
+    "violated": res.violated_invariant,
+    "backend": backend,
+}
+print(json.dumps(out))
+with open(os.path.join(REPO, "scripts", "sim_scale.json"), "w") as f:
+    json.dump(out, f, indent=1)
