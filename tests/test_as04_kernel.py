@@ -1,0 +1,206 @@
+"""Differential tests for the AS04 device kernel (VR_APP_STATE) vs the
+interpreter oracle — pinning the AS04 deltas over ST03: the
+MaybeExecuteOps app-state executor on every commit-advancing path,
+recv_dvc-set quorums (dense slots, seed-with-carrier, never-lowered
+commit), the ReceiveMatchingSVC sent_dvc guard, and
+NoAppStateDivergence.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import (REFERENCE, explore_states, requires_reference,
+                            state_key)
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.models.as04 import AS04Codec
+from tpuvsr.models.as04_kernel import ACTION_NAMES, AS04Kernel
+from tpuvsr.models.registry import value_perm_table
+
+pytestmark = requires_reference
+
+AS04_DIR = f"{REFERENCE}/analysis/04-application-state"
+
+
+def _load(overrides=None, max_msgs=48, symmetry=False):
+    mod = parse_module_file(f"{AS04_DIR}/VR_APP_STATE.tla")
+    cfg = parse_cfg_file(f"{AS04_DIR}/VR_APP_STATE.cfg")
+    if overrides:
+        from tpuvsr.frontend.cfg import _parse_value
+        for k, v in overrides.items():
+            cfg.constants[k] = _parse_value(v)
+    if symmetry:
+        cfg.symmetry = "symmValues"
+    spec = SpecModel(mod, cfg)
+    codec = AS04Codec(spec.ev.constants, max_msgs=max_msgs)
+    kern = AS04Kernel(codec, perms=value_perm_table(spec, codec))
+    return spec, codec, kern
+
+
+def _interp_succs(spec, st):
+    out = {}
+    for action, succ in spec.successors(st):
+        out.setdefault(action.name, set()).add(state_key(succ))
+    return out
+
+
+def _kernel_succs(kern, codec, st):
+    dense = codec.encode(st)
+    succs, enabled = kern.step_batch(
+        {k: np.asarray(v)[None] for k, v in dense.items()})
+    enabled = np.asarray(enabled)[0]
+    succs = {k: np.asarray(v)[0] for k, v in succs.items()}
+    out = {}
+    for lane in np.nonzero(enabled)[0]:
+        d = {k: v[lane] for k, v in succs.items()}
+        assert int(d["err"]) == 0, \
+            f"kernel error flag {int(d['err'])} on lane {lane}"
+        name = ACTION_NAMES[kern.lane_action[lane]]
+        out.setdefault(name, set()).add(state_key(codec.decode(d)))
+    return out
+
+
+def _assert_same(spec, codec, kern, states):
+    for n, st in enumerate(states):
+        want = _interp_succs(spec, st)
+        got = _kernel_succs(kern, codec, st)
+        assert set(want) == set(got), (
+            f"state {n}: enabled action sets differ: "
+            f"interp-only={set(want) - set(got)}, "
+            f"kernel-only={set(got) - set(want)}")
+        for name in want:
+            assert want[name] == got[name], \
+                f"state {n}: successors differ for action {name}"
+
+
+def test_kernel_smoke_init():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1"})
+    st = next(iter(spec.init_states()))
+    want = _interp_succs(spec, st)
+    got = _kernel_succs(kern, codec, st)
+    assert set(want) == set(got)
+    for name in want:
+        assert want[name] == got[name]
+
+
+def test_kernel_matches_interpreter_small():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1"})
+    states = explore_states(spec, 120)
+    _assert_same(spec, codec, kern, states[::3])
+
+
+@pytest.mark.slow
+def test_kernel_matches_interpreter_shipped_cfg():
+    spec, codec, kern = _load()
+    states = explore_states(spec, 160)
+    _assert_same(spec, codec, kern, states[::4])
+
+
+@pytest.mark.slow
+def test_kernel_matches_interpreter_app_exec_era():
+    # states where app state is non-empty on some replica (the executor
+    # has run) and view-change interleavings above them
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "2"})
+    states = explore_states(spec, 2000)
+    era = [s for s in states
+           if any(len(s["rep_app_state"].apply(r)) > 0
+                  for r in sorted(s["replicas"]))]
+    assert era, "exploration never executed an op"
+    _assert_same(spec, codec, kern, era[::6])
+
+
+def test_incremental_fingerprint_matches_full():
+    import jax
+    import jax.numpy as jnp
+
+    spec, codec, kern = _load({"StartViewOnTimerLimit": "1",
+                               "NoProgressChangeLimit": "1"},
+                              max_msgs=40, symmetry=True)
+
+    def both(st):
+        parts = kern.parent_parts(st)
+        outs = []
+        for name, fn in zip(ACTION_NAMES, kern._action_fns()):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+
+            def lane_eval(lane, fn=fn, name=name):
+                succ, en = fn(kern.seed_touch(st), lane)
+                ri = kern.lane_replica(name, st, lane)
+                inc = kern.fingerprint_incremental(succ, ri, parts, st)
+                full = kern.fingerprint(
+                    {k: v for k, v in succ.items()
+                     if not k.startswith("_")})
+                return inc, full, en
+            outs.append(jax.vmap(lane_eval)(lanes))
+        return tuple(jnp.concatenate([o[i] for o in outs])
+                     for i in range(3))
+
+    both_j = jax.jit(both)
+    states = explore_states(spec, 70)[::5]
+    for st in states:
+        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
+        inc, full, en = both_j(dense)
+        en = np.asarray(en)
+        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
+
+
+def test_guard_fns_match_action_enabledness():
+    import jax
+    import jax.numpy as jnp
+
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1",
+                               "NoProgressChangeLimit": "1"})
+    states = explore_states(spec, 100)[::2]
+    gfns = kern._guard_fns()
+    afns = kern._action_fns()
+
+    @jax.jit
+    def all_en(dense):
+        outs_g, outs_a = [], []
+        for name, g, a in zip(ACTION_NAMES, gfns, afns):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+            outs_g.append(jax.vmap(lambda ln, g=g: g(dense, ln))(lanes))
+            outs_a.append(jax.vmap(
+                lambda ln, a=a: a(dense, ln)[1])(lanes))
+        return jnp.concatenate(outs_g), jnp.concatenate(outs_a)
+
+    for st in states:
+        dense = {k: jnp.asarray(v) for k, v in codec.encode(st).items()}
+        g, a = all_en(dense)
+        assert (np.asarray(g) == np.asarray(a)).all()
+
+
+@pytest.mark.slow
+def test_device_bfs_fixpoint_matches_interpreter():
+    from tpuvsr.engine.bfs import bfs_check
+    from tpuvsr.engine.device_bfs import DeviceBFS
+
+    mod = parse_module_file(f"{AS04_DIR}/VR_APP_STATE.tla")
+    cfg = parse_cfg_file(f"{AS04_DIR}/VR_APP_STATE.cfg")
+    from tpuvsr.frontend.cfg import _parse_value
+    cfg.constants["Values"] = _parse_value("{v1}")
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    spec = SpecModel(mod, cfg)
+    want = bfs_check(spec)
+    assert want.ok
+    eng = DeviceBFS(spec, tile_size=64)
+    got = eng.run()
+    assert got.ok
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.states_generated == want.states_generated
+
+
+def test_registry_resolves_as04():
+    from tpuvsr.models import registry
+    mod = parse_module_file(f"{AS04_DIR}/VR_APP_STATE.tla")
+    cfg = parse_cfg_file(f"{AS04_DIR}/VR_APP_STATE.cfg")
+    spec = SpecModel(mod, cfg)
+    assert registry.has_device_model(spec)
+    codec, kern = registry.make_model(spec)
+    assert kern.action_names == ACTION_NAMES

@@ -72,4 +72,8 @@ def _resolve(name):
         from .st03 import ST03Codec
         from .st03_kernel import ST03Kernel
         return ST03Codec, ST03Kernel
+    if name == "VR_APP_STATE":
+        from .as04 import AS04Codec
+        from .as04_kernel import AS04Kernel
+        return AS04Codec, AS04Kernel
     raise KeyError(name)

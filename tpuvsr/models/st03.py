@@ -184,6 +184,11 @@ class ST03Codec:
         return hdr, entry, log
 
     def encode(self, st: dict):
+        return self._encode_common(st)
+
+    def _encode_common(self, st: dict):
+        """The ST03-shaped portion of the encoding (subclasses add
+        their extra planes on top of the returned dense dict)."""
         s = self.shape
         d = self.zero_state()
         for r in range(1, s.R + 1):

@@ -71,6 +71,10 @@ def _lex_less(a, b):
 
 class ST03Kernel:
     action_names = ACTION_NAMES
+    REP_KEYS = REP_KEYS          # per-replica hashed planes (class attr
+                                 # so subclasses can extend the layout)
+    # value-id planes a symmetry permutation must remap
+    PERM_REP_KEYS = ("log",)
 
     def __init__(self, codec: ST03Codec, perms: np.ndarray = None):
         self.codec = codec
@@ -82,7 +86,7 @@ class ST03Kernel:
         self.perms = np.asarray(perms, dtype=np.int32)
 
         acts, params = [], []
-        for aid, name in enumerate(ACTION_NAMES):
+        for aid, name in enumerate(self.action_names):
             n = self._lane_count(name)
             acts.append(np.full(n, aid, np.int32))
             params.append(np.arange(n, dtype=np.int32))
@@ -92,7 +96,7 @@ class ST03Kernel:
 
         rng = np.random.default_rng(0x57A7E03)
         nrep = 1 + sum(int(np.prod(self._rep_shape(k))) // s.R
-                       for k in REP_KEYS)
+                       for k in self.REP_KEYS)
         nmsg = NHDR + 1 + self.MAX_OPS + 1      # hdr, entry, log, count
         nglob = s.R + 1                          # no_prog plane + ctr
 
@@ -724,7 +728,7 @@ class ST03Kernel:
     def step_all(self, st):
         st = {k: jnp.asarray(v, I32) for k, v in st.items()}
         parts, ens = [], []
-        for name, fn in zip(ACTION_NAMES, self._action_fns()):
+        for name, fn in zip(self.action_names, self._action_fns()):
             lanes = jnp.arange(self._lane_count(name), dtype=I32)
             succ, en = jax.vmap(fn, in_axes=(None, 0))(st, lanes)
             parts.append(succ)
@@ -749,7 +753,8 @@ class ST03Kernel:
 
     def _permuted(self, st, perm):
         st = dict(st)
-        st["log"] = perm[st["log"]]
+        for k in self.PERM_REP_KEYS:
+            st[k] = perm[st[k]]
         st["m_log"] = perm[st["m_log"]]
         st["m_entry"] = perm[st["m_entry"]]
         return st
@@ -757,7 +762,7 @@ class ST03Kernel:
     def _rep_rows(self, st):
         R = self.R
         cols = [jnp.arange(R, dtype=jnp.uint32)[:, None]]
-        for k in REP_KEYS:
+        for k in self.REP_KEYS:
             cols.append(jnp.asarray(st[k], jnp.uint32).reshape(R, -1))
         return jnp.concatenate(cols, axis=1)
 
@@ -827,9 +832,9 @@ class ST03Kernel:
 
     def _rep_row_one(self, st, i, perm):
         cols = [jnp.asarray(i, jnp.uint32)[None]]
-        for k in REP_KEYS:
+        for k in self.REP_KEYS:
             v = st[k][i]
-            if k == "log":
+            if k in self.PERM_REP_KEYS:
                 v = perm[v]
             cols.append(jnp.asarray(v, jnp.uint32).reshape(-1))
         return jnp.concatenate(cols)
