@@ -86,6 +86,49 @@ def vsr_spec(values=("v1",), timer=1, restarts=0, symmetry=False,
     return SpecModel(mod, cfg)
 
 
+def interp_succs(spec, st):
+    """Per-action successor-state-key sets from the interpreter."""
+    out = {}
+    for action, succ in spec.successors(st):
+        out.setdefault(action.name, set()).add(state_key(succ))
+    return out
+
+
+def kernel_succs(kern, codec, st):
+    """Per-action successor-state-key sets from a device kernel
+    (encode -> step_batch -> decode)."""
+    import numpy as np
+    dense = codec.encode(st)
+    succs, enabled = kern.step_batch(
+        {k: np.asarray(v)[None] for k, v in dense.items()})
+    enabled = np.asarray(enabled)[0]
+    succs = {k: np.asarray(v)[0] for k, v in succs.items()}
+    out = {}
+    for lane in np.nonzero(enabled)[0]:
+        d = {k: v[lane] for k, v in succs.items()}
+        assert int(d["err"]) == 0, \
+            f"kernel error flag {int(d['err'])} on lane {lane}"
+        name = kern.action_names[kern.lane_action[lane]]
+        out.setdefault(name, set()).add(state_key(codec.decode(d)))
+    return out
+
+
+def assert_kernel_matches(spec, codec, kern, states):
+    """The exact successor multiset per action produced by the kernel
+    must equal the interpreter's, for every given state — the standing
+    differential harness every device kernel is held to."""
+    for n, st in enumerate(states):
+        want = interp_succs(spec, st)
+        got = kernel_succs(kern, codec, st)
+        assert set(want) == set(got), (
+            f"state {n}: enabled action sets differ: "
+            f"interp-only={set(want) - set(got)}, "
+            f"kernel-only={set(got) - set(want)}")
+        for name in want:
+            assert want[name] == got[name], \
+                f"state {n}: successors differ for action {name}"
+
+
 def reference_available():
     return os.path.isdir(REFERENCE)
 

@@ -11,8 +11,9 @@ upsert, CHOOSE tie-break, and frame condition of all 19 actions.
 import numpy as np
 import pytest
 
-from tests.conftest import (REFERENCE, explore_states, requires_reference,
-                            state_key)
+from tests.conftest import (REFERENCE, assert_kernel_matches,
+                            explore_states, interp_succs,
+                            kernel_succs, requires_reference)
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
@@ -35,42 +36,6 @@ def _load(overrides=None, max_msgs=48):
     return spec, codec, kern
 
 
-def _interp_succs(spec, st):
-    out = {}
-    for action, succ in spec.successors(st):
-        out.setdefault(action.name, set()).add(state_key(succ))
-    return out
-
-
-def _kernel_succs(kern, codec, st):
-    dense = codec.encode(st)
-    succs, enabled = kern.step_batch(
-        {k: np.asarray(v)[None] for k, v in dense.items()})
-    enabled = np.asarray(enabled)[0]
-    succs = {k: np.asarray(v)[0] for k, v in succs.items()}
-    out = {}
-    for lane in np.nonzero(enabled)[0]:
-        d = {k: v[lane] for k, v in succs.items()}
-        assert int(d["err"]) == 0, \
-            f"kernel error flag {int(d['err'])} on lane {lane}"
-        name = ACTION_NAMES[kern.lane_action[lane]]
-        out.setdefault(name, set()).add(state_key(codec.decode(d)))
-    return out
-
-
-def _assert_same(spec, codec, kern, states):
-    for n, st in enumerate(states):
-        want = _interp_succs(spec, st)
-        got = _kernel_succs(kern, codec, st)
-        assert set(want) == set(got), (
-            f"state {n}: enabled action sets differ: "
-            f"interp-only={set(want) - set(got)}, "
-            f"kernel-only={set(got) - set(want)}")
-        for name in want:
-            assert want[name] == got[name], \
-                f"state {n}: successors differ for action {name}"
-
-
 @pytest.mark.slow
 def test_kernel_matches_interpreter_vsr_cfg():
     # shipped config: R=3, C=1, Values={v1,v2}, timer=2, restarts=0
@@ -78,7 +43,7 @@ def test_kernel_matches_interpreter_vsr_cfg():
     states = explore_states(spec, 160)
     # thin out while keeping BFS depth coverage (late states exercise
     # view-change + state-transfer paths)
-    _assert_same(spec, codec, kern, states[::4])
+    assert_kernel_matches(spec, codec, kern, states[::4])
 
 
 @pytest.mark.slow
@@ -93,7 +58,7 @@ def test_kernel_matches_interpreter_recovery_era():
            if any(len(s["rep_rec_recv"].apply(r)) > 0
                   for r in range(1, 4)) or s["aux_restart"] > 0]
     assert rec, "exploration never reached the recovery era"
-    _assert_same(spec, codec, kern, rec[::6] + states[:40:4])
+    assert_kernel_matches(spec, codec, kern, rec[::6] + states[:40:4])
 
 
 @pytest.mark.parametrize("values,timer,symmetry", [
@@ -148,8 +113,8 @@ def test_incremental_fingerprint_matches_full(values, timer, symmetry):
 def test_kernel_smoke_init():
     spec, codec, kern = _load()
     st = next(iter(spec.init_states()))
-    want = _interp_succs(spec, st)
-    got = _kernel_succs(kern, codec, st)
+    want = interp_succs(spec, st)
+    got = kernel_succs(kern, codec, st)
     assert set(want) == set(got)
     for name in want:
         assert want[name] == got[name]

@@ -1,0 +1,141 @@
+"""jit+vmap transition kernel for VR_ASSUME_NEWVIEWCHANGE (A01).
+
+Subclasses the ST03 kernel dropping the three state-transfer actions
+(A01's 13-action Next, A01:661-677) and applying the assume-mode guard
+differences:
+
+* ``TimerSendSVC`` is blocked only for the CURRENT PRIMARY regardless
+  of status (``~IsPrimary(r)``, A01:411 — a mid-view-change primary
+  still cannot fire its timer, unlike ST03:521 which only exempts a
+  *Normal* primary);
+* ``ReceiveSV`` accepts any ``m.view_number >= View(r)`` with no
+  status conjunct (A01:621-624 — the paper-faithful loose guard ST03
+  later tightens, SURVEY.md §2.7.7);
+* log entries are packed (value_id << 8 | view) ints (models/a01.py),
+  so value-permutation remapping and the ReceiveClientRequest /
+  ExecuteOp entry handling go through the packing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .a01 import ENTRY_VIEW_BITS, A01Codec
+from .st03 import M_PREPARE, M_SV, NORMAL
+from .st03_kernel import I32, ST03Kernel
+from .vsr import H_VIEW
+
+ACTION_NAMES = (
+    "TimerSendSVC", "ReceiveHigherSVC", "ReceiveMatchingSVC", "SendDVC",
+    "ReceiveHigherDVC", "ReceiveMatchingDVC", "SendSV", "ReceiveSV",
+    "ReceiveClientRequest", "ReceivePrepareMsg", "ReceivePrepareOkMsg",
+    "ExecuteOp", "NoProgressChange",
+)
+
+
+class A01Kernel(ST03Kernel):
+    action_names = ACTION_NAMES
+
+    def __init__(self, codec: A01Codec, perms=None):
+        super().__init__(codec, perms=perms)
+
+    def _perm_vals(self, arr, perm):
+        # packed entries: remap the value-id field, keep the view field
+        vid = arr >> ENTRY_VIEW_BITS
+        view = arr & ((1 << ENTRY_VIEW_BITS) - 1)
+        return jnp.where(arr > 0, (perm[vid] << ENTRY_VIEW_BITS) | view,
+                         arr)
+
+    def _is_primary(self, st, i, r):
+        return self._primary(st["view"][i], self.R) == r
+
+    # -- guard deltas ---------------------------------------------------
+    def act_timer_send_svc(self, st, lane):       # A01:406-424
+        s2, _en = super().act_timer_send_svc(st, lane)
+        i = lane
+        en = ((st["aux_svc"] < self.shape.timer_limit)
+              & self._can_progress(st, i)
+              & ~self._is_primary(st, i, i + 1))
+        return s2, en
+
+    def guard_timer_send_svc(self, st, lane):
+        i = lane
+        return ((st["aux_svc"] < self.shape.timer_limit)
+                & self._can_progress(st, i)
+                & ~self._is_primary(st, i, i + 1))
+
+    def act_receive_sv(self, st, lane):           # A01:617-644
+        s2, _en = super().act_receive_sv(st, lane)
+        return s2, self.guard_receive_sv(st, lane)
+
+    def guard_receive_sv(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_SV) & self._can_progress(st, i)
+                & (st["m_hdr"][k, H_VIEW] >= st["view"][i]))
+
+    # -- packed-entry deltas --------------------------------------------
+    def act_receive_client_request(self, st, lane):  # A01:278-303
+        i = lane // self.V
+        r = i + 1
+        vid = lane % self.V + 1
+        en = (self._can_progress(st, i)
+              & self._is_primary(st, i, r)
+              & (st["status"][i] == NORMAL)
+              & (st["aux_acked"][vid - 1] == 0))
+        opn = st["op"][i] + 1
+        entry = (vid << ENTRY_VIEW_BITS) | st["view"][i]
+        s2 = dict(st)
+        s2["log"] = st["log"].at[i, jnp.clip(opn - 1, 0, self.MAX_OPS - 1)] \
+            .set(entry)
+        s2["op"] = st["op"].at[i].set(opn)
+        s2["aux_acked"] = st["aux_acked"].at[vid - 1].set(1)
+        row = self._row(M_PREPARE, view=st["view"][i], op=opn,
+                        commit=st["commit"][i], src=r, entry=entry)
+        s2 = self._broadcast(s2, row, r)
+        return s2, en
+
+    def act_execute_op(self, st, lane):           # A01:374-391
+        i = lane
+        r = i + 1
+        opn = st["commit"][i] + 1
+        committed = (st["peer_op"][i] >= opn).sum() >= self.R // 2
+        en = (self._can_progress(st, i)
+              & self._is_primary(st, i, r) & (st["status"][i] == NORMAL)
+              & (st["commit"][i] < st["op"][i]) & committed)
+        code = st["log"][i, jnp.clip(opn - 1, 0, self.MAX_OPS - 1)]
+        vid = code >> ENTRY_VIEW_BITS
+        s2 = dict(st)
+        s2["commit"] = st["commit"].at[i].set(opn)
+        s2["aux_acked"] = st["aux_acked"].at[
+            jnp.clip(vid - 1, 0, self.V - 1)].set(2)
+        return s2, en
+
+    def _replica_has_op(self, st):
+        v_ids = jnp.arange(1, self.V + 1, dtype=I32)
+        vids = st["log"] >> ENTRY_VIEW_BITS                  # [R, P]
+        return (vids[:, :, None] == v_ids[None, None, :]).any(axis=1)
+
+    # -- action table (state transfer dropped) --------------------------
+    def _guard_fns(self):
+        return [
+            self.guard_timer_send_svc, self.guard_receive_higher_svc,
+            self.guard_receive_matching_svc, self.guard_send_dvc,
+            self.guard_receive_higher_dvc, self.guard_receive_matching_dvc,
+            self.guard_send_sv, self.guard_receive_sv,
+            self.guard_receive_client_request, self.guard_receive_prepare,
+            self.guard_receive_prepare_ok, self.guard_execute_op,
+            self.guard_no_progress_change,
+        ]
+
+    def _action_fns(self):
+        return [
+            self.act_timer_send_svc, self.act_receive_higher_svc,
+            self.act_receive_matching_svc, self.act_send_dvc,
+            self.act_receive_higher_dvc, self.act_receive_matching_dvc,
+            self.act_send_sv, self.act_receive_sv,
+            self.act_receive_client_request, self.act_receive_prepare,
+            self.act_receive_prepare_ok, self.act_execute_op,
+            self.act_no_progress_change,
+        ]
+    # lane_replica is inherited: ST03's mapping already covers every
+    # A01 action name (the state-transfer branches are unreachable)

@@ -129,7 +129,7 @@ class AS04Kernel(ST03Kernel):
         i = jnp.clip(st["m_hdr"][lane, H_DEST] - 1, 0, self.R - 1)
         return self._clear_dvc(s2, i), en
 
-    def act_timer_send_svc(self, st, lane):       # AS04:848-866
+    def act_timer_send_svc(self, st, lane):       # AS04:551-566
         s2, en = super().act_timer_send_svc(st, lane)
         return self._clear_dvc(s2, lane), en
 

@@ -76,4 +76,8 @@ def _resolve(name):
         from .as04 import AS04Codec
         from .as04_kernel import AS04Kernel
         return AS04Codec, AS04Kernel
+    if name == "VR_ASSUME_NEWVIEWCHANGE":
+        from .a01 import A01Codec
+        from .a01_kernel import A01Kernel
+        return A01Codec, A01Kernel
     raise KeyError(name)

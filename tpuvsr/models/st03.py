@@ -88,13 +88,16 @@ class ST03Codec:
         self.value_id = {v: i + 1 for i, v in enumerate(values)}
         self.values = values
         self.nil = constants["Nil"]
-        self.anydest = constants["AnyDest"]
+        self.anydest = constants.get("AnyDest")   # absent in A01/I01
         self.status_id = {constants["Normal"]: NORMAL,
-                          constants["ViewChange"]: VIEWCHANGE,
-                          constants["StateTransfer"]: STATETRANSFER}
+                          constants["ViewChange"]: VIEWCHANGE}
+        stf = constants.get("StateTransfer")
+        if stf is not None:
+            self.status_id[stf] = STATETRANSFER
         self.status_mv = {i: mv for mv, i in self.status_id.items()}
         self.mtype_id = {constants[cname]: code
-                         for code, cname in MSGTYPE_NAMES.items()}
+                         for code, cname in MSGTYPE_NAMES.items()
+                         if cname in constants}
         self.mtype_mv = {i: mv for mv, i in self.mtype_id.items()}
 
     # -- empty dense state -------------------------------------------------
@@ -136,16 +139,23 @@ class ST03Codec:
         return out
 
     # -- encode ------------------------------------------------------------
+    def _enc_entry(self, e: FnVal) -> int:
+        """One log-entry record -> packed int (ST03 entries are
+        [operation: Values], ST03:105-106; subclasses with richer
+        entries override this pair)."""
+        return self.value_id[e.apply("operation")]
+
     def _enc_log(self, log: FnVal, first_op=1):
         """Log-valued field with domain first_op..first_op+n-1 ->
-        zero-padded [MAX_OPS] value-id row."""
+        zero-padded [MAX_OPS] packed-entry row."""
         row = np.zeros(self.shape.MAX_OPS, np.int32)
         for i in range(len(log)):
-            row[i] = self.value_id[log.apply(first_op + i).apply("operation")]
+            row[i] = self._enc_entry(log.apply(first_op + i))
         return row
 
     def _enc_dest(self, dest):
-        return ANYDEST if dest is self.anydest else dest
+        return ANYDEST if (self.anydest is not None
+                           and dest is self.anydest) else dest
 
     def encode_msg_row(self, m: FnVal):
         hdr = np.zeros(NHDR, np.int32)
@@ -160,7 +170,7 @@ class ST03Codec:
         if t == M_PREPARE:
             hdr[H_OP] = get("op_number")
             hdr[H_COMMIT] = get("commit_number")
-            entry = self.value_id[get("message").apply("operation")]
+            entry = self._enc_entry(get("message"))
         elif t in (M_PREPAREOK, M_GETSTATE):
             hdr[H_OP] = get("op_number")
         elif t == M_SVC:

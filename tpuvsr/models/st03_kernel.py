@@ -751,12 +751,18 @@ class ST03Kernel:
         x = x ^ (x >> 16)
         return x
 
+    def _perm_vals(self, arr, perm):
+        """Apply a value-id permutation to a packed-entry array (ST03
+        entries ARE value ids; subclasses with packed multi-field
+        entries override)."""
+        return perm[arr]
+
     def _permuted(self, st, perm):
         st = dict(st)
         for k in self.PERM_REP_KEYS:
-            st[k] = perm[st[k]]
-        st["m_log"] = perm[st["m_log"]]
-        st["m_entry"] = perm[st["m_entry"]]
+            st[k] = self._perm_vals(st[k], perm)
+        st["m_log"] = self._perm_vals(st["m_log"], perm)
+        st["m_entry"] = self._perm_vals(st["m_entry"], perm)
         return st
 
     def _rep_rows(self, st):
@@ -835,15 +841,17 @@ class ST03Kernel:
         for k in self.REP_KEYS:
             v = st[k][i]
             if k in self.PERM_REP_KEYS:
-                v = perm[v]
+                v = self._perm_vals(v, perm)
             cols.append(jnp.asarray(v, jnp.uint32).reshape(-1))
         return jnp.concatenate(cols)
 
     def _slot_row_one(self, st, m, perm):
         return jnp.concatenate([
             jnp.asarray(st["m_hdr"][m], jnp.uint32),
-            jnp.asarray(perm[st["m_entry"][m]], jnp.uint32)[None],
-            jnp.asarray(perm[st["m_log"][m]], jnp.uint32),
+            jnp.asarray(self._perm_vals(st["m_entry"][m], perm),
+                        jnp.uint32)[None],
+            jnp.asarray(self._perm_vals(st["m_log"][m], perm),
+                        jnp.uint32),
             jnp.asarray(st["m_count"][m], jnp.uint32)[None]])
 
     def fingerprint_incremental(self, succ, ri, parts, parent):
