@@ -129,6 +129,64 @@ def assert_kernel_matches(spec, codec, kern, states):
                 f"state {n}: successors differ for action {name}"
 
 
+def assert_incremental_fp_matches(codec, kern, states):
+    """The O(touched) incremental fingerprint must equal the full-state
+    recompute on every enabled lane of the given states."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def both(st):
+        parts = kern.parent_parts(st)
+        outs = []
+        for name, fn in zip(kern.action_names, kern._action_fns()):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+
+            def lane_eval(lane, fn=fn, name=name):
+                succ, en = fn(kern.seed_touch(st), lane)
+                ri = kern.lane_replica(name, st, lane)
+                inc = kern.fingerprint_incremental(succ, ri, parts, st)
+                full = kern.fingerprint(
+                    {k: v for k, v in succ.items()
+                     if not k.startswith("_")})
+                return inc, full, en
+            outs.append(jax.vmap(lane_eval)(lanes))
+        return tuple(jnp.concatenate([o[i] for o in outs])
+                     for i in range(3))
+
+    both_j = jax.jit(both)
+    for st in states:
+        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
+        inc, full, en = both_j(dense)
+        en = np.asarray(en)
+        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
+
+
+def assert_guards_match_actions(codec, kern, states):
+    """The cheap guard pass must agree with the action fns' own `en`
+    on every lane of every given state."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    gfns = kern._guard_fns()
+    afns = kern._action_fns()
+
+    @jax.jit
+    def all_en(dense):
+        outs_g, outs_a = [], []
+        for name, g, a in zip(kern.action_names, gfns, afns):
+            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
+            outs_g.append(jax.vmap(lambda ln, g=g: g(dense, ln))(lanes))
+            outs_a.append(jax.vmap(
+                lambda ln, a=a: a(dense, ln)[1])(lanes))
+        return jnp.concatenate(outs_g), jnp.concatenate(outs_a)
+
+    for st in states:
+        dense = {k: jnp.asarray(v) for k, v in codec.encode(st).items()}
+        g, a = all_en(dense)
+        assert (np.asarray(g) == np.asarray(a)).all()
+
+
 def reference_available():
     return os.path.isdir(REFERENCE)
 

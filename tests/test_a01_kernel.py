@@ -7,9 +7,11 @@ TimerSendSVC primary exemption, and the loose ReceiveSV guard.
 import numpy as np
 import pytest
 
-from tests.conftest import (REFERENCE, assert_kernel_matches,
-                            explore_states, interp_succs,
-                            kernel_succs, requires_reference)
+from tests.conftest import (REFERENCE, assert_guards_match_actions,
+                            assert_incremental_fp_matches,
+                            assert_kernel_matches, explore_states,
+                            interp_succs, kernel_succs,
+                            requires_reference)
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
@@ -74,38 +76,10 @@ def test_kernel_matches_interpreter_no_progress_era():
 
 
 def test_incremental_fingerprint_matches_full():
-    import jax
-    import jax.numpy as jnp
-
     spec, codec, kern = _load({"StartViewOnTimerLimit": "1"},
                               max_msgs=40, symmetry=True)
-
-    def both(st):
-        parts = kern.parent_parts(st)
-        outs = []
-        for name, fn in zip(ACTION_NAMES, kern._action_fns()):
-            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
-
-            def lane_eval(lane, fn=fn, name=name):
-                succ, en = fn(kern.seed_touch(st), lane)
-                ri = kern.lane_replica(name, st, lane)
-                inc = kern.fingerprint_incremental(succ, ri, parts, st)
-                full = kern.fingerprint(
-                    {k: v for k, v in succ.items()
-                     if not k.startswith("_")})
-                return inc, full, en
-            outs.append(jax.vmap(lane_eval)(lanes))
-        return tuple(jnp.concatenate([o[i] for o in outs])
-                     for i in range(3))
-
-    both_j = jax.jit(both)
     states = explore_states(spec, 70)[::5]
-    for st in states:
-        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
-        inc, full, en = both_j(dense)
-        en = np.asarray(en)
-        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
-
+    assert_incremental_fp_matches(codec, kern, states)
 
 @pytest.mark.slow
 def test_device_bfs_fixpoint_matches_interpreter():

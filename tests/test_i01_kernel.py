@@ -1,0 +1,169 @@
+"""Differential tests for the I01 device kernel (VR_INC_RESEND) vs the
+interpreter oracle — pinning the increment-mode deltas: View(r)+1
+adoptions, ResendSVC (per-peer lanes over bag predicates), the
+mixed-view DVC tracker with replacement semantics, HighestViewNumber
+adoption at SendSV, and the two I01-only invariants.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import (REFERENCE, assert_guards_match_actions,
+                            assert_incremental_fp_matches,
+                            assert_kernel_matches, explore_states,
+                            interp_succs, kernel_succs,
+                            requires_reference)
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_file
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.models.i01 import I01Codec
+from tpuvsr.models.i01_kernel import ACTION_NAMES, I01Kernel
+from tpuvsr.models.registry import value_perm_table
+
+pytestmark = requires_reference
+
+I01_DIR = f"{REFERENCE}/analysis/01-view-changes"
+
+
+def _load(overrides=None, max_msgs=48, symmetry=False):
+    mod = parse_module_file(f"{I01_DIR}/VR_INC_RESEND.tla")
+    cfg = parse_cfg_file(f"{I01_DIR}/VR_INC_RESEND.cfg")
+    if overrides:
+        from tpuvsr.frontend.cfg import _parse_value
+        for k, v in overrides.items():
+            cfg.constants[k] = _parse_value(v)
+    if symmetry:
+        cfg.symmetry = "symmValues"
+    spec = SpecModel(mod, cfg)
+    codec = I01Codec(spec.ev.constants, max_msgs=max_msgs)
+    kern = I01Kernel(codec, perms=value_perm_table(spec, codec))
+    return spec, codec, kern
+
+
+def test_kernel_smoke_init():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1"})
+    st = next(iter(spec.init_states()))
+    want = interp_succs(spec, st)
+    got = kernel_succs(kern, codec, st)
+    assert set(want) == set(got)
+    for name in want:
+        assert want[name] == got[name]
+
+
+def test_kernel_matches_interpreter_small():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1"})
+    states = explore_states(spec, 120)
+    assert_kernel_matches(spec, codec, kern, states[::3])
+
+
+@pytest.mark.slow
+def test_kernel_matches_interpreter_shipped_cfg():
+    # shipped config: R=3, Values={v1,v2}, timer=2, np_limit=0
+    spec, codec, kern = _load()
+    states = explore_states(spec, 160)
+    assert_kernel_matches(spec, codec, kern, states[::4])
+
+
+@pytest.mark.slow
+def test_kernel_matches_interpreter_tracker_era():
+    # states where some tracker holds entries — the machinery I01 adds
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "2"})
+    states = explore_states(spec, 1500)
+    era = [s for s in states
+           if any(len(s["rep_recv_dvc"].apply(r)) > 0
+                  for r in sorted(s["replicas"]))]
+    assert era, "exploration never registered a DVC"
+    assert_kernel_matches(spec, codec, kern, era[::6])
+
+
+def test_kernel_matches_interpreter_mixed_view_tracker():
+    """Mixed-view tracker states (ReceivedDVCsAllSameView's violation
+    region) are deep — shallow exploration never reaches one, so build
+    them directly: take reachable tracker states and graft in a second
+    DVC with a DIFFERENT view from another source.  Both engines must
+    still agree on every successor — this is what pins
+    _highest_tracker's valid-mask + CHOOSE tie-break over mixed views
+    (I01:610-645)."""
+    from tpuvsr.core.values import FnVal
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "2"})
+    dvc_mv = spec.ev.constants["DoViewChangeMsg"]
+    states = explore_states(spec, 1200)
+    built = []
+    for s in states:
+        for r in sorted(s["replicas"]):
+            entries = s["rep_recv_dvc"].apply(r)
+            if not entries:
+                continue
+            e0 = next(iter(entries))
+            srcs = {m.apply("source") for m in entries}
+            other = next((x for x in sorted(s["replicas"])
+                          if x not in srcs), None)
+            if other is None:
+                continue
+            graft = FnVal([("type", dvc_mv),
+                           ("view_number", e0.apply("view_number") + 1),
+                           ("log", FnVal(())), ("last_normal_vn", 1),
+                           ("op_number", 0), ("commit_number", 0),
+                           ("dest", r), ("source", other)])
+            st2 = dict(s)
+            st2["rep_recv_dvc"] = s["rep_recv_dvc"].updated(
+                r, frozenset(entries) | {graft})
+            built.append(st2)
+            break
+        if len(built) >= 8:
+            break
+    assert built, "no tracker state to graft onto"
+    # sanity: the grafted states really are mixed-view
+    assert any(
+        len({m.apply("view_number") for m in st["rep_recv_dvc"].apply(r)})
+        > 1
+        for st in built for r in sorted(st["replicas"]))
+    assert_kernel_matches(spec, codec, kern, built)
+
+
+def test_incremental_fingerprint_matches_full():
+    spec, codec, kern = _load({"StartViewOnTimerLimit": "1"},
+                              max_msgs=40, symmetry=True)
+    states = explore_states(spec, 70)[::5]
+    assert_incremental_fp_matches(codec, kern, states)
+
+def test_guard_fns_match_action_enabledness():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1",
+                               "NoProgressChangeLimit": "1"})
+    states = explore_states(spec, 120)[::2]
+    assert_guards_match_actions(codec, kern, states)
+
+@pytest.mark.slow
+def test_device_bfs_fixpoint_matches_interpreter():
+    from tpuvsr.engine.bfs import bfs_check
+    from tpuvsr.engine.device_bfs import DeviceBFS
+
+    mod = parse_module_file(f"{I01_DIR}/VR_INC_RESEND.tla")
+    cfg = parse_cfg_file(f"{I01_DIR}/VR_INC_RESEND.cfg")
+    from tpuvsr.frontend.cfg import _parse_value
+    cfg.constants["Values"] = _parse_value("{v1}")
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    spec = SpecModel(mod, cfg)
+    want = bfs_check(spec)
+    assert want.ok
+    eng = DeviceBFS(spec, tile_size=64)
+    got = eng.run()
+    assert got.ok
+    assert got.distinct_states == want.distinct_states
+    assert got.diameter == want.diameter
+    assert got.states_generated == want.states_generated
+
+
+def test_registry_resolves_i01():
+    from tpuvsr.models import registry
+    mod = parse_module_file(f"{I01_DIR}/VR_INC_RESEND.tla")
+    cfg = parse_cfg_file(f"{I01_DIR}/VR_INC_RESEND.cfg")
+    spec = SpecModel(mod, cfg)
+    assert registry.has_device_model(spec)
+    codec, kern = registry.make_model(spec)
+    assert kern.action_names == ACTION_NAMES

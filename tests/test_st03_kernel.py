@@ -9,9 +9,11 @@ SUBSET enumeration.
 import numpy as np
 import pytest
 
-from tests.conftest import (REFERENCE, assert_kernel_matches,
-                            explore_states, interp_succs,
-                            kernel_succs, requires_reference)
+from tests.conftest import (REFERENCE, assert_guards_match_actions,
+                            assert_incremental_fp_matches,
+                            assert_kernel_matches, explore_states,
+                            interp_succs, kernel_succs,
+                            requires_reference)
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
@@ -98,66 +100,18 @@ def test_kernel_matches_interpreter_no_progress_era():
 
 
 def test_incremental_fingerprint_matches_full():
-    import jax
-    import jax.numpy as jnp
-
     spec, codec, kern = _load({"StartViewOnTimerLimit": "1",
                                "NoProgressChangeLimit": "1"},
                               max_msgs=40, symmetry=True)
-
-    def both(st):
-        parts = kern.parent_parts(st)
-        outs = []
-        for name, fn in zip(ACTION_NAMES, kern._action_fns()):
-            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
-
-            def lane_eval(lane, fn=fn, name=name):
-                succ, en = fn(kern.seed_touch(st), lane)
-                ri = kern.lane_replica(name, st, lane)
-                inc = kern.fingerprint_incremental(succ, ri, parts, st)
-                full = kern.fingerprint(
-                    {k: v for k, v in succ.items()
-                     if not k.startswith("_")})
-                return inc, full, en
-            outs.append(jax.vmap(lane_eval)(lanes))
-        return tuple(jnp.concatenate([o[i] for o in outs])
-                     for i in range(3))
-
-    both_j = jax.jit(both)
     states = explore_states(spec, 80)[::5]
-    for st in states:
-        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
-        inc, full, en = both_j(dense)
-        en = np.asarray(en)
-        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
-
+    assert_incremental_fp_matches(codec, kern, states)
 
 def test_guard_fns_match_action_enabledness():
-    import jax
-    import jax.numpy as jnp
-
     spec, codec, kern = _load({"Values": "{v1}",
                                "StartViewOnTimerLimit": "1",
                                "NoProgressChangeLimit": "1"})
     states = explore_states(spec, 120)[::2]
-    gfns = kern._guard_fns()
-    afns = kern._action_fns()
-
-    @jax.jit
-    def all_en(dense):
-        outs_g, outs_a = [], []
-        for name, g, a in zip(ACTION_NAMES, gfns, afns):
-            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
-            outs_g.append(jax.vmap(lambda ln, g=g: g(dense, ln))(lanes))
-            outs_a.append(jax.vmap(
-                lambda ln, a=a: a(dense, ln)[1])(lanes))
-        return jnp.concatenate(outs_g), jnp.concatenate(outs_a)
-
-    for st in states:
-        dense = {k: jnp.asarray(v) for k, v in codec.encode(st).items()}
-        g, a = all_en(dense)
-        assert (np.asarray(g) == np.asarray(a)).all()
-
+    assert_guards_match_actions(codec, kern, states)
 
 @pytest.mark.slow
 def test_device_bfs_fixpoint_matches_interpreter():

@@ -11,9 +11,10 @@ upsert, CHOOSE tie-break, and frame condition of all 19 actions.
 import numpy as np
 import pytest
 
-from tests.conftest import (REFERENCE, assert_kernel_matches,
-                            explore_states, interp_succs,
-                            kernel_succs, requires_reference)
+from tests.conftest import (REFERENCE, assert_incremental_fp_matches,
+                            assert_kernel_matches, explore_states,
+                            interp_succs, kernel_succs,
+                            requires_reference)
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
@@ -68,10 +69,8 @@ def test_kernel_matches_interpreter_recovery_era():
 def test_incremental_fingerprint_matches_full(values, timer, symmetry):
     # the O(touched) incremental fingerprint must equal the full-state
     # recompute on every enabled lane of sampled reachable states
-    import jax
-    import jax.numpy as jnp
     from tpuvsr.core.values import ModelValue
-    from tpuvsr.engine.device_bfs import _value_perm_table
+    from tpuvsr.models.registry import value_perm_table
 
     mod = parse_module_file(f"{REFERENCE}/VSR.tla")
     cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
@@ -81,33 +80,9 @@ def test_incremental_fingerprint_matches_full(values, timer, symmetry):
         cfg.symmetry = None
     spec = SpecModel(mod, cfg)
     codec = VSRCodec(spec.ev.constants, max_msgs=40)
-    kern = VSRKernel(codec, perms=_value_perm_table(spec, codec))
-
-    def both(st):
-        parts = kern.parent_parts(st)
-        outs = []
-        for name, fn in zip(ACTION_NAMES, kern._action_fns()):
-            lanes = jnp.arange(kern._lane_count(name), dtype=jnp.int32)
-
-            def lane_eval(lane, fn=fn, name=name):
-                succ, en = fn(kern.seed_touch(st), lane)
-                ri = kern.lane_replica(name, st, lane)
-                inc = kern.fingerprint_incremental(succ, ri, parts, st)
-                full = kern.fingerprint(
-                    {k: v for k, v in succ.items()
-                     if not k.startswith("_")})
-                return inc, full, en
-            outs.append(jax.vmap(lane_eval)(lanes))
-        return tuple(jnp.concatenate([o[i] for o in outs])
-                     for i in range(3))
-
-    both_j = jax.jit(both)
+    kern = VSRKernel(codec, perms=value_perm_table(spec, codec))
     states = explore_states(spec, 90)[::6]
-    for st in states:
-        dense = {k: np.asarray(v) for k, v in codec.encode(st).items()}
-        inc, full, en = both_j(dense)
-        en = np.asarray(en)
-        assert (np.asarray(inc)[en] == np.asarray(full)[en]).all()
+    assert_incremental_fp_matches(codec, kern, states)
 
 
 def test_kernel_smoke_init():

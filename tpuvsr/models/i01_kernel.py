@@ -1,0 +1,403 @@
+"""jit+vmap transition kernel for VR_INC_RESEND (I01).
+
+Subclasses the A01 kernel with the increment-mode deltas (I01's
+14-action Next, I01:731-751):
+
+* every view adoption is ``View(r)+1`` — ReceiveHigherSVC (I01:455)
+  and ReceiveHigherDVC (I01:572) increment instead of adopting the
+  carrier's view;
+* ``rep_sent_svc`` + ``NotInPhaseSVC`` (I01:416-419) gate TimerSendSVC,
+  and ``ResendSVC`` (I01:505-517) re-sends an SVC to a specific peer
+  when none is in flight and none was ever received back — one lane
+  per (replica, peer) pair;
+* the DVC tracker (UpdateDVCsTracker, I01:245-250): per-source slots
+  with their own view column (mixed views are expected —
+  ReceivedDVCsAllSameView is the intentionally violatable invariant,
+  I01:797-804); replacement semantics mean slot collisions cannot
+  happen;
+* SendSV adopts ``HighestViewNumber`` of the valid (view >= own)
+  tracker entries (I01:614-620, 649-675) and installs it as both
+  view_number and last_normal_view;
+* ReceivePrepareMsg has no primary exemption (I01:311-323);
+* NoReplicaMoreThanOneViewAheadOfMajority (I01:789-795) and
+  ReceivedDVCsAllSameView invariants.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .a01 import ENTRY_VIEW_BITS, A01Codec  # noqa: F401 (doc reference)
+from .a01_kernel import A01Kernel
+from .i01 import I01Codec
+from .st03 import M_DVC, M_PREPARE, M_PREPAREOK, M_SV, M_SVC, NORMAL, \
+    VIEWCHANGE
+from .st03_kernel import INF, I32
+from .vsr import H_COMMIT, H_DEST, H_LNV, H_OP, H_SRC, H_TYPE, H_VIEW
+
+ACTION_NAMES = (
+    "TimerSendSVC", "ReceiveHigherSVC", "ReceiveMatchingSVC", "ResendSVC",
+    "SendDVC", "ReceiveHigherDVC", "ReceiveMatchingDVC", "SendSV",
+    "ReceiveSV", "ReceiveClientRequest", "ReceivePrepareMsg",
+    "ReceivePrepareOkMsg", "ExecuteOp", "NoProgressChange",
+)
+
+REP_KEYS = ("status", "view", "op", "commit", "lnv", "log", "peer_op",
+            "sent_svc", "sent_dvc", "sent_sv", "dvc", "dvc_view",
+            "dvc_lnv", "dvc_op", "dvc_commit", "dvc_log")
+
+
+class I01Kernel(A01Kernel):
+    action_names = ACTION_NAMES
+    REP_KEYS = REP_KEYS
+    PERM_REP_KEYS = ("log", "dvc_log")
+
+    def __init__(self, codec: I01Codec, perms=None):
+        super().__init__(codec, perms=perms)
+
+    def _rep_shape(self, k):
+        s = self.shape
+        extra = {
+            "sent_svc": (s.R,), "dvc": (s.R, s.R),
+            "dvc_view": (s.R, s.R), "dvc_lnv": (s.R, s.R),
+            "dvc_op": (s.R, s.R), "dvc_commit": (s.R, s.R),
+            "dvc_log": (s.R, s.R, s.MAX_OPS),
+        }
+        if k in extra:
+            return extra[k]
+        return super()._rep_shape(k)
+
+    def _lane_count(self, name):
+        if name == "ResendSVC":
+            return self.R * self.R
+        return super()._lane_count(name)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _reset_sent3(self, s2, i, svc, dvc, sv):
+        s2 = dict(s2)
+        s2["sent_svc"] = s2["sent_svc"].at[i].set(svc)
+        s2["sent_dvc"] = s2["sent_dvc"].at[i].set(dvc)
+        s2["sent_sv"] = s2["sent_sv"].at[i].set(sv)
+        return s2
+
+    def _reset_sent(self, st, i):
+        # ResetSentVars (I01:232-236): all three flags to FALSE
+        return self._reset_sent3(st, i, 0, 0, 0)
+
+    def _update_tracker(self, s2, i, vn, src_j, view, lnv, op, commit,
+                        log, pred):
+        """UpdateDVCsTracker (I01:245-250): drop entries below `vn` and
+        any entry from `src_j`, then write the carrier into its slot
+        (canonical zeros for dropped slots)."""
+        s2 = dict(s2)
+        slots = jnp.arange(self.R, dtype=I32)
+        keep = ((s2["dvc"][i] == 1) & (s2["dvc_view"][i] >= vn)
+                & (slots != src_j))
+        keep = jnp.where(pred, keep, s2["dvc"][i] == 1)
+
+        def zero_non_keep(key):
+            s2[key] = s2[key].at[i].set(
+                jnp.where(keep, s2[key][i], 0))
+        s2["dvc"] = s2["dvc"].at[i].set(keep.astype(I32))
+        for key in ("dvc_view", "dvc_lnv", "dvc_op", "dvc_commit"):
+            zero_non_keep(key)
+        s2["dvc_log"] = s2["dvc_log"].at[i].set(
+            jnp.where(keep[:, None], s2["dvc_log"][i], 0))
+
+        def put(key, val):
+            s2[key] = jnp.where(pred, s2[key].at[i, src_j].set(val),
+                                s2[key])
+        put("dvc", 1)
+        put("dvc_view", view)
+        put("dvc_lnv", lnv)
+        put("dvc_op", op)
+        put("dvc_commit", commit)
+        put("dvc_log", log)
+        return s2
+
+    def _clear_tracker(self, s2, i):
+        s2 = dict(s2)
+        for key in ("dvc", "dvc_view", "dvc_lnv", "dvc_op", "dvc_commit"):
+            s2[key] = s2[key].at[i].set(0)
+        s2["dvc_log"] = s2["dvc_log"].at[i].set(0)
+        return s2
+
+    def _not_in_phase_svc(self, st, i):
+        # NotInPhaseSVC (I01:416-419)
+        return (st["sent_svc"][i] == 0) | (st["sent_dvc"][i] == 1)
+
+    # ------------------------------------------------------------------
+    # view-change actions (increment mode)
+    # ------------------------------------------------------------------
+    def act_timer_send_svc(self, st, lane):       # I01:421-438
+        i = lane
+        r = i + 1
+        en = ((st["aux_svc"] < self.shape.timer_limit)
+              & self._can_progress(st, i)
+              & ~self._is_primary(st, i, r)
+              & self._not_in_phase_svc(st, i))
+        new_view = st["view"][i] + 1
+        s2 = dict(st)
+        s2["view"] = st["view"].at[i].set(new_view)
+        s2["status"] = st["status"].at[i].set(VIEWCHANGE)
+        s2 = self._reset_sent3(s2, i, 1, 0, 0)
+        s2["aux_svc"] = st["aux_svc"] + 1
+        s2 = self._broadcast(s2, self._row(M_SVC, view=new_view, src=r), r)
+        return s2, en
+
+    def guard_timer_send_svc(self, st, lane):
+        i = lane
+        return ((st["aux_svc"] < self.shape.timer_limit)
+                & self._can_progress(st, i)
+                & ~self._is_primary(st, i, i + 1)
+                & self._not_in_phase_svc(st, i))
+
+    def act_receive_higher_svc(self, st, lane):   # I01:440-463
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_SVC) & self._can_progress(st, i)
+              & (hdr[H_VIEW] > st["view"][i]))
+        new_view = st["view"][i] + 1           # increment, not adopt
+        s2 = dict(st)
+        s2["view"] = st["view"].at[i].set(new_view)
+        s2["status"] = st["status"].at[i].set(VIEWCHANGE)
+        s2 = self._reset_sent3(s2, i, 1, 0, 0)
+        s2 = self._bag_discard(s2, k)
+        s2 = self._broadcast(s2, self._row(M_SVC, view=new_view, src=r), r)
+        return s2, en
+
+    def guard_resend_svc(self, st, lane):         # RequiresResend,
+        i = lane // self.R                        # I01:490-503
+        p = lane % self.R
+        r = i + 1
+        peer = p + 1
+        h = st["m_hdr"]
+        svc = (st["m_present"] == 1) & (h[:, H_TYPE] == M_SVC)
+        undelivered = (svc & (h[:, H_DEST] == peer) & (h[:, H_SRC] == r)
+                       & (h[:, H_VIEW] == st["view"][i])
+                       & (st["m_count"] == 1)).any()
+        ever_back = (svc & (h[:, H_DEST] == r) & (h[:, H_SRC] == peer)
+                     & (h[:, H_VIEW] == st["view"][i])).any()
+        return (self._can_progress(st, i) & (r != peer)
+                & (st["sent_svc"][i] == 1)
+                & ~undelivered & ~ever_back)
+
+    def act_resend_svc(self, st, lane):           # I01:505-517
+        i = lane // self.R
+        p = lane % self.R
+        en = self.guard_resend_svc(st, lane)
+        s2 = self._bag_send(
+            dict(st), self._row(M_SVC, view=st["view"][i], dest=p + 1,
+                                src=i + 1))
+        return s2, en
+
+    def act_send_dvc(self, st, lane):             # I01:528-556
+        i = lane
+        r = i + 1
+        view = st["view"][i]
+        prim = self._primary(view, self.R)
+        en = (self._can_progress(st, i)
+              & (st["status"][i] == VIEWCHANGE) & (st["sent_dvc"][i] == 0)
+              & (self._svc_tombstones(st, i) >= self.R // 2))
+        s2 = dict(st)
+        s2["sent_dvc"] = st["sent_dvc"].at[i].set(1)
+        row = self._row(M_DVC, view=view, op=st["op"][i],
+                        commit=st["commit"][i], dest=prim, src=r,
+                        lnv=st["lnv"][i], log=st["log"][i])
+        self_case = prim == r
+        s2 = self._bag_send(s2, row, new_count=jnp.where(self_case, 0, 1))
+        s2 = self._update_tracker(s2, i, view, i, view, st["lnv"][i],
+                                  st["op"][i], st["commit"][i],
+                                  st["log"][i], pred=self_case & en)
+        return s2, en
+
+    def act_receive_higher_dvc(self, st, lane):   # I01:558-581
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        j = jnp.clip(hdr[H_SRC] - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_DVC) & self._can_progress(st, i)
+              & (hdr[H_VIEW] > st["view"][i]))
+        new_view = st["view"][i] + 1           # increment, not adopt
+        s2 = dict(st)
+        s2["view"] = st["view"].at[i].set(new_view)
+        s2["status"] = st["status"].at[i].set(VIEWCHANGE)
+        s2 = self._reset_sent3(s2, i, 1, 0, 0)
+        s2 = self._update_tracker(s2, i, new_view, j, hdr[H_VIEW],
+                                  hdr[H_LNV], hdr[H_OP], hdr[H_COMMIT],
+                                  st["m_log"][k], pred=en)
+        s2 = self._bag_discard(s2, k)
+        s2 = self._broadcast(s2, self._row(M_SVC, view=new_view, src=r), r)
+        return s2, en
+
+    def act_receive_matching_dvc(self, st, lane):  # I01:583-597
+        k = lane
+        hdr = st["m_hdr"][k]
+        i = jnp.clip(hdr[H_DEST] - 1, 0, self.R - 1)
+        j = jnp.clip(hdr[H_SRC] - 1, 0, self.R - 1)
+        # no status conjunct (I01:588-591): even a Normal replica
+        # registers a matching DVC
+        en = (self._recv_guard(st, k, M_DVC) & self._can_progress(st, i)
+              & (hdr[H_VIEW] == st["view"][i]))
+        s2 = self._update_tracker(dict(st), i, st["view"][i], j,
+                                  hdr[H_VIEW], hdr[H_LNV], hdr[H_OP],
+                                  hdr[H_COMMIT], st["m_log"][k], pred=en)
+        s2 = self._bag_discard(s2, k)
+        return s2, en
+
+    def guard_receive_matching_dvc(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_DVC) & self._can_progress(st, i)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i]))
+
+    def _highest_tracker(self, st, i):
+        """HighestViewNumber/-Log/-CommitNumber over the valid
+        (view >= own) tracker entries (I01:610-645); CHOOSE ties by min
+        value_key — per-source slots make `source` a unique tie-break
+        after (commit, log)."""
+        valid = (st["dvc"][i] == 1) & (st["dvc_view"][i] >= st["view"][i])
+        new_vn = jnp.max(jnp.where(valid, st["dvc_view"][i], -1))
+        pair = st["dvc_lnv"][i] * I32(self.MAX_OPS + 1) + st["dvc_op"][i]
+        best_pair = jnp.max(jnp.where(valid, pair, -1))
+        maximal = valid & (pair == best_pair)
+        src_ids = jnp.arange(1, self.R + 1, dtype=I32)
+        keys = jnp.concatenate(
+            [st["dvc_commit"][i][:, None], st["dvc_log"][i],
+             src_ids[:, None]], axis=1)
+        cand = maximal
+        for c in range(keys.shape[1]):
+            col = jnp.where(cand, keys[:, c], INF)
+            cand = cand & (col == col.min())
+        best_j = jnp.argmax(cand)
+        return (new_vn, st["dvc_log"][i, best_j], st["dvc_op"][i, best_j],
+                jnp.max(jnp.where(valid, st["dvc_commit"][i], -1)))
+
+    def act_send_sv(self, st, lane):              # I01:647-675
+        i = lane
+        r = i + 1
+        valid = (st["dvc"][i] == 1) & (st["dvc_view"][i] >= st["view"][i])
+        en = (self._can_progress(st, i)
+              & (st["status"][i] == VIEWCHANGE) & (st["sent_sv"][i] == 0)
+              & (valid.sum() >= self.R // 2 + 1))
+        new_vn, new_log, new_on, new_cn = self._highest_tracker(st, i)
+        s2 = dict(st)
+        s2["status"] = st["status"].at[i].set(NORMAL)
+        s2["view"] = st["view"].at[i].set(new_vn)
+        s2["log"] = st["log"].at[i].set(new_log)
+        s2["op"] = st["op"].at[i].set(new_on)
+        s2["peer_op"] = st["peer_op"].at[i].set(0)
+        s2["commit"] = st["commit"].at[i].set(new_cn)
+        s2["sent_sv"] = st["sent_sv"].at[i].set(1)
+        s2["lnv"] = st["lnv"].at[i].set(new_vn)
+        s2 = self._clear_tracker(s2, i)
+        row = self._row(M_SV, view=new_vn, op=new_on, commit=new_cn,
+                        src=r, log=new_log)
+        s2 = self._broadcast(s2, row, r)
+        return s2, en
+
+    def guard_send_sv(self, st, lane):
+        i = lane
+        valid = (st["dvc"][i] == 1) & (st["dvc_view"][i] >= st["view"][i])
+        return (self._can_progress(st, i)
+                & (st["status"][i] == VIEWCHANGE)
+                & (st["sent_sv"][i] == 0)
+                & (valid.sum() >= self.R // 2 + 1))
+
+    def act_receive_sv(self, st, lane):           # I01:686-710
+        s2, en = super().act_receive_sv(st, lane)
+        i = jnp.clip(st["m_hdr"][lane, H_DEST] - 1, 0, self.R - 1)
+        return self._clear_tracker(s2, i), en
+
+    def act_receive_prepare(self, st, lane):      # I01:311-334
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        # no primary exemption in I01 (the primary never receives its
+        # own broadcast, so the conjunct is dropped from the spec)
+        en = (self._recv_guard(st, k, M_PREPARE)
+              & self._can_progress(st, i)
+              & (st["status"][i] == NORMAL)
+              & (hdr[H_VIEW] == st["view"][i])
+              & (hdr[H_OP] == st["op"][i] + 1))
+        s2 = dict(st)
+        s2["log"] = st["log"].at[
+            i, jnp.clip(hdr[H_OP] - 1, 0, self.MAX_OPS - 1)] \
+            .set(st["m_entry"][k])
+        s2["op"] = st["op"].at[i].set(hdr[H_OP])
+        s2["commit"] = st["commit"].at[i].set(hdr[H_COMMIT])
+        s2 = self._bag_discard(s2, k)
+        ok_row = self._row(M_PREPAREOK, view=st["view"][i], op=hdr[H_OP],
+                           dest=hdr[H_SRC], src=r)
+        s2 = self._bag_send(s2, ok_row)
+        return s2, en
+
+    def guard_receive_prepare(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_PREPARE)
+                & self._can_progress(st, i)
+                & (st["status"][i] == NORMAL)
+                & (st["m_hdr"][k, H_VIEW] == st["view"][i])
+                & (st["m_hdr"][k, H_OP] == st["op"][i] + 1))
+
+    # ------------------------------------------------------------------
+    # action table
+    # ------------------------------------------------------------------
+    def _guard_fns(self):
+        return [
+            self.guard_timer_send_svc, self.guard_receive_higher_svc,
+            self.guard_receive_matching_svc, self.guard_resend_svc,
+            self.guard_send_dvc, self.guard_receive_higher_dvc,
+            self.guard_receive_matching_dvc, self.guard_send_sv,
+            self.guard_receive_sv, self.guard_receive_client_request,
+            self.guard_receive_prepare, self.guard_receive_prepare_ok,
+            self.guard_execute_op, self.guard_no_progress_change,
+        ]
+
+    def _action_fns(self):
+        return [
+            self.act_timer_send_svc, self.act_receive_higher_svc,
+            self.act_receive_matching_svc, self.act_resend_svc,
+            self.act_send_dvc, self.act_receive_higher_dvc,
+            self.act_receive_matching_dvc, self.act_send_sv,
+            self.act_receive_sv, self.act_receive_client_request,
+            self.act_receive_prepare, self.act_receive_prepare_ok,
+            self.act_execute_op, self.act_no_progress_change,
+        ]
+
+    def lane_replica(self, name, st, lane):
+        if name == "ResendSVC":
+            return lane // self.R     # the sender (no rep state changes,
+                                      # but a slot row does)
+        return super().lane_replica(name, st, lane)
+
+    # ------------------------------------------------------------------
+    # invariants
+    # ------------------------------------------------------------------
+    def inv_no_replica_more_than_one_view_ahead(self, st):
+        # I01:789-795: no replica r with a MAJORITY of others more than
+        # one view behind it
+        behind = (st["view"][None, :] < st["view"][:, None] - 1)  # [r, r1]
+        r_ids = jnp.arange(self.R)
+        behind = behind & (r_ids[None, :] != r_ids[:, None])
+        return ~(behind.sum(axis=1) > self.R // 2).any()
+
+    def inv_received_dvcs_all_same_view(self, st):
+        # I01:797-804 (intentionally violatable)
+        pres = st["dvc"] == 1                              # [R, R]
+        views = st["dvc_view"]
+        both = pres[:, :, None] & pres[:, None, :]
+        diff = views[:, :, None] != views[:, None, :]
+        mixed = (both & diff).any(axis=(1, 2))
+        return ~((st["status"] == VIEWCHANGE) & mixed).any()
+
+    INVARIANT_FNS = dict(
+        A01Kernel.INVARIANT_FNS,
+        NoReplicaMoreThanOneViewAheadOfMajority=
+        "inv_no_replica_more_than_one_view_ahead",
+        ReceivedDVCsAllSameView="inv_received_dvcs_all_same_view")

@@ -52,7 +52,7 @@ def has_device_model(spec) -> bool:
         codec_cls, _ = _resolve(spec.module.name)
         codec_cls(spec.ev.constants)
         return True
-    except (KeyError, TLAError):
+    except (KeyError, TLAError, ImportError):
         return False
 
 
@@ -80,4 +80,8 @@ def _resolve(name):
         from .a01 import A01Codec
         from .a01_kernel import A01Kernel
         return A01Codec, A01Kernel
+    if name == "VR_INC_RESEND":
+        from .i01 import I01Codec
+        from .i01_kernel import I01Kernel
+        return I01Codec, I01Kernel
     raise KeyError(name)
