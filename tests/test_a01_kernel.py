@@ -110,3 +110,11 @@ def test_registry_resolves_a01():
     assert registry.has_device_model(spec)
     codec, kern = registry.make_model(spec)
     assert kern.action_names == ACTION_NAMES
+
+
+def test_guard_fns_match_action_enabledness():
+    spec, codec, kern = _load({"Values": "{v1}",
+                               "StartViewOnTimerLimit": "1",
+                               "NoProgressChangeLimit": "1"})
+    states = explore_states(spec, 120)[::2]
+    assert_guards_match_actions(codec, kern, states)

@@ -105,6 +105,53 @@ def test_recovery_spec_checks_with_synthesized_cfg(stem, cfg_text, n_actions):
     assert res.distinct_states >= 400
 
 
+# ---------------------------------------------------------------------
+# Pinned fixpoints: exact distinct-state counts at R=3, Values={v1},
+# StartViewOnTimerLimit=1 (symmetry off), measured by the interpreter
+# engine (collision-free dedup on exact canonical views) — the standing
+# oracle the device engines are differentially held to
+# (scripts/pin_fixpoints.py writes scripts/fixpoints.json; TLC is not
+# available in this environment).  SURVEY.md §4.7.
+# ---------------------------------------------------------------------
+
+FIXPOINTS = {
+    # stem: (distinct, generated, diameter)
+    "VSR": (43941, 118746, 24),
+    "01-view-changes/VR_ASSUME_NEWVIEWCHANGE": (42753, 106794, 24),
+    "01-view-changes/VR_INC_RESEND": (52635, 135162, 24),
+    "03-state-transfer/VR_STATE_TRANSFER": (42753, 106794, 24),
+    "04-application-state/VR_APP_STATE": (42738, 85336, 24),
+}
+
+
+def _small_fixpoint_spec(stem):
+    from tpuvsr.frontend.cfg import _parse_value
+    if stem == "VSR":
+        mod = parse_module_file(f"{REFERENCE}/VSR.tla")
+        cfg = parse_cfg_file(f"{REFERENCE}/VSR.cfg")
+        cfg.constants["RestartEmptyLimit"] = 0
+    else:
+        mod = parse_module_file(f"{ANALYSIS}/{stem}.tla")
+        cfg = parse_cfg_file(f"{ANALYSIS}/{stem}.cfg")
+    cfg.constants["Values"] = _parse_value("{v1}")
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    cfg.symmetry = None
+    return SpecModel(mod, cfg)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stem", sorted(FIXPOINTS))
+def test_pinned_fixpoint(stem):
+    spec = _small_fixpoint_spec(stem)
+    res = bfs_check(spec)
+    assert res.ok, (res.violated_invariant, res.error)
+    assert res.error is None, "did not reach fixpoint"
+    want_distinct, want_generated, want_diam = FIXPOINTS[stem]
+    assert res.distinct_states == want_distinct
+    assert res.states_generated == want_generated
+    assert res.diameter == want_diam
+
+
 def test_liveness_cfg_decomposition():
     # A01's shipped cfg uses SPECIFICATION LivenessSpec with WF per
     # action (A01:793-809): the spec model must recover Init/Next and

@@ -1,0 +1,166 @@
+"""Differential tests for the RR05 device kernel (VR_REPLICA_RECOVERY)
+vs the interpreter oracle — pinning the crash-recovery sub-protocol:
+UniqueNumber nonces, the primary-only recovery responses with Nil
+sentinels, highest-view CompleteRecovery, RetryRecovery's no-more-
+responses bag predicate, and the not-Recovering guards on the carried-
+over view-change actions.  RR05 ships no cfg; constants are
+synthesized (test_corpus does the same).
+"""
+
+import pytest
+
+from tests.conftest import (REFERENCE, assert_guards_match_actions,
+                            assert_incremental_fp_matches,
+                            assert_kernel_matches, explore_states,
+                            interp_succs, kernel_succs,
+                            requires_reference)
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.models.registry import value_perm_table
+from tpuvsr.models.rr05 import RR05Codec
+from tpuvsr.models.rr05_kernel import ACTION_NAMES, RR05Kernel
+
+pytestmark = requires_reference
+
+RR05_TLA = (f"{REFERENCE}/analysis/05-replica-recovery/"
+            f"VR_REPLICA_RECOVERY.tla")
+
+CFG = """CONSTANTS
+    ReplicaCount = 3
+    Values = {values}
+    StartViewOnTimerLimit = {timer}
+    NoProgressChangeLimit = {np_limit}
+    CrashLimit = {crash}
+    Normal = Normal
+    ViewChange = ViewChange
+    StateTransfer = StateTransfer
+    Recovering = Recovering
+    PrepareMsg = PrepareMsg
+    PrepareOkMsg = PrepareOkMsg
+    StartViewChangeMsg = StartViewChangeMsg
+    DoViewChangeMsg = DoViewChangeMsg
+    StartViewMsg = StartViewMsg
+    GetStateMsg = GetStateMsg
+    NewStateMsg = NewStateMsg
+    RecoveryMsg = RecoveryMsg
+    RecoveryResponseMsg = RecoveryResponseMsg
+    Nil = Nil
+    AnyDest = AnyDest
+INIT Init
+NEXT Next
+VIEW view
+INVARIANT
+NoLogDivergence
+NoAppStateDivergence
+AcknowledgedWriteNotLost
+CommitNumberNeverHigherThanOpNumber
+"""
+
+
+def _load(values="{v1}", timer=1, crash=1, np_limit=0, max_msgs=48,
+          symmetry=False):
+    mod = parse_module_file(RR05_TLA)
+    cfg = parse_cfg_text(CFG.format(values=values, timer=timer,
+                                    crash=crash, np_limit=np_limit))
+    if symmetry:
+        cfg.symmetry = "symmValues"
+    spec = SpecModel(mod, cfg)
+    codec = RR05Codec(spec.ev.constants, max_msgs=max_msgs)
+    kern = RR05Kernel(codec, perms=value_perm_table(spec, codec))
+    return spec, codec, kern
+
+
+def test_kernel_smoke_init():
+    spec, codec, kern = _load()
+    st = next(iter(spec.init_states()))
+    want = interp_succs(spec, st)
+    got = kernel_succs(kern, codec, st)
+    assert set(want) == set(got)
+    for name in want:
+        assert want[name] == got[name]
+
+
+def test_kernel_matches_interpreter_small():
+    spec, codec, kern = _load()
+    states = explore_states(spec, 120)
+    assert_kernel_matches(spec, codec, kern, states[::3])
+
+
+@pytest.mark.slow
+def test_kernel_matches_interpreter_recovery_era():
+    # states with a Recovering replica or recovery traffic in flight —
+    # the sub-protocol RR05 adds (incl. CompleteRecovery/RetryRecovery
+    # enabling regions)
+    spec, codec, kern = _load(timer=1, crash=1)
+    rec_mv = spec.ev.constants["Recovering"]
+    states = explore_states(spec, 2500)
+    era = [s for s in states
+           if any(s["rep_status"].apply(r) is rec_mv
+                  for r in sorted(s["replicas"]))]
+    assert era, "exploration never crashed a replica"
+    deep = [s for s in era
+            if any(len(s["rep_rec_recv"].apply(r)) > 0
+                   for r in sorted(s["replicas"]))]
+    assert deep, "exploration never received a recovery response"
+    assert_kernel_matches(spec, codec, kern, era[::8] + deep[::4])
+
+
+def test_incremental_fingerprint_matches_full():
+    spec, codec, kern = _load(values="{v1, v2}", max_msgs=40,
+                              symmetry=True)
+    states = explore_states(spec, 70)[::5]
+    assert_incremental_fp_matches(codec, kern, states)
+
+
+def test_guard_fns_match_action_enabledness():
+    spec, codec, kern = _load(np_limit=1)
+    states = explore_states(spec, 120)[::2]
+    assert_guards_match_actions(codec, kern, states)
+
+
+@pytest.mark.slow
+def test_device_bfs_levels_match_interpreter():
+    """The RR05 crash-era state space is too large for a fixpoint
+    oracle run (>300k distinct at CrashLimit=1); compare exact
+    per-level frontier sizes to a fixed depth instead — any kernel
+    divergence shifts a level count."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+
+    spec, _codec, _kern = _load()
+    depth = 5
+    seen = set()
+    frontier = []
+    for st in spec.init_states():
+        k = spec.view_value(st)
+        if k not in seen:
+            seen.add(k)
+            frontier.append(st)
+    sizes = [len(frontier)]
+    for _ in range(depth):
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                k = spec.view_value(succ)
+                if k not in seen:
+                    seen.add(k)
+                    nxt.append(succ)
+        frontier = nxt
+        sizes.append(len(frontier))
+
+    eng = DeviceBFS(spec, tile_size=64)
+    got = eng.run(max_depth=depth)
+    assert got.ok
+    assert eng.level_sizes == sizes
+    assert got.distinct_states == sum(sizes)
+
+
+def test_registry_resolves_rr05():
+    from tpuvsr.models import registry
+    mod = parse_module_file(RR05_TLA)
+    cfg = parse_cfg_text(CFG.format(values="{v1}", timer=1, crash=1,
+                                    np_limit=0))
+    spec = SpecModel(mod, cfg)
+    assert registry.has_device_model(spec)
+    codec, kern = registry.make_model(spec)
+    assert kern.action_names == ACTION_NAMES

@@ -55,9 +55,7 @@ class AS04Codec(ST03Codec):
                 raise TLAError("AS04 layout invariant violated: "
                                "Len(rep_app_state) != rep_commit_number")
             d["app"][i] = self._enc_log(app)
-            if st["rep_rec_number"].apply(r) != 0 or \
-                    len(st["rep_rec_recv"].apply(r)) != 0:
-                raise TLAError("AS04 recovery vars must stay at Init")
+            self._encode_rec(st, d, r)
             for m in st["rep_recv_dvc"].apply(r):
                 if m.apply("view_number") != int(d["view"][i]) or \
                         m.apply("dest") != r:
@@ -71,9 +69,20 @@ class AS04Codec(ST03Codec):
                 d["dvc_op"][i][j] = m.apply("op_number")
                 d["dvc_commit"][i][j] = m.apply("commit_number")
                 d["dvc_log"][i][j] = self._enc_log(m.apply("log"))
+        self._encode_aux_restart(st, d)
+        return d
+
+    def _encode_rec(self, st, d, r):
+        """AS04 declares the recovery vars but has no recovery actions
+        (AS04:811-831) — they must stay at Init; RR05 overrides with a
+        real encoding."""
+        if st["rep_rec_number"].apply(r) != 0 or \
+                len(st["rep_rec_recv"].apply(r)) != 0:
+            raise TLAError("AS04 recovery vars must stay at Init")
+
+    def _encode_aux_restart(self, st, d):
         if st["aux_restart"] != 0:
             raise TLAError("AS04 aux_restart must stay 0")
-        return d
 
     def decode(self, d: dict):
         st = super().decode(d)

@@ -52,7 +52,15 @@ def has_device_model(spec) -> bool:
         codec_cls, _ = _resolve(spec.module.name)
         codec_cls(spec.ev.constants)
         return True
-    except (KeyError, TLAError, ImportError):
+    except (KeyError, TLAError):
+        return False
+    except ImportError as e:
+        # a registered module whose implementation cannot import is a
+        # packaging bug — degrade to the interpreter but say so loudly
+        import sys
+        print(f"[tpuvsr] WARNING: device model for {spec.module.name} "
+              f"failed to import ({e}); falling back to the interpreter",
+              file=sys.stderr)
         return False
 
 
@@ -84,4 +92,8 @@ def _resolve(name):
         from .i01 import I01Codec
         from .i01_kernel import I01Kernel
         return I01Codec, I01Kernel
+    if name == "VR_REPLICA_RECOVERY":
+        from .rr05 import RR05Codec
+        from .rr05_kernel import RR05Kernel
+        return RR05Codec, RR05Kernel
     raise KeyError(name)

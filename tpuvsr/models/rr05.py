@@ -1,0 +1,177 @@
+"""Dense TPU state layout for VR_REPLICA_RECOVERY (reference: RR05,
+analysis/05-replica-recovery/VR_REPLICA_RECOVERY.tla).
+
+RR05 = AS04 (app state, recv_dvc-set quorums, state transfer) + the
+crash-recovery sub-protocol (RR05:820-983): ``Crash`` wipes a replica
+to the ``Recovering`` status (a FOURTH status code) broadcasting a
+``RecoveryMsg`` with a fresh nonce from ``UniqueNumber`` (max x in the
+bag + 1, RR05:826-835); only a Normal replica responds, attaching its
+log/op/commit exactly when it is the primary (Nil otherwise,
+RR05:871-889); ``CompleteRecovery`` installs the highest-view primary
+response (RR05:920-942); ``RetryRecovery`` re-nonces when no such
+response can ever arrive (RR05:951-983).
+
+Layout additions over AS04: live ``rep_rec_number``/``rep_rec_recv``
+(VSR-style [dest, source] response slots with implied x =
+rep_rec_number[dest] and dest = r), a real ``aux_restart`` counter
+(outside the VIEW projection like all aux vars, RR05:103), and two
+more message kinds carrying the H_X header column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.values import FnVal, TLAError
+from .as04 import AS04Codec
+from .st03 import MSGTYPE_NAMES as ST03_MSGTYPE_NAMES
+from .vsr import H_COMMIT, H_DEST, H_OP, H_SRC, H_TYPE, H_VIEW, H_X
+
+RECOVERING = 3
+
+M_RECOVERY, M_RECOVERYRESP = 8, 9
+MSGTYPE_NAMES = dict(ST03_MSGTYPE_NAMES)
+MSGTYPE_NAMES[M_RECOVERY] = "RecoveryMsg"
+MSGTYPE_NAMES[M_RECOVERYRESP] = "RecoveryResponseMsg"
+
+
+ENTRY_VIEW_BITS = 8
+
+
+class RR05Codec(AS04Codec):
+    def __init__(self, constants, shape=None, max_msgs=None):
+        super().__init__(constants, shape=shape, max_msgs=max_msgs)
+        if self.shape.MAX_VIEW >= 1 << ENTRY_VIEW_BITS:
+            raise TLAError("RR05 packed entries need MAX_VIEW < 256")
+        self.status_id[constants["Recovering"]] = RECOVERING
+        self.status_mv[RECOVERING] = constants["Recovering"]
+        for code in (M_RECOVERY, M_RECOVERYRESP):
+            mv = constants[MSGTYPE_NAMES[code]]
+            self.mtype_id[mv] = code
+            self.mtype_mv[code] = mv
+
+    # RR05 log entries are [operation, view_number] records
+    # (RR05:306-309) — packed like A01's, without the client_id
+    def _enc_entry(self, e: FnVal) -> int:
+        return (self.value_id[e.apply("operation")] << ENTRY_VIEW_BITS) \
+            | e.apply("view_number")
+
+    def _dec_entry(self, code):
+        from ..core.values import mk_record
+        code = int(code)
+        return mk_record(
+            view_number=code & ((1 << ENTRY_VIEW_BITS) - 1),
+            operation=self.values[(code >> ENTRY_VIEW_BITS) - 1])
+
+    def zero_state(self):
+        d = super().zero_state()
+        s = self.shape
+        z = lambda *sh: np.zeros(sh, np.int32)
+        d["rec_number"] = z(s.R)
+        d["rec"] = z(s.R, s.R)
+        d["rec_view"] = z(s.R, s.R)
+        d["rec_has_log"] = z(s.R, s.R)
+        d["rec_log"] = z(s.R, s.R, s.MAX_OPS)
+        d["rec_op"] = z(s.R, s.R)
+        d["rec_commit"] = z(s.R, s.R)
+        d["aux_restart"] = z()
+        return d
+
+    # -- live recovery vars (overrides AS04's frozen checks) ------------
+    def _encode_rec(self, st, d, r):
+        i = r - 1
+        d["rec_number"][i] = st["rep_rec_number"].apply(r)
+        for m in st["rep_rec_recv"].apply(r):
+            if m.apply("x") != d["rec_number"][i] or m.apply("dest") != r:
+                raise TLAError("rec_recv implied-field invariant violated")
+            j = m.apply("source") - 1
+            if d["rec"][i][j]:
+                raise TLAError("recovery-response slot collision")
+            d["rec"][i][j] = 1
+            d["rec_view"][i][j] = m.apply("view_number")
+            lg = m.apply("log")
+            if isinstance(lg, FnVal):
+                d["rec_has_log"][i][j] = 1
+                d["rec_log"][i][j] = self._enc_log(lg)
+                d["rec_op"][i][j] = m.apply("op_number")
+                d["rec_commit"][i][j] = m.apply("commit_number")
+            else:                       # log|op|commit are Nil
+                d["rec_op"][i][j] = -1
+                d["rec_commit"][i][j] = -1
+
+    def _encode_aux_restart(self, st, d):
+        d["aux_restart"][()] = st["aux_restart"]
+
+    # -- messages -------------------------------------------------------
+    def encode_msg_row(self, m: FnVal):
+        t = self.mtype_id[m.apply("type")]
+        if t not in (M_RECOVERY, M_RECOVERYRESP):
+            return super().encode_msg_row(m)
+        from .vsr import NHDR
+        hdr = np.zeros(NHDR, np.int32)
+        log = np.zeros(self.shape.MAX_OPS, np.int32)
+        get = m.get
+        hdr[H_TYPE] = t
+        hdr[H_DEST] = self._enc_dest(get("dest"))
+        hdr[H_SRC] = get("source")
+        hdr[H_X] = get("x")
+        if t == M_RECOVERYRESP:
+            hdr[H_VIEW] = get("view_number")
+            lg = get("log")
+            if isinstance(lg, FnVal):
+                log = self._enc_log(lg)
+                hdr[H_OP] = get("op_number")
+                hdr[H_COMMIT] = get("commit_number")
+            else:
+                hdr[H_OP] = -1          # log|op|commit are Nil
+                hdr[H_COMMIT] = -1
+        return hdr, 0, log
+
+    def decode_msg_row(self, hdr, entry, log):
+        t = int(hdr[H_TYPE])
+        if t not in (M_RECOVERY, M_RECOVERYRESP):
+            return super().decode_msg_row(hdr, entry, log)
+        mv = self.mtype_mv[t]
+        f = {"type": mv, "dest": self._dec_dest(hdr[H_DEST]),
+             "source": int(hdr[H_SRC]), "x": int(hdr[H_X])}
+        if t == M_RECOVERYRESP:
+            f["view_number"] = int(hdr[H_VIEW])
+            if int(hdr[H_OP]) < 0:
+                f.update(log=self.nil, op_number=self.nil,
+                         commit_number=self.nil)
+            else:
+                f.update(log=self._dec_log(log, hdr[H_OP]),
+                         op_number=int(hdr[H_OP]),
+                         commit_number=int(hdr[H_COMMIT]))
+        return FnVal(f.items())
+
+    def decode(self, d: dict):
+        st = super().decode(d)
+        d = {k: np.asarray(v) for k, v in d.items()}
+        s = self.shape
+        reps = range(1, s.R + 1)
+        st["rep_rec_number"] = FnVal((r, int(d["rec_number"][r - 1]))
+                                     for r in reps)
+        resp_mv = self.constants["RecoveryResponseMsg"]
+
+        def rec_msg(r, j):
+            f = {"type": resp_mv,
+                 "view_number": int(d["rec_view"][r - 1][j]),
+                 "x": int(d["rec_number"][r - 1]),
+                 "dest": r, "source": j + 1}
+            if d["rec_has_log"][r - 1][j]:
+                f.update(log=self._dec_log(d["rec_log"][r - 1][j],
+                                           d["rec_op"][r - 1][j]),
+                         op_number=int(d["rec_op"][r - 1][j]),
+                         commit_number=int(d["rec_commit"][r - 1][j]))
+            else:
+                f.update(log=self.nil, op_number=self.nil,
+                         commit_number=self.nil)
+            return FnVal(f.items())
+
+        st["rep_rec_recv"] = FnVal(
+            (r, frozenset(rec_msg(r, j)
+                          for j in range(s.R) if d["rec"][r - 1][j]))
+            for r in reps)
+        st["aux_restart"] = int(d["aux_restart"])
+        return st

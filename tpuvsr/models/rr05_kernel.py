@@ -1,0 +1,335 @@
+"""jit+vmap transition kernel for VR_REPLICA_RECOVERY (RR05).
+
+Subclasses the AS04 kernel with the crash-recovery sub-protocol
+(RR05's 21-action Next, RR05:999-1025):
+
+* ``Crash`` (RR05:837-861): total wipe to ``Recovering`` (view 0,
+  empty log/app, cleared trackers), nonce = ``UniqueNumber`` = max
+  RecoveryMsg x in the bag + 1 (RR05:826-835, a deterministic CHOOSE),
+  RecoveryMsg broadcast;
+* ``ReceiveRecoveryMsg`` (RR05:871-889): only Normal replicas respond;
+  the response carries log/op/commit exactly when the responder is the
+  primary (Nil sentinel -1 otherwise);
+* ``ReceiveRecoveryResponseMsg`` (RR05:896-909): VSR-style response
+  slots with implied x = rep_rec_number[dest];
+* ``CompleteRecovery`` (RR05:920-942): install the has-log response in
+  the highest view of ALL received responses (unique: one primary per
+  view), execute its committed prefix into the app state;
+* ``RetryRecovery`` (RR05:951-983): when no such response exists and
+  none can arrive, clear and re-nonce;
+* the four carried-over actions that must exclude Recovering replicas
+  (TimerSendSVC RR05:582, ReceiveHigherSVC RR05:606, ReceiveHigherDVC
+  RR05:688, ReceiveSV RR05:798).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .a01_kernel import A01Kernel
+from .as04_kernel import AS04Kernel
+from .rr05 import (ENTRY_VIEW_BITS, M_RECOVERY, M_RECOVERYRESP,
+                   RECOVERING, RR05Codec)
+from .st03 import NORMAL
+from .st03_kernel import I32
+from .vsr import (ERR_REC_OVERFLOW, H_COMMIT, H_DEST, H_OP, H_SRC,
+                  H_TYPE, H_VIEW, H_X)
+
+ACTION_NAMES = (
+    "TimerSendSVC", "ReceiveHigherSVC", "ReceiveMatchingSVC", "SendDVC",
+    "ReceiveHigherDVC", "ReceiveMatchingDVC", "SendSV", "ReceiveSV",
+    "ReceiveClientRequest", "ReceivePrepareMsg", "ReceivePrepareOkMsg",
+    "PrimaryExecuteOp", "SendGetState", "ReceiveGetState",
+    "ReceiveNewState", "Crash", "ReceiveRecoveryMsg",
+    "ReceiveRecoveryResponseMsg", "CompleteRecovery", "RetryRecovery",
+    "NoProgressChange",
+)
+
+REP_KEYS = AS04Kernel.REP_KEYS + (
+    "rec_number", "rec", "rec_view", "rec_has_log", "rec_log", "rec_op",
+    "rec_commit")
+
+
+class RR05Kernel(AS04Kernel):
+    action_names = ACTION_NAMES
+    REP_KEYS = REP_KEYS
+    PERM_REP_KEYS = ("log", "app", "dvc_log", "rec_log")
+
+    def __init__(self, codec: RR05Codec, perms=None):
+        self.crash_limit = codec.constants.get("CrashLimit", 0)
+        super().__init__(codec, perms=perms)
+
+    def _rep_shape(self, k):
+        s = self.shape
+        extra = {
+            "rec_number": (s.R,), "rec": (s.R, s.R),
+            "rec_view": (s.R, s.R), "rec_has_log": (s.R, s.R),
+            "rec_log": (s.R, s.R, s.MAX_OPS), "rec_op": (s.R, s.R),
+            "rec_commit": (s.R, s.R),
+        }
+        if k in extra:
+            return extra[k]
+        return super()._rep_shape(k)
+
+    def _lane_count(self, name):
+        if name in ("Crash", "CompleteRecovery", "RetryRecovery"):
+            return self.R
+        return super()._lane_count(name)
+
+    # RR05 log entries are packed (vid << 8 | view) like A01's —
+    # borrow A01's packed-entry machinery (permutation remap, has-op
+    # scan, entry-creating/reading actions)
+    _perm_vals = A01Kernel._perm_vals
+    _is_primary = A01Kernel._is_primary
+    _replica_has_op = A01Kernel._replica_has_op
+    act_receive_client_request = A01Kernel.act_receive_client_request
+
+    def act_execute_op(self, st, lane):           # PrimaryExecuteOp,
+        i = lane                                  # RR05:426-443
+        r = i + 1
+        opn = st["commit"][i] + 1
+        committed = (st["peer_op"][i] >= opn).sum() >= self.R // 2
+        en = (self._can_progress(st, i)
+              & self._is_normal_primary(st, i, r)
+              & (st["commit"][i] < st["op"][i]) & committed)
+        code = st["log"][i, jnp.clip(opn - 1, 0, self.MAX_OPS - 1)]
+        vid = code >> ENTRY_VIEW_BITS
+        s2 = self._exec_ops(dict(st), i, st["log"][i], opn)
+        s2["aux_acked"] = s2["aux_acked"].at[
+            jnp.clip(vid - 1, 0, self.V - 1)].set(2)
+        return s2, en
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _not_recovering(self, st, i):
+        return st["status"][i] != RECOVERING
+
+    def _unique_number(self, st):
+        """UniqueNumber (RR05:826-835): max RecoveryMsg x in the bag
+        plus one (1 when none — the max over an empty mask is 0)."""
+        h = st["m_hdr"]
+        xs = jnp.where((st["m_present"] == 1)
+                       & (h[:, H_TYPE] == M_RECOVERY), h[:, H_X], 0)
+        return xs.max() + 1
+
+    def _clear_rec(self, s2, i):
+        s2 = dict(s2)
+        for key in ("rec", "rec_view", "rec_has_log", "rec_op",
+                    "rec_commit"):
+            s2[key] = s2[key].at[i].set(0)
+        s2["rec_log"] = s2["rec_log"].at[i].set(0)
+        return s2
+
+    # ------------------------------------------------------------------
+    # not-Recovering guard deltas on carried-over actions
+    # ------------------------------------------------------------------
+    def act_timer_send_svc(self, st, lane):       # RR05:578-600
+        s2, en = super().act_timer_send_svc(st, lane)
+        return s2, en & self._not_recovering(st, lane)
+
+    def guard_timer_send_svc(self, st, lane):
+        return (super().guard_timer_send_svc(st, lane)
+                & self._not_recovering(st, lane))
+
+    def act_receive_higher_svc(self, st, lane):   # RR05:602-625
+        s2, en = super().act_receive_higher_svc(st, lane)
+        i = self._dest_i(st, lane)
+        return s2, en & self._not_recovering(st, i)
+
+    def guard_receive_higher_svc(self, st, k):
+        return (super().guard_receive_higher_svc(st, k)
+                & self._not_recovering(st, self._dest_i(st, k)))
+
+    def act_receive_higher_dvc(self, st, lane):   # RR05:684-707
+        s2, en = super().act_receive_higher_dvc(st, lane)
+        i = self._dest_i(st, lane)
+        return s2, en & self._not_recovering(st, i)
+
+    def guard_receive_higher_dvc(self, st, k):
+        return (super().guard_receive_higher_dvc(st, k)
+                & self._not_recovering(st, self._dest_i(st, k)))
+
+    def act_receive_sv(self, st, lane):           # RR05:794-822
+        s2, en = super().act_receive_sv(st, lane)
+        i = self._dest_i(st, lane)
+        return s2, en & self._not_recovering(st, i)
+
+    def guard_receive_sv(self, st, k):
+        return (super().guard_receive_sv(st, k)
+                & self._not_recovering(st, self._dest_i(st, k)))
+
+    # ------------------------------------------------------------------
+    # recovery actions
+    # ------------------------------------------------------------------
+    def act_crash(self, st, lane):                # RR05:837-861
+        i = lane
+        r = i + 1
+        en = ((st["aux_restart"] < self.crash_limit)
+              & self._can_progress(st, i))
+        u = self._unique_number(st)
+        s2 = dict(st)
+        s2["status"] = st["status"].at[i].set(RECOVERING)
+        s2["log"] = st["log"].at[i].set(0)
+        s2["app"] = st["app"].at[i].set(0)
+        s2["view"] = st["view"].at[i].set(0)
+        s2["op"] = st["op"].at[i].set(0)
+        s2["commit"] = st["commit"].at[i].set(0)
+        s2["peer_op"] = st["peer_op"].at[i].set(0)
+        s2["lnv"] = st["lnv"].at[i].set(0)
+        s2 = self._reset_sent(s2, i)
+        s2 = self._clear_dvc(s2, i)
+        s2 = self._clear_rec(s2, i)
+        s2["rec_number"] = s2["rec_number"].at[i].set(u)
+        s2["aux_restart"] = st["aux_restart"] + 1
+        s2 = self._broadcast(s2, self._row(M_RECOVERY, src=r, x=u), r)
+        return s2, en
+
+    def guard_crash(self, st, lane):
+        return ((st["aux_restart"] < self.crash_limit)
+                & self._can_progress(st, lane))
+
+    def act_receive_recovery(self, st, lane):     # RR05:871-889
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_RECOVERY)
+              & self._can_progress(st, i)
+              & (st["status"][i] == NORMAL))
+        prim = self._is_normal_primary(st, i, r)
+        s2 = self._bag_discard(dict(st), k)
+        row = self._row(
+            M_RECOVERYRESP, view=st["view"][i], x=hdr[H_X],
+            op=jnp.where(prim, st["op"][i], -1),
+            commit=jnp.where(prim, st["commit"][i], -1),
+            dest=hdr[H_SRC], src=r,
+            log=jnp.where(prim, st["log"][i], 0))
+        s2 = self._bag_send(s2, row)
+        return s2, en
+
+    def guard_receive_recovery(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_RECOVERY)
+                & self._can_progress(st, i)
+                & (st["status"][i] == NORMAL))
+
+    def act_receive_recovery_response(self, st, lane):  # RR05:896-909
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        j = jnp.clip(hdr[H_SRC] - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_RECOVERYRESP)
+              & self._can_progress(st, i)
+              & (st["rec_number"][i] == hdr[H_X])
+              & (st["status"][i] == RECOVERING))
+        s2 = dict(st)
+        # set-union into the per-source slot; a different record from
+        # the same source cannot occur (one response per (x, source))
+        collide = en & (s2["rec"][i, j] == 1) \
+            & ((s2["rec_view"][i, j] != hdr[H_VIEW])
+               | (s2["rec_op"][i, j] != hdr[H_OP]))
+        s2["rec"] = s2["rec"].at[i, j].set(1)
+        s2["rec_view"] = s2["rec_view"].at[i, j].set(hdr[H_VIEW])
+        s2["rec_has_log"] = s2["rec_has_log"].at[i, j].set(
+            jnp.where(hdr[H_OP] >= 0, 1, 0))
+        s2["rec_log"] = s2["rec_log"].at[i, j].set(st["m_log"][k])
+        s2["rec_op"] = s2["rec_op"].at[i, j].set(hdr[H_OP])
+        s2["rec_commit"] = s2["rec_commit"].at[i, j].set(hdr[H_COMMIT])
+        s2["err"] = s2["err"] | jnp.where(collide, ERR_REC_OVERFLOW, 0)
+        s2 = self._bag_discard(s2, k)
+        return s2, en
+
+    def guard_receive_recovery_response(self, st, k):
+        i = self._dest_i(st, k)
+        return (self._recv_guard(st, k, M_RECOVERYRESP)
+                & self._can_progress(st, i)
+                & (st["rec_number"][i] == st["m_hdr"][k, H_X])
+                & (st["status"][i] == RECOVERING))
+
+    def _best_rec(self, st, i):
+        """The has-log response in the highest view of ALL responses
+        (RR05:924-931), or none."""
+        pres = st["rec"][i] == 1
+        vmax = jnp.max(jnp.where(pres, st["rec_view"][i], -1))
+        cand = pres & (st["rec_has_log"][i] == 1) \
+            & (st["rec_view"][i] == vmax)
+        return cand, jnp.argmax(cand)
+
+    def act_complete_recovery(self, st, lane):    # RR05:920-942
+        i = lane
+        cand, j = self._best_rec(st, i)
+        en = (self._can_progress(st, i)
+              & (st["status"][i] == RECOVERING)
+              & ((st["rec"][i] == 1).sum() > self.R // 2)
+              & cand.any())
+        s2 = dict(st)
+        s2["status"] = st["status"].at[i].set(NORMAL)
+        s2["view"] = st["view"].at[i].set(st["rec_view"][i, j])
+        s2["lnv"] = st["lnv"].at[i].set(st["rec_view"][i, j])
+        s2["log"] = st["log"].at[i].set(st["rec_log"][i, j])
+        s2["op"] = st["op"].at[i].set(st["rec_op"][i, j])
+        s2 = self._exec_ops(s2, i, st["rec_log"][i, j],
+                            st["rec_commit"][i, j])
+        s2 = self._clear_rec(s2, i)
+        return s2, en
+
+    def guard_complete_recovery(self, st, lane):
+        i = lane
+        cand, _j = self._best_rec(st, i)
+        return (self._can_progress(st, i)
+                & (st["status"][i] == RECOVERING)
+                & ((st["rec"][i] == 1).sum() > self.R // 2)
+                & cand.any())
+
+    def act_retry_recovery(self, st, lane):       # RR05:951-983
+        i = lane
+        cand, _j = self._best_rec(st, i)
+        h = st["m_hdr"]
+        dest_i = jnp.clip(h[:, H_DEST] - 1, 0, self.R - 1)
+        dest_can = st["no_prog"][dest_i] == 0
+        pending = ((st["m_present"] == 1) & (st["m_count"] > 0)
+                   & (h[:, H_X] == st["rec_number"][i])
+                   & (((h[:, H_TYPE] == M_RECOVERY) & dest_can)
+                      | (h[:, H_TYPE] == M_RECOVERYRESP))).any()
+        en = (self._can_progress(st, i)
+              & (st["status"][i] == RECOVERING)
+              & ((st["rec"][i] == 1).sum() > self.R // 2)
+              & ~cand.any() & ~pending)
+        u = self._unique_number(st)
+        s2 = self._clear_rec(dict(st), i)
+        s2["rec_number"] = s2["rec_number"].at[i].set(u)
+        s2 = self._broadcast(s2, self._row(M_RECOVERY, src=i + 1, x=u),
+                             i + 1)
+        return s2, en
+
+    def guard_retry_recovery(self, st, lane):
+        _s2, en = self.act_retry_recovery(st, lane)
+        return en
+
+    # ------------------------------------------------------------------
+    # action table
+    # ------------------------------------------------------------------
+    def _guard_fns(self):
+        return super()._guard_fns() [:15] + [
+            self.guard_crash, self.guard_receive_recovery,
+            self.guard_receive_recovery_response,
+            self.guard_complete_recovery, self.guard_retry_recovery,
+            self.guard_no_progress_change,
+        ]
+
+    def _action_fns(self):
+        return super()._action_fns()[:15] + [
+            self.act_crash, self.act_receive_recovery,
+            self.act_receive_recovery_response,
+            self.act_complete_recovery, self.act_retry_recovery,
+            self.act_no_progress_change,
+        ]
+
+    def lane_replica(self, name, st, lane):
+        if name in ("Crash", "CompleteRecovery", "RetryRecovery"):
+            return lane
+        if name in ("ReceiveRecoveryMsg", "ReceiveRecoveryResponseMsg"):
+            return jnp.clip(st["m_hdr"][lane, H_DEST] - 1, 0, self.R - 1)
+        return super().lane_replica(name, st, lane)

@@ -37,7 +37,7 @@ from .st03 import (ANYDEST, ERR_BAG_OVERFLOW, M_DVC, M_GETSTATE,
                    M_NEWSTATE, M_PREPARE, M_PREPAREOK, M_SV, M_SVC,
                    NORMAL, STATETRANSFER, VIEWCHANGE, ST03Codec)
 from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_LNV, H_OP, H_SRC, H_TYPE,
-                  H_VIEW, NHDR)
+                  H_VIEW, H_X, NHDR)
 
 I32 = jnp.int32
 INF = np.int32(0x7FFFFFFF)
@@ -133,11 +133,11 @@ class ST03Kernel:
     # message-bag primitives (ST03:164-218)
     # ==================================================================
     def _row(self, type_, view=0, op=0, commit=0, dest=0, src=0,
-             first=0, lnv=0, entry=0, log=None):
+             first=0, lnv=0, entry=0, log=None, x=0):
         hdr = jnp.zeros((NHDR,), I32)
         for col, v in ((H_TYPE, type_), (H_VIEW, view), (H_OP, op),
                        (H_COMMIT, commit), (H_DEST, dest), (H_SRC, src),
-                       (H_FIRST, first), (H_LNV, lnv)):
+                       (H_FIRST, first), (H_LNV, lnv), (H_X, x)):
             hdr = hdr.at[col].set(jnp.asarray(v, I32))
         return {
             "hdr": hdr,
