@@ -129,6 +129,31 @@ def assert_kernel_matches(spec, codec, kern, states):
                 f"state {n}: successors differ for action {name}"
 
 
+def interp_level_sizes(spec, depth):
+    """Exact per-level frontier sizes of the interpreter BFS to a fixed
+    depth — the level-count oracle for state spaces too large for a
+    fixpoint run."""
+    seen = set()
+    frontier = []
+    for st in spec.init_states():
+        k = spec.view_value(st)
+        if k not in seen:
+            seen.add(k)
+            frontier.append(st)
+    sizes = [len(frontier)]
+    for _ in range(depth):
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                k = spec.view_value(succ)
+                if k not in seen:
+                    seen.add(k)
+                    nxt.append(succ)
+        frontier = nxt
+        sizes.append(len(frontier))
+    return sizes
+
+
 def assert_incremental_fp_matches(codec, kern, states):
     """The O(touched) incremental fingerprint must equal the full-state
     recompute on every enabled lane of the given states."""

@@ -1,9 +1,8 @@
-"""Differential tests for the RR05 device kernel (VR_REPLICA_RECOVERY)
-vs the interpreter oracle — pinning the crash-recovery sub-protocol:
-UniqueNumber nonces, the primary-only recovery responses with Nil
-sentinels, highest-view CompleteRecovery, RetryRecovery's no-more-
-responses bag predicate, and the not-Recovering guards on the carried-
-over view-change actions.  RR05 ships no cfg; constants are
+"""Differential tests for the AL05 device kernel
+(VR_REPLICA_RECOVERY_ASYNC_LOG)
+vs the interpreter oracle — pinning the async-log deltas: prefix-survival crashes (one lane per
+(replica, last_op)), the two-form recovery responses (backup Nil vs
+primary prefix_ceil+suffix), and the prefix-splicing CompleteRecovery.  AL05 ships no cfg; constants are
 synthesized (test_corpus does the same).
 """
 
@@ -19,13 +18,13 @@ from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_text
 from tpuvsr.frontend.parser import parse_module_file
 from tpuvsr.models.registry import value_perm_table
-from tpuvsr.models.rr05 import RR05Codec
-from tpuvsr.models.rr05_kernel import ACTION_NAMES, RR05Kernel
+from tpuvsr.models.al05 import AL05Codec
+from tpuvsr.models.al05_kernel import ACTION_NAMES, AL05Kernel
 
 pytestmark = requires_reference
 
-RR05_TLA = (f"{REFERENCE}/analysis/05-replica-recovery/"
-            f"VR_REPLICA_RECOVERY.tla")
+AL05_TLA = (f"{REFERENCE}/analysis/05-replica-recovery/"
+            f"VR_REPLICA_RECOVERY_ASYNC_LOG.tla")
 
 CFG = """CONSTANTS
     ReplicaCount = 3
@@ -61,14 +60,14 @@ CommitNumberNeverHigherThanOpNumber
 
 def _load(values="{v1}", timer=1, crash=1, np_limit=0, max_msgs=48,
           symmetry=False):
-    mod = parse_module_file(RR05_TLA)
+    mod = parse_module_file(AL05_TLA)
     cfg = parse_cfg_text(CFG.format(values=values, timer=timer,
                                     crash=crash, np_limit=np_limit))
     if symmetry:
         cfg.symmetry = "symmValues"
     spec = SpecModel(mod, cfg)
-    codec = RR05Codec(spec.ev.constants, max_msgs=max_msgs)
-    kern = RR05Kernel(codec, perms=value_perm_table(spec, codec))
+    codec = AL05Codec(spec.ev.constants, max_msgs=max_msgs)
+    kern = AL05Kernel(codec, perms=value_perm_table(spec, codec))
     return spec, codec, kern
 
 
@@ -91,7 +90,7 @@ def test_kernel_matches_interpreter_small():
 @pytest.mark.slow
 def test_kernel_matches_interpreter_recovery_era():
     # states with a Recovering replica or recovery traffic in flight —
-    # the sub-protocol RR05 adds (incl. CompleteRecovery/RetryRecovery
+    # the sub-protocol AL05 adds (incl. CompleteRecovery/RetryRecovery
     # enabling regions)
     spec, codec, kern = _load(timer=1, crash=1)
     rec_mv = spec.ev.constants["Recovering"]
@@ -122,7 +121,7 @@ def test_guard_fns_match_action_enabledness():
 
 @pytest.mark.slow
 def test_device_bfs_levels_match_interpreter():
-    """The RR05 crash-era state space is too large for a fixpoint
+    """The AL05 crash-era state space is too large for a fixpoint
     oracle run (>300k distinct at CrashLimit=1); compare exact
     per-level frontier sizes to a fixed depth instead — any kernel
     divergence shifts a level count."""
@@ -138,9 +137,9 @@ def test_device_bfs_levels_match_interpreter():
     assert got.distinct_states == sum(sizes)
 
 
-def test_registry_resolves_rr05():
+def test_registry_resolves_al05():
     from tpuvsr.models import registry
-    mod = parse_module_file(RR05_TLA)
+    mod = parse_module_file(AL05_TLA)
     cfg = parse_cfg_text(CFG.format(values="{v1}", timer=1, crash=1,
                                     np_limit=0))
     spec = SpecModel(mod, cfg)

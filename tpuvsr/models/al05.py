@@ -1,0 +1,152 @@
+"""Dense TPU state layout for VR_REPLICA_RECOVERY_ASYNC_LOG (reference:
+AL05, analysis/05-replica-recovery/VR_REPLICA_RECOVERY_ASYNC_LOG.tla).
+
+AL05 = RR05 with asynchronous log persistence: ``Crash`` keeps a
+nondeterministic log *prefix* (``\\E last_op \\in 0..rep_op_number[r]``,
+AL05:851-885) and the RecoveryMsg carries the survivor's floor
+``op = MinVal(commit, last_op)``; recovery responses come in TWO forms
+(AL05:888-915) — a backup's [view, x, log_suffix=Nil] and the primary's
+[view, x, prefix_ceil, log_suffix, op, commit] — and CompleteRecovery
+splices its own surviving prefix under the primary's suffix
+(AL05:947-977).  No RetryRecovery (20 actions).
+
+Layout deltas over RR05: a ``rec_ceil`` plane for prefix_ceil, suffix
+logs stored re-based at 0 from the ceiling, and the H_OP/H_FIRST
+columns on the two recovery message kinds (H_OP = -1 marks the
+backup's Nil form, whose record carries no op/commit/ceil fields at
+all).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.values import FnVal
+from .rr05 import M_RECOVERY, M_RECOVERYRESP, RR05Codec
+from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_OP, H_SRC, H_TYPE,
+                  H_VIEW, H_X, NHDR)
+
+
+class AL05Codec(RR05Codec):
+    # AL05 log entries revert to the 1-field [operation] records
+    # (AL05:106-108) — undo RR05's packed 2-field encoding
+    def _enc_entry(self, e: FnVal) -> int:
+        return self.value_id[e.apply("operation")]
+
+    def _dec_entry(self, code):
+        from ..core.values import mk_record
+        return mk_record(operation=self.values[int(code) - 1])
+
+    def zero_state(self):
+        d = super().zero_state()
+        s = self.shape
+        d["rec_ceil"] = np.zeros((s.R, s.R), np.int32)
+        return d
+
+    def _encode_rec(self, st, d, r):
+        i = r - 1
+        d["rec_number"][i] = st["rep_rec_number"].apply(r)
+        for m in st["rep_rec_recv"].apply(r):
+            if m.apply("x") != d["rec_number"][i] or m.apply("dest") != r:
+                from ..core.values import TLAError
+                raise TLAError("rec_recv implied-field invariant violated")
+            j = m.apply("source") - 1
+            if d["rec"][i][j]:
+                from ..core.values import TLAError
+                raise TLAError("recovery-response slot collision")
+            d["rec"][i][j] = 1
+            d["rec_view"][i][j] = m.apply("view_number")
+            lg = m.get("log_suffix")
+            if isinstance(lg, FnVal):
+                ceil = m.apply("prefix_ceil")
+                d["rec_has_log"][i][j] = 1
+                d["rec_ceil"][i][j] = ceil
+                d["rec_log"][i][j] = self._enc_log(lg, first_op=ceil + 1)
+                d["rec_op"][i][j] = m.apply("op_number")
+                d["rec_commit"][i][j] = m.apply("commit_number")
+            else:
+                d["rec_op"][i][j] = -1
+                d["rec_commit"][i][j] = -1
+
+    def encode_msg_row(self, m: FnVal):
+        t = self.mtype_id[m.apply("type")]
+        if t not in (M_RECOVERY, M_RECOVERYRESP):
+            return super(RR05Codec, self).encode_msg_row(m)
+        hdr = np.zeros(NHDR, np.int32)
+        log = np.zeros(self.shape.MAX_OPS, np.int32)
+        get = m.get
+        hdr[H_TYPE] = t
+        hdr[H_DEST] = self._enc_dest(get("dest"))
+        hdr[H_SRC] = get("source")
+        hdr[H_X] = get("x")
+        if t == M_RECOVERY:
+            hdr[H_OP] = get("op")       # MinVal(commit, last_op) floor
+        else:
+            hdr[H_VIEW] = get("view_number")
+            lg = get("log_suffix")
+            if isinstance(lg, FnVal):
+                ceil = get("prefix_ceil")
+                hdr[H_FIRST] = ceil
+                hdr[H_OP] = get("op_number")
+                hdr[H_COMMIT] = get("commit_number")
+                log = self._enc_log(lg, first_op=ceil + 1)
+            else:
+                hdr[H_OP] = -1          # backup form: log_suffix = Nil
+                hdr[H_COMMIT] = -1
+        return hdr, 0, log
+
+    def decode_msg_row(self, hdr, entry, log):
+        t = int(hdr[H_TYPE])
+        if t not in (M_RECOVERY, M_RECOVERYRESP):
+            return super(RR05Codec, self).decode_msg_row(hdr, entry, log)
+        mv = self.mtype_mv[t]
+        f = {"type": mv, "dest": self._dec_dest(hdr[H_DEST]),
+             "source": int(hdr[H_SRC]), "x": int(hdr[H_X])}
+        if t == M_RECOVERY:
+            f["op"] = int(hdr[H_OP])
+        else:
+            f["view_number"] = int(hdr[H_VIEW])
+            if int(hdr[H_OP]) < 0:
+                f["log_suffix"] = self.nil
+            else:
+                ceil = int(hdr[H_FIRST])
+                f.update(prefix_ceil=ceil,
+                         log_suffix=self._dec_log(
+                             log, int(hdr[H_OP]) - ceil, first_op=ceil + 1),
+                         op_number=int(hdr[H_OP]),
+                         commit_number=int(hdr[H_COMMIT]))
+        return FnVal(f.items())
+
+    def decode(self, d: dict):
+        st = super(RR05Codec, self).decode(d)     # AS04 layers
+        d = {k: np.asarray(v) for k, v in d.items()}
+        s = self.shape
+        reps = range(1, s.R + 1)
+        st["rep_rec_number"] = FnVal((r, int(d["rec_number"][r - 1]))
+                                     for r in reps)
+        resp_mv = self.constants["RecoveryResponseMsg"]
+
+        def rec_msg(r, j):
+            f = {"type": resp_mv,
+                 "view_number": int(d["rec_view"][r - 1][j]),
+                 "x": int(d["rec_number"][r - 1]),
+                 "dest": r, "source": j + 1}
+            if d["rec_has_log"][r - 1][j]:
+                ceil = int(d["rec_ceil"][r - 1][j])
+                f.update(prefix_ceil=ceil,
+                         log_suffix=self._dec_log(
+                             d["rec_log"][r - 1][j],
+                             int(d["rec_op"][r - 1][j]) - ceil,
+                             first_op=ceil + 1),
+                         op_number=int(d["rec_op"][r - 1][j]),
+                         commit_number=int(d["rec_commit"][r - 1][j]))
+            else:
+                f["log_suffix"] = self.nil
+            return FnVal(f.items())
+
+        st["rep_rec_recv"] = FnVal(
+            (r, frozenset(rec_msg(r, j)
+                          for j in range(s.R) if d["rec"][r - 1][j]))
+            for r in reps)
+        st["aux_restart"] = int(d["aux_restart"])
+        return st

@@ -1,0 +1,183 @@
+"""jit+vmap transition kernel for VR_REPLICA_RECOVERY_ASYNC_LOG (AL05).
+
+Subclasses the RR05 kernel with the async-log-persistence deltas
+(AL05's 20-action Next, AL05:992-1017 — RR05 minus RetryRecovery):
+
+* ``Crash`` keeps a nondeterministic surviving log prefix: one lane
+  per (replica, last_op in 0..MAX_OPS); the RecoveryMsg carries the
+  floor ``op = min(old commit, last_op)`` (AL05:851-885);
+* ``ReceiveRecoveryMsg`` answers in two record shapes (AL05:888-915):
+  a backup's Nil log_suffix (no op/commit/ceil fields) or the
+  primary's prefix_ceil + suffix-above-the-floor;
+* ``CompleteRecovery`` splices the recovering replica's OWN surviving
+  prefix (up to prefix_ceil) under the primary's suffix
+  (AL05:947-977).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .al05 import AL05Codec
+from .as04_kernel import AS04Kernel
+from .rr05 import M_RECOVERY, M_RECOVERYRESP, RECOVERING
+from .rr05_kernel import RR05Kernel
+from .st03 import NORMAL
+from .st03_kernel import I32, ST03Kernel
+from .vsr import H_DEST, H_FIRST, H_OP, H_SRC, H_X
+
+ACTION_NAMES = (
+    "TimerSendSVC", "ReceiveHigherSVC", "ReceiveMatchingSVC", "SendDVC",
+    "ReceiveHigherDVC", "ReceiveMatchingDVC", "SendSV", "ReceiveSV",
+    "ReceiveClientRequest", "ReceivePrepareMsg", "ReceivePrepareOkMsg",
+    "PrimaryExecuteOp", "SendGetState", "ReceiveGetState",
+    "ReceiveNewState", "Crash", "ReceiveRecoveryMsg",
+    "ReceiveRecoveryResponseMsg", "CompleteRecovery", "NoProgressChange",
+)
+
+REP_KEYS = RR05Kernel.REP_KEYS + ("rec_ceil",)
+
+
+class AL05Kernel(RR05Kernel):
+    action_names = ACTION_NAMES
+    REP_KEYS = REP_KEYS
+
+    def __init__(self, codec: AL05Codec, perms=None):
+        super().__init__(codec, perms=perms)
+
+    def _rep_shape(self, k):
+        if k == "rec_ceil":
+            return (self.shape.R, self.shape.R)
+        return super()._rep_shape(k)
+
+    # AL05 entries are plain value ids again (AL05:106-108) — undo the
+    # RR05 packed-entry borrowings
+    _perm_vals = ST03Kernel._perm_vals
+    _replica_has_op = ST03Kernel._replica_has_op
+    act_receive_client_request = ST03Kernel.act_receive_client_request
+    act_execute_op = AS04Kernel.act_execute_op
+
+    def _lane_count(self, name):
+        if name == "Crash":
+            return self.R * (self.MAX_OPS + 1)
+        return super()._lane_count(name)
+
+    def _clear_rec(self, s2, i):
+        s2 = super()._clear_rec(s2, i)
+        s2["rec_ceil"] = s2["rec_ceil"].at[i].set(0)
+        return s2
+
+    # ------------------------------------------------------------------
+    # async-log recovery actions
+    # ------------------------------------------------------------------
+    def act_crash(self, st, lane):                # AL05:851-885
+        i = lane // (self.MAX_OPS + 1)
+        last_op = lane % (self.MAX_OPS + 1)
+        r = i + 1
+        en = ((st["aux_restart"] < self.crash_limit)
+              & self._can_progress(st, i)
+              & (last_op <= st["op"][i]))
+        u = self._unique_number(st)
+        floor = jnp.minimum(st["commit"][i], last_op)
+        pos = jnp.arange(self.MAX_OPS, dtype=I32)
+        s2 = dict(st)
+        s2["status"] = st["status"].at[i].set(RECOVERING)
+        s2["log"] = st["log"].at[i].set(
+            jnp.where(pos < last_op, st["log"][i], 0))    # LogPrefix
+        s2["app"] = st["app"].at[i].set(0)
+        s2["view"] = st["view"].at[i].set(0)
+        s2["op"] = st["op"].at[i].set(last_op)
+        s2["commit"] = st["commit"].at[i].set(0)
+        s2["peer_op"] = st["peer_op"].at[i].set(0)
+        s2["lnv"] = st["lnv"].at[i].set(0)
+        s2 = self._reset_sent(s2, i)
+        s2 = self._clear_dvc(s2, i)
+        s2 = self._clear_rec(s2, i)
+        s2["rec_number"] = s2["rec_number"].at[i].set(u)
+        s2["aux_restart"] = st["aux_restart"] + 1
+        s2 = self._broadcast(
+            s2, self._row(M_RECOVERY, src=r, x=u, op=floor), r)
+        return s2, en
+
+    def guard_crash(self, st, lane):
+        i = lane // (self.MAX_OPS + 1)
+        last_op = lane % (self.MAX_OPS + 1)
+        return ((st["aux_restart"] < self.crash_limit)
+                & self._can_progress(st, i)
+                & (last_op <= st["op"][i]))
+
+    def act_receive_recovery(self, st, lane):     # AL05:888-915
+        k = lane
+        hdr = st["m_hdr"][k]
+        r = hdr[H_DEST]
+        i = jnp.clip(r - 1, 0, self.R - 1)
+        en = (self._recv_guard(st, k, M_RECOVERY)
+              & self._can_progress(st, i)
+              & (st["status"][i] == NORMAL))
+        prim = self._is_normal_primary(st, i, r)
+        floor = hdr[H_OP]
+        pos = jnp.arange(self.MAX_OPS, dtype=I32)
+        n_suffix = jnp.maximum(st["op"][i] - floor, 0)
+        src_pos = jnp.clip(pos + floor, 0, self.MAX_OPS - 1)
+        suffix = jnp.where(pos < n_suffix, st["log"][i][src_pos], 0)
+        s2 = self._bag_discard(dict(st), k)
+        row = self._row(
+            M_RECOVERYRESP, view=st["view"][i], x=hdr[H_X],
+            first=jnp.where(prim, floor, 0),
+            op=jnp.where(prim, st["op"][i], -1),
+            commit=jnp.where(prim, st["commit"][i], -1),
+            dest=hdr[H_SRC], src=r,
+            log=jnp.where(prim, suffix, jnp.zeros_like(suffix)))
+        s2 = self._bag_send(s2, row)
+        return s2, en
+
+    def act_receive_recovery_response(self, st, lane):  # AL05:918-932
+        s2, en = super().act_receive_recovery_response(st, lane)
+        hdr = st["m_hdr"][lane]
+        i = jnp.clip(hdr[H_DEST] - 1, 0, self.R - 1)
+        j = jnp.clip(hdr[H_SRC] - 1, 0, self.R - 1)
+        s2["rec_ceil"] = s2["rec_ceil"].at[i, j].set(
+            jnp.where(hdr[H_OP] >= 0, hdr[H_FIRST], 0))
+        return s2, en
+
+    def act_complete_recovery(self, st, lane):    # AL05:947-977
+        i = lane
+        cand, j = self._best_rec(st, i)
+        en = (self._can_progress(st, i)
+              & (st["status"][i] == RECOVERING)
+              & ((st["rec"][i] == 1).sum() > self.R // 2)
+              & cand.any())
+        ceil = st["rec_ceil"][i, j]
+        m_op = st["rec_op"][i, j]
+        pos = jnp.arange(self.MAX_OPS, dtype=I32)
+        suffix = st["rec_log"][i, j][jnp.clip(pos - ceil, 0,
+                                              self.MAX_OPS - 1)]
+        new_log = jnp.where(pos < jnp.minimum(ceil, m_op), st["log"][i],
+                            jnp.where(pos < m_op, suffix, 0))
+        s2 = dict(st)
+        s2["status"] = st["status"].at[i].set(NORMAL)
+        s2["view"] = st["view"].at[i].set(st["rec_view"][i, j])
+        s2["lnv"] = st["lnv"].at[i].set(st["rec_view"][i, j])
+        s2["log"] = st["log"].at[i].set(new_log)
+        s2["op"] = st["op"].at[i].set(m_op)
+        s2 = self._exec_ops(s2, i, new_log, st["rec_commit"][i, j])
+        s2 = self._clear_rec(s2, i)
+        return s2, en
+
+    # ------------------------------------------------------------------
+    # action table (no RetryRecovery)
+    # ------------------------------------------------------------------
+    def _guard_fns(self):
+        fns = super()._guard_fns()
+        del fns[19]                   # RetryRecovery slot
+        return fns
+
+    def _action_fns(self):
+        fns = super()._action_fns()
+        del fns[19]
+        return fns
+
+    def lane_replica(self, name, st, lane):
+        if name == "Crash":
+            return lane // (self.MAX_OPS + 1)
+        return super().lane_replica(name, st, lane)

@@ -96,4 +96,8 @@ def _resolve(name):
         from .rr05 import RR05Codec
         from .rr05_kernel import RR05Kernel
         return RR05Codec, RR05Kernel
+    if name == "VR_REPLICA_RECOVERY_ASYNC_LOG":
+        from .al05 import AL05Codec
+        from .al05_kernel import AL05Kernel
+        return AL05Codec, AL05Kernel
     raise KeyError(name)
