@@ -1,9 +1,9 @@
-"""Differential tests for the AL05 device kernel
-(VR_REPLICA_RECOVERY_ASYNC_LOG)
-vs the interpreter oracle — pinning the async-log deltas: prefix-survival crashes (one lane per
-(replica, last_op)), the two-form recovery responses (backup Nil vs
-primary prefix_ceil+suffix), and the prefix-splicing CompleteRecovery.  AL05 ships no cfg; constants are
-synthesized (test_corpus does the same).
+"""Differential tests for the CP06 device kernel (VR_REPLICA_RECOVERY_CP)
+vs the interpreter oracle — pinning the checkpointing machinery: NoOp GC'd prefixes, implicit
+last_cp existentials, dual-mode (flag 0/1) replies, checkpointed
+DVC/SV with the WinningDVC tie-break, ApplyCheckpoint splices, and
+the GetCheckpoint -> NewCheckpoint -> Recovery chain.  CP06 ships no
+cfg; constants are synthesized (test_corpus does the same).
 """
 
 import pytest
@@ -18,13 +18,13 @@ from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_text
 from tpuvsr.frontend.parser import parse_module_file
 from tpuvsr.models.registry import value_perm_table
-from tpuvsr.models.al05 import AL05Codec
-from tpuvsr.models.al05_kernel import ACTION_NAMES, AL05Kernel
+from tpuvsr.models.cp06 import CP06Codec
+from tpuvsr.models.cp06_kernel import ACTION_NAMES, CP06Kernel
 
 pytestmark = requires_reference
 
-AL05_TLA = (f"{REFERENCE}/analysis/05-replica-recovery/"
-            f"VR_REPLICA_RECOVERY_ASYNC_LOG.tla")
+CP06_TLA = (f"{REFERENCE}/analysis/06-replica-recovery-cp/"
+            f"VR_REPLICA_RECOVERY_CP.tla")
 
 CFG = """CONSTANTS
     ReplicaCount = 3
@@ -47,6 +47,9 @@ CFG = """CONSTANTS
     RecoveryResponseMsg = RecoveryResponseMsg
     Nil = Nil
     AnyDest = AnyDest
+    NoOp = NoOp
+    GetCheckpointMsg = GetCheckpointMsg
+    NewCheckpointMsg = NewCheckpointMsg
 INIT Init
 NEXT Next
 VIEW view
@@ -55,19 +58,20 @@ NoLogDivergence
 NoAppStateDivergence
 AcknowledgedWriteNotLost
 CommitNumberNeverHigherThanOpNumber
+CommitNumberMatchesAppState
 """
 
 
 def _load(values="{v1}", timer=1, crash=1, np_limit=0, max_msgs=48,
           symmetry=False):
-    mod = parse_module_file(AL05_TLA)
+    mod = parse_module_file(CP06_TLA)
     cfg = parse_cfg_text(CFG.format(values=values, timer=timer,
                                     crash=crash, np_limit=np_limit))
     if symmetry:
         cfg.symmetry = "symmValues"
     spec = SpecModel(mod, cfg)
-    codec = AL05Codec(spec.ev.constants, max_msgs=max_msgs)
-    kern = AL05Kernel(codec, perms=value_perm_table(spec, codec))
+    codec = CP06Codec(spec.ev.constants, max_msgs=max_msgs)
+    kern = CP06Kernel(codec, perms=value_perm_table(spec, codec))
     return spec, codec, kern
 
 
@@ -99,10 +103,12 @@ def test_kernel_matches_interpreter_recovery_era():
            if any(s["rep_status"].apply(r) is rec_mv
                   for r in sorted(s["replicas"]))]
     assert era, "exploration never crashed a replica"
+    gcp = spec.ev.constants["NewCheckpointMsg"]
     deep = [s for s in era
-            if any(len(s["rep_rec_recv"].apply(r)) > 0
+            if any(m.apply("type") is gcp for m, _c in s["messages"].items)
+            or any(len(s["rep_rec_recv"].apply(r)) > 0
                    for r in sorted(s["replicas"]))]
-    assert deep, "exploration never received a recovery response"
+    assert deep, "exploration never progressed past GetCheckpoint"
     assert_kernel_matches(spec, codec, kern, era[::8] + deep[::4])
 
 
@@ -121,7 +127,7 @@ def test_guard_fns_match_action_enabledness():
 
 @pytest.mark.slow
 def test_device_bfs_levels_match_interpreter():
-    """The AL05 crash-era state space is too large for a fixpoint
+    """The CP06 crash-era state space is too large for a fixpoint
     oracle run (>300k distinct at CrashLimit=1); compare exact
     per-level frontier sizes to a fixed depth instead — any kernel
     divergence shifts a level count."""
@@ -137,9 +143,9 @@ def test_device_bfs_levels_match_interpreter():
     assert got.distinct_states == sum(sizes)
 
 
-def test_registry_resolves_al05():
+def test_registry_resolves_cp06():
     from tpuvsr.models import registry
-    mod = parse_module_file(AL05_TLA)
+    mod = parse_module_file(CP06_TLA)
     cfg = parse_cfg_text(CFG.format(values="{v1}", timer=1, crash=1,
                                     np_limit=0))
     spec = SpecModel(mod, cfg)

@@ -100,4 +100,8 @@ def _resolve(name):
         from .al05 import AL05Codec
         from .al05_kernel import AL05Kernel
         return AL05Codec, AL05Kernel
+    if name == "VR_REPLICA_RECOVERY_CP":
+        from .cp06 import CP06Codec
+        from .cp06_kernel import CP06Kernel
+        return CP06Codec, CP06Kernel
     raise KeyError(name)

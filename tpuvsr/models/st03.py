@@ -193,6 +193,14 @@ class ST03Codec:
             raise TLAError(f"unencodable message type {m.apply('type')}")
         return hdr, entry, log
 
+    def _store_msg_row(self, d, k, m):
+        """Write one bag record into slot k (hook: CP06 adds a second
+        log plane)."""
+        hdr, entry, log = self.encode_msg_row(m)
+        d["m_hdr"][k] = hdr
+        d["m_entry"][k] = entry
+        d["m_log"][k] = log
+
     def encode(self, st: dict):
         return self._encode_common(st)
 
@@ -223,12 +231,9 @@ class ST03Codec:
         for k, (m, cnt) in enumerate(st["messages"].items):
             if k >= s.MAX_MSGS:
                 raise TLAError(f"message bag exceeds MAX_MSGS={s.MAX_MSGS}")
-            hdr, entry, log = self.encode_msg_row(m)
             d["m_present"][k] = 1
             d["m_count"][k] = cnt
-            d["m_hdr"][k] = hdr
-            d["m_entry"][k] = entry
-            d["m_log"][k] = log
+            self._store_msg_row(d, k, m)
         d["aux_svc"][()] = st["aux_svc"]
         for v, acked in st["aux_client_acked"].items:
             d["aux_acked"][self.value_id[v] - 1] = 2 if acked else 1
@@ -244,6 +249,11 @@ class ST03Codec:
 
     def _dec_dest(self, dest):
         return self.anydest if int(dest) == ANYDEST else int(dest)
+
+    def _bag_row_args(self, d, k):
+        """Slot-k pieces fed to decode_msg_row (hook: CP06 adds the
+        checkpoint plane)."""
+        return (d["m_hdr"][k], d["m_entry"][k], d["m_log"][k])
 
     def decode_msg_row(self, hdr, entry, log):
         t = int(hdr[H_TYPE])
@@ -304,8 +314,7 @@ class ST03Codec:
                                   for r in reps)
         st["no_progress_ctr"] = int(d["np_ctr"])
         st["messages"] = FnVal(
-            (self.decode_msg_row(d["m_hdr"][k], d["m_entry"][k],
-                                 d["m_log"][k]),
+            (self.decode_msg_row(*self._bag_row_args(d, k)),
              int(d["m_count"][k]))
             for k in range(s.MAX_MSGS) if d["m_present"][k])
         st["aux_svc"] = int(d["aux_svc"])

@@ -75,6 +75,10 @@ class ST03Kernel:
                                  # so subclasses can extend the layout)
     # value-id planes a symmetry permutation must remap
     PERM_REP_KEYS = ("log",)
+    PERM_MSG_KEYS = ("m_entry", "m_log")
+    # bag-row payload pieces -> their slot planes (CP06 adds a second
+    # log plane for checkpoints)
+    ROW_PLANES = (("entry", "m_entry"), ("log", "m_log"))
 
     def __init__(self, codec: ST03Codec, perms: np.ndarray = None):
         self.codec = codec
@@ -97,7 +101,7 @@ class ST03Kernel:
         rng = np.random.default_rng(0x57A7E03)
         nrep = 1 + sum(int(np.prod(self._rep_shape(k))) // s.R
                        for k in self.REP_KEYS)
-        nmsg = NHDR + 1 + self.MAX_OPS + 1      # hdr, entry, log, count
+        nmsg = self._nmsg()
         nglob = s.R + 1                          # no_prog plane + ctr
 
         def keys(n):
@@ -113,6 +117,10 @@ class ST03Kernel:
 
         self.step_batch = jax.jit(jax.vmap(self.step_all))
         self.fingerprint_batch = jax.jit(jax.vmap(self.fingerprint))
+
+    def _nmsg(self):
+        # hdr + entry + log + count
+        return NHDR + 1 + self.MAX_OPS + 1
 
     def _rep_shape(self, k):
         s = self.shape
@@ -147,10 +155,11 @@ class ST03Kernel:
         }
 
     def _row_eq(self, st, row):
-        return ((st["m_present"] == 1)
-                & (st["m_hdr"] == row["hdr"]).all(-1)
-                & (st["m_entry"] == row["entry"])
-                & (st["m_log"] == row["log"]).all(-1))
+        eq = (st["m_present"] == 1) & (st["m_hdr"] == row["hdr"]).all(-1)
+        for rk, plane in self.ROW_PLANES:
+            cmp = st[plane] == row[rk]
+            eq = eq & (cmp if cmp.ndim == 1 else cmp.all(-1))
+        return eq
 
     def _touch(self, st, idx, pred):
         if "_ts" not in st:
@@ -185,8 +194,8 @@ class ST03Kernel:
         st["m_count"] = jnp.where(
             wr, st["m_count"].at[idx].set(new_count), st["m_count"])
         st["m_hdr"] = put(st["m_hdr"], row["hdr"])
-        st["m_entry"] = put(st["m_entry"], row["entry"])
-        st["m_log"] = put(st["m_log"], row["log"])
+        for rk, plane in self.ROW_PLANES:
+            st[plane] = put(st[plane], row[rk])
         st["err"] = st["err"] | jnp.where(overflow, ERR_BAG_OVERFLOW, 0)
         return st
 
@@ -761,8 +770,8 @@ class ST03Kernel:
         st = dict(st)
         for k in self.PERM_REP_KEYS:
             st[k] = self._perm_vals(st[k], perm)
-        st["m_log"] = self._perm_vals(st["m_log"], perm)
-        st["m_entry"] = self._perm_vals(st["m_entry"], perm)
+        for k in self.PERM_MSG_KEYS:
+            st[k] = self._perm_vals(st[k], perm)
         return st
 
     def _rep_rows(self, st):
@@ -779,11 +788,12 @@ class ST03Kernel:
 
     def _slot_rows(self, st):
         # AnyDest (-1) casts to 0xFFFFFFFF — distinct from every id
-        return jnp.concatenate(
-            [jnp.asarray(st["m_hdr"], jnp.uint32),
-             jnp.asarray(st["m_entry"], jnp.uint32)[:, None],
-             jnp.asarray(st["m_log"], jnp.uint32),
-             jnp.asarray(st["m_count"], jnp.uint32)[:, None]], axis=1)
+        cols = [jnp.asarray(st["m_hdr"], jnp.uint32)]
+        for _rk, plane in self.ROW_PLANES:
+            v = jnp.asarray(st[plane], jnp.uint32)
+            cols.append(v[:, None] if v.ndim == 1 else v)
+        cols.append(jnp.asarray(st["m_count"], jnp.uint32)[:, None])
+        return jnp.concatenate(cols, axis=1)
 
     def _slot_hashes(self, st):
         rows = self._slot_rows(st)
@@ -846,13 +856,15 @@ class ST03Kernel:
         return jnp.concatenate(cols)
 
     def _slot_row_one(self, st, m, perm):
-        return jnp.concatenate([
-            jnp.asarray(st["m_hdr"][m], jnp.uint32),
-            jnp.asarray(self._perm_vals(st["m_entry"][m], perm),
-                        jnp.uint32)[None],
-            jnp.asarray(self._perm_vals(st["m_log"][m], perm),
-                        jnp.uint32),
-            jnp.asarray(st["m_count"][m], jnp.uint32)[None]])
+        cols = [jnp.asarray(st["m_hdr"][m], jnp.uint32)]
+        for _rk, plane in self.ROW_PLANES:
+            v = st[plane][m]
+            if plane in self.PERM_MSG_KEYS:
+                v = self._perm_vals(v, perm)
+            v = jnp.asarray(v, jnp.uint32)
+            cols.append(v[None] if v.ndim == 0 else v)
+        cols.append(jnp.asarray(st["m_count"][m], jnp.uint32)[None])
+        return jnp.concatenate(cols)
 
     def fingerprint_incremental(self, succ, ri, parts, parent):
         rep_h, slot_h, total = parts

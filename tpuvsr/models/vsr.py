@@ -71,9 +71,12 @@ MSGTYPE_NAMES = {
     M_RECOVERYRESP: "RecoveryResponseMsg",
 }
 
-# Message header columns (hdr[M, NHDR])
-H_TYPE, H_VIEW, H_OP, H_COMMIT, H_DEST, H_SRC, H_X, H_FIRST, H_LNV = range(9)
-NHDR = 9
+# Message header columns (hdr[M, NHDR]).  H_FLAG/H_CP exist for the
+# CP06 dual-mode replies (flag 0/1 + checkpoint number, CP06:404-431);
+# they stay zero for every other model.
+(H_TYPE, H_VIEW, H_OP, H_COMMIT, H_DEST, H_SRC, H_X, H_FIRST, H_LNV,
+ H_FLAG, H_CP) = range(11)
+NHDR = 11
 
 # Log-entry columns (LogEntryType, VSR.tla:157-161)
 E_VIEW, E_OPER, E_CLIENT, E_REQ = range(4)
