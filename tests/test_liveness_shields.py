@@ -104,17 +104,21 @@ def test_al05_shield_neutralizes_limiter_counterexample():
     last view change forever; BlockedOnLastViewChange (inside
     ExistsBlockedReplica, AL05:1127-1135) must neutralize the would-be
     []<>AllReplicasMoveToSameView counterexample — and stubbing the
-    shield to FALSE must surface exactly that violation."""
+    shield to FALSE must surface exactly that violation.  The behavior
+    graph is built once and shared: shields appear only in properties,
+    never in Next."""
+    from tpuvsr.engine.liveness import build_graph
     mod = parse_module_file(f"{AL05}.tla")
     spec = SpecModel(mod, parse_cfg_text(AL05_LIVE_CFG))
-    res = liveness_check(spec)
+    graph = build_graph(spec)
+    res = liveness_check(spec, graph=graph)
     assert res.error is None
     assert res.ok, res.property_name
 
     mod2 = parse_module_file(f"{AL05}.tla")
     spec2 = SpecModel(mod2, parse_cfg_text(AL05_LIVE_CFG))
     _stub_false(spec2, "ExistsBlockedReplica")
-    res2 = liveness_check(spec2)
+    res2 = liveness_check(spec2, graph=graph)
     assert not res2.ok
     assert res2.property_name == "ConvergenceToView"
     # the counterexample must end in a cycle where some replica that

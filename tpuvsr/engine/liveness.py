@@ -212,12 +212,21 @@ def _tarjan_sccs(n_nodes, succ):
     return sccs
 
 
+def build_graph(spec: SpecModel, max_states=None):
+    """Public: the reachable behavior graph (states, edges, inits).
+    Reusable across property runs — e.g. checking a spec with and
+    without its liveness shields shares one graph, since shield
+    predicates appear only in properties, never in Next."""
+    return _build_graph(spec, max_states)
+
+
 def liveness_check(spec: SpecModel, max_states=None,
-                   log=None) -> LivenessResult:
+                   log=None, graph=None) -> LivenessResult:
     res = LivenessResult()
     t0 = time.time()
     try:
-        states, edges, inits = _build_graph(spec, max_states)
+        states, edges, inits = graph if graph is not None \
+            else _build_graph(spec, max_states)
     except TLAError as e:
         res.ok = False
         res.error = str(e)

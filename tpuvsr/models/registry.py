@@ -5,9 +5,16 @@ The engines (device_bfs, device_sim, sharded_bfs) are kernel-agnostic:
 they consume the kernel interface (action_names, lane tables, guard/
 action fns, step_all, fingerprint*, invariant_fn) and the codec
 interface (encode/decode/zero_state/pad_msgs/MSG_KEYS/shape).  This
-module is the one place that maps a module name to an implementation —
-the hand-written kernels today, the ``lower/`` IR pipeline when specs
-gain generated kernels.
+module is the one place that maps a module name to an implementation.
+
+Every module in the reference corpus has a compiled kernel, built as a
+subclass tower that mirrors the specs' own progression: VSR stands
+alone (recv-set quorums, client table, RestartEmpty); ST03 is the base
+of the analysis family (bag-tombstone quorums, AnyDest, state
+transfer) -> A01/I01 (assume/increment view modes, packed entries,
+ResendSVC) and AS04 (app-state executor, recv_dvc slots) -> RR05
+(crash recovery) -> AL05 (async-log prefix survival) and CP06
+(checkpointing, NoOp GC, dual-mode replies).
 """
 
 from __future__ import annotations

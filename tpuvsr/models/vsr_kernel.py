@@ -177,8 +177,10 @@ class VSRKernel:
     def _row(self, type_, view=0, op=0, commit=0, dest=0, src=0, x=0,
              first=0, lnv=0, entry=None, log=None, log_len=0, has_log=0):
         z = jnp.zeros
-        hdr = jnp.stack([jnp.asarray(v, I32) for v in
-                         (type_, view, op, commit, dest, src, x, first, lnv)])
+        hdr = z((NHDR,), I32).at[:9].set(
+            jnp.stack([jnp.asarray(v, I32) for v in
+                       (type_, view, op, commit, dest, src, x, first,
+                        lnv)]))
         return {
             "hdr": hdr,
             "entry": entry if entry is not None else z((NENT,), I32),
