@@ -1,0 +1,96 @@
+"""Measure device-vs-interpreter behavior-graph construction on the
+A01 liveness oracle config (VERDICT r3 item 3 done-criterion: verdicts
+through the device-built graph match the interpreter path, with a
+measured graph-construction speedup).
+
+Config: VR_ASSUME_NEWVIEWCHANGE at R=3, Values={v1}, timer=1 — the
+pinned 42,753-state fixpoint (BASELINE.md), the largest size the
+interpreter graph builder is known to finish (813 s for the BFS alone,
+scripts/fixpoints.json).
+
+Writes scripts/liveness_speedup.json.
+
+Usage: python scripts/liveness_speedup.py [--skip-interp]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import ensure_backend
+
+backend = ensure_backend(log=lambda m: print(f"[liveness] {m}",
+                                             flush=True))
+
+from tpuvsr.core.values import ModelValue                 # noqa: E402
+from tpuvsr.engine.device_liveness import DeviceGraph     # noqa: E402
+from tpuvsr.engine.liveness import build_graph, liveness_check  # noqa: E402
+from tpuvsr.engine.spec import SpecModel                  # noqa: E402
+from tpuvsr.frontend.cfg import parse_cfg_file            # noqa: E402
+from tpuvsr.frontend.parser import parse_module_file      # noqa: E402
+
+REFERENCE = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+PATH = f"{REFERENCE}/analysis/01-view-changes/VR_ASSUME_NEWVIEWCHANGE"
+
+skip_interp = "--skip-interp" in sys.argv
+
+
+def _spec(spec_formula=None):
+    mod = parse_module_file(f"{PATH}.tla")
+    cfg = parse_cfg_file(f"{PATH}.cfg")
+    cfg.constants["Values"] = frozenset({ModelValue("v1")})
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    if spec_formula:
+        cfg.specification = spec_formula
+    return SpecModel(mod, cfg)
+
+
+out = {"config": "A01 @ R=3, |Values|=1, timer=1 (42,753 states)",
+       "backend": backend}
+
+spec = _spec()
+t0 = time.time()
+g = DeviceGraph(spec, tile_size=128,
+                log=lambda m: print(f"[liveness] {m}", flush=True))
+out["device_graph_s"] = round(time.time() - t0, 1)
+out["states"] = g.n
+out["edges"] = sum(len(e) for e in g.edges)
+
+t0 = time.time()
+res = liveness_check(spec, graph=g)
+out["device_verdict_livenessspec"] = {
+    "ok": res.ok, "property": res.property_name,
+    "check_s": round(time.time() - t0, 1)}
+
+spec2 = _spec("Spec")            # fairness-free: ConvergenceToView breaks
+t0 = time.time()
+res2 = liveness_check(spec2, graph=g)
+out["device_verdict_spec_nofairness"] = {
+    "ok": res2.ok, "property": res2.property_name,
+    "check_s": round(time.time() - t0, 1)}
+
+if not skip_interp:
+    t0 = time.time()
+    graph = build_graph(_spec())
+    out["interp_graph_s"] = round(time.time() - t0, 1)
+    ires = liveness_check(_spec(), graph=graph)
+    ires2 = liveness_check(_spec("Spec"), graph=graph)
+    out["interp_verdict_livenessspec"] = {"ok": ires.ok,
+                                          "property": ires.property_name}
+    out["interp_verdict_spec_nofairness"] = {
+        "ok": ires2.ok, "property": ires2.property_name}
+    out["graph_speedup"] = round(out["interp_graph_s"]
+                                 / out["device_graph_s"], 1)
+    out["verdicts_match"] = (ires.ok == res.ok
+                             and ires2.ok == res2.ok
+                             and ires2.property_name == res2.property_name)
+
+with open(os.path.join(REPO, "scripts", "liveness_speedup.json"),
+          "w") as f:
+    json.dump(out, f, indent=1)
+print(json.dumps(out))

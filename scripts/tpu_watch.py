@@ -1,0 +1,55 @@
+"""Tunnel-watch loop (VERDICT r3 item 1).
+
+Re-probes the axon TPU tunnel every few minutes for the whole round,
+appending one JSON line per attempt to ``scripts/tpu_probe_log.jsonl``
+so the tunnel's availability (or absence) is auditable.  When a probe
+sees >0 devices it drops ``scripts/TPU_UP`` as a flag file and keeps
+watching (the tunnel can flap).
+
+Run detached:  python scripts/tpu_watch.py --interval 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuvsr.platform_select import probe_tpu
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_probe_log.jsonl")
+FLAG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_UP")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--timeout", type=float, default=75.0)
+    ap.add_argument("--max-hours", type=float, default=13.0)
+    args = ap.parse_args()
+
+    t0 = time.time()
+    while time.time() - t0 < args.max_hours * 3600:
+        t = time.time()
+        n = probe_tpu(args.timeout)
+        rec = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)),
+            "probe_s": round(time.time() - t, 1),
+            "devices": n,
+        }
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if n > 0:
+            with open(FLAG, "w") as f:
+                f.write(json.dumps(rec) + "\n")
+        elif os.path.exists(FLAG):
+            os.remove(FLAG)
+        time.sleep(max(0.0, args.interval - (time.time() - t)))
+
+
+if __name__ == "__main__":
+    main()

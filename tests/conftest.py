@@ -154,6 +154,33 @@ def interp_level_sizes(spec, depth):
     return sizes
 
 
+def interp_levels_fixpoint(spec):
+    """Interpreter BFS to fixpoint: (nonempty level sizes, total
+    distinct, diameter) — the engine-parity oracle for small configs."""
+    seen = set()
+    frontier = []
+    for st in spec.init_states():
+        k = spec.view_value(st)
+        if k not in seen:
+            seen.add(k)
+            frontier.append(st)
+    sizes = [len(frontier)]
+    depth = 0
+    while frontier:
+        depth += 1
+        nxt = []
+        for st in frontier:
+            for _a, succ in spec.successors(st):
+                k = spec.view_value(succ)
+                if k not in seen:
+                    seen.add(k)
+                    nxt.append(succ)
+        frontier = nxt
+        if nxt:
+            sizes.append(len(nxt))
+    return sizes, len(seen), depth
+
+
 def assert_incremental_fp_matches(codec, kern, states):
     """The O(touched) incremental fingerprint must equal the full-state
     recompute on every enabled lane of the given states."""

@@ -1,0 +1,89 @@
+"""Paged-BFS tests: the host-RAM frontier spill tier must match the
+device-resident engine exactly (same jitted level kernel, different
+frontier residency), including under forced spills, message-table
+growth, and checkpoint/resume.  This is the CAPACITY.md mitigation-1
+tier gating the defect-config flagship run (reference README:20).
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import (interp_levels_fixpoint as _interp_levels,
+                            requires_reference, vsr_spec)
+from tpuvsr.engine.device_bfs import DeviceBFS
+from tpuvsr.engine.paged_bfs import PagedBFS
+
+pytestmark = requires_reference
+
+
+def test_paged_bfs_fixpoint_matches_interpreter():
+    # small chunks (2 tiles x 8 states) force many page-in cycles per
+    # level
+    spec = vsr_spec(values=("v1",), timer=0)
+    sizes, total, diameter = _interp_levels(spec)
+    eng = PagedBFS(spec, tile_size=8, chunk_tiles=2, next_capacity=1)
+    res = eng.run()
+    assert res.ok and res.error is None
+    assert res.distinct_states == total
+    assert eng.level_sizes == sizes
+    assert res.diameter == diameter
+    # every recorded next state was paged through the host exactly once
+    assert eng.spill_rows == total - sizes[0]
+
+
+def test_paged_bfs_forced_spills_mid_chunk():
+    # timer=1 levels grow to hundreds of states (1,3,8,24,68,163,332);
+    # with next_capacity clamped to its floor (total_E + tile) the
+    # R_NEXT_GROW spill path must fire mid-chunk, repeatedly, and the
+    # per-level counts must still exactly match the interpreter
+    from tests.conftest import interp_level_sizes
+    spec = vsr_spec(values=("v1",), timer=1)
+    sizes = interp_level_sizes(spec, 6)
+    eng = PagedBFS(spec, tile_size=8, chunk_tiles=2, next_capacity=1)
+    res = eng.run(max_depth=6)
+    assert res.ok
+    assert eng.level_sizes[:7] == sizes[:7]
+    assert eng.spill_count > 0, "forced-spill path never fired"
+
+
+def test_paged_bfs_matches_resident_engine():
+    spec = vsr_spec(values=("v1",), timer=0, restarts=1)
+    eng_r = DeviceBFS(spec, tile_size=8)
+    res_r = eng_r.run()
+    eng_p = PagedBFS(vsr_spec(values=("v1",), timer=0, restarts=1),
+                     tile_size=8, chunk_tiles=4)
+    res_p = eng_p.run()
+    assert res_p.ok == res_r.ok
+    assert res_p.distinct_states == res_r.distinct_states
+    assert res_p.states_generated == res_r.states_generated
+    assert eng_p.level_sizes == eng_r.level_sizes
+
+
+def test_paged_bfs_message_table_grows_in_place():
+    # undersized message table: growth happens mid-level with states
+    # already spilled to host (they get padded in place)
+    spec = vsr_spec(values=("v1",), timer=0, restarts=1)
+    sizes, total, _ = _interp_levels(spec)
+    eng = PagedBFS(spec, tile_size=8, chunk_tiles=2, max_msgs=2)
+    res = eng.run()
+    assert res.ok and res.distinct_states == total
+    assert eng.level_sizes == sizes
+    assert eng.codec.shape.MAX_MSGS > 2
+
+
+def test_paged_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "paged.ckpt")
+    spec = vsr_spec()
+    eng1 = PagedBFS(spec, tile_size=64, chunk_tiles=2)
+    res1 = eng1.run(max_depth=5, checkpoint_path=ckpt)
+    assert res1.error                     # depth-limited
+    sizes_at_kill = list(eng1.level_sizes)
+
+    eng2 = PagedBFS(vsr_spec(), tile_size=64, chunk_tiles=2)
+    res2 = eng2.run(max_depth=9, resume_from=ckpt)
+    eng3 = DeviceBFS(vsr_spec(), tile_size=64)
+    res3 = eng3.run(max_depth=9)
+    assert eng2.level_sizes == eng3.level_sizes
+    assert eng2.level_sizes[:len(sizes_at_kill)] == sizes_at_kill
+    assert res2.distinct_states == res3.distinct_states
+    assert res2.states_generated == res3.states_generated

@@ -18,8 +18,11 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
   -fpset NAME      fingerprint-set implementation, mirroring TLC's
                    pluggable-FPSet class flag: auto (default) | hbm
                    (the HBM-resident device table — forces the device
-                   engine) | host (the interpreter's in-memory set —
-                   forces the interpreter engine)
+                   engine) | paged (HBM fingerprints + host-RAM-paged
+                   frontier — the spill tier for defect-scale runs,
+                   TLC's disk-backed queue analog) | host (the
+                   interpreter's in-memory set — forces the
+                   interpreter engine)
   -maxstates N     stop BFS after N distinct states
   -deadlock        enable deadlock reporting (note: TLC's flag of the
                    same name *disables* its default-on check; the
@@ -53,7 +56,7 @@ def build_parser():
     p.add_argument("-seed", type=int, default=0)
     p.add_argument("-engine", choices=["auto", "device", "interp"],
                    default="auto")
-    p.add_argument("-fpset", choices=["auto", "hbm", "host"],
+    p.add_argument("-fpset", choices=["auto", "hbm", "paged", "host"],
                    default="auto")
     p.add_argument("-maxstates", type=int, default=None)
     p.add_argument("-deadlock", action="store_true")
@@ -74,6 +77,10 @@ def _pick_engine(requested, fpset, spec):
         if requested == "interp":
             raise SystemExit("-fpset hbm requires the device engine")
         return "device"
+    if fpset == "paged":
+        if requested == "interp":
+            raise SystemExit("-fpset paged requires the device engine")
+        return "paged"
     if fpset == "host":
         if requested == "device":
             raise SystemExit("-fpset host requires -engine interp")
@@ -100,14 +107,14 @@ def main(argv=None):
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
 
-    if engine == "device":
+    if engine in ("device", "paged"):
         backend = ensure_backend(log)
         log(f"backend: {backend}")
     log(f"spec {spec.module.name}, engine {engine}, "
         f"{'simulation' if args.simulate else 'BFS'}")
 
     if args.simulate:
-        if engine == "device":
+        if engine in ("device", "paged"):
             from ..engine.device_sim import device_simulate
             res = device_simulate(spec, num=args.num, depth=args.depth,
                                   seed=args.seed, log=log,
@@ -123,11 +130,21 @@ def main(argv=None):
                    "violated": res.violated_invariant,
                    "elapsed_s": round(res.elapsed, 3)}
     else:
-        if engine == "device":
+        if engine in ("device", "paged"):
             from ..engine.device_bfs import DeviceBFS
+            from ..engine.paged_bfs import PagedBFS
             ckpt_dir = args.checkpointdir or (
                 os.path.splitext(args.spec)[0] + ".ckpt")
-            eng = DeviceBFS(spec)
+            # temporal properties need the behavior graph: run the
+            # safety BFS through the paged engine with level retention
+            # so the device graph builder reuses the enumeration
+            # instead of re-running it
+            want_graph = bool(spec.temporal_props) and \
+                not spec.symmetry_perms
+            if want_graph:
+                eng = PagedBFS(spec, retain_levels=True)
+            else:
+                eng = (PagedBFS if engine == "paged" else DeviceBFS)(spec)
             res = eng.run(
                 max_states=args.maxstates, max_seconds=args.maxseconds,
                 check_deadlock=args.deadlock, log=log,
@@ -159,7 +176,22 @@ def main(argv=None):
             from ..engine.liveness import liveness_check
             log(f"checking temporal properties: "
                 f"{', '.join(spec.temporal_props)}")
-            lres = liveness_check(spec, max_states=args.maxstates, log=log)
+            graph = None
+            if engine in ("device", "paged") and not spec.symmetry_perms:
+                # device-built behavior graph (round-3 fix: the CLI
+                # used the interpreter graph even for device runs,
+                # which cannot terminate beyond toy constants), reusing
+                # the safety BFS's retained level blocks.  A resumed
+                # run's blocks only cover post-resume levels, so the
+                # graph re-enumerates from scratch in that case.
+                from ..engine.device_liveness import DeviceGraph
+                if args.recover:
+                    graph = DeviceGraph(spec, log=log)
+                else:
+                    graph = DeviceGraph(spec, engine=eng, result=res,
+                                        log=log)
+            lres = liveness_check(spec, max_states=args.maxstates,
+                                  log=log, graph=graph)
             summary["properties_ok"] = lres.ok
             if not lres.ok:
                 res.ok = False

@@ -56,7 +56,7 @@ def spec_digest(spec) -> str:
 def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
-                    elapsed, digest=None):
+                    elapsed, digest=None, extra=None):
     """Write a complete engine snapshot to `path` (atomic).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
@@ -89,6 +89,9 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         "expand_mults": [int(x) for x in expand_mults],
         "elapsed": float(elapsed),
         "spec_digest": digest,
+        # engine-specific payload (e.g. the sharded driver's per-shard
+        # frontier counts and exchange capacities)
+        "extra": extra,
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
@@ -150,4 +153,5 @@ def load_checkpoint(path, expect_digest=None):
         "max_msgs": manifest["max_msgs"],
         "expand_mults": manifest["expand_mults"],
         "elapsed": manifest["elapsed"],
+        "extra": manifest.get("extra"),
     }

@@ -360,6 +360,9 @@ class DeviceBFS:
             from .checkpoint import load_checkpoint, spec_digest
             ck = load_checkpoint(resume_from,
                                  expect_digest=spec_digest(spec))
+            if (ck.get("extra") or {}).get("sharded"):
+                raise TLAError("checkpoint was written by the sharded "
+                               "engine; resume it there")
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
                     list(ck["expand_mults"]) != list(self.expand_mults):
                 self.expand_mults = list(ck["expand_mults"])

@@ -222,11 +222,21 @@ def build_graph(spec: SpecModel, max_states=None):
 
 def liveness_check(spec: SpecModel, max_states=None,
                    log=None, graph=None) -> LivenessResult:
+    """`graph` may be the interpreter-built (states, edges, inits)
+    triple from build_graph, or a device-built
+    engine.device_liveness.DeviceGraph (same attributes, lazy state
+    decode, batched predicate evaluation)."""
     res = LivenessResult()
     t0 = time.time()
+    dev_graph = None
     try:
-        states, edges, inits = graph if graph is not None \
-            else _build_graph(spec, max_states)
+        if graph is None:
+            states, edges, inits = _build_graph(spec, max_states)
+        elif hasattr(graph, "batch_predicate"):
+            dev_graph = graph
+            states, edges, inits = graph.states, graph.edges, graph.inits
+        else:
+            states, edges, inits = graph
     except TLAError as e:
         res.ok = False
         res.error = str(e)
@@ -246,24 +256,40 @@ def liveness_check(spec: SpecModel, max_states=None,
             if tid != sid:
                 enabled[sid].add(aname)
 
+    def batch_values(expr, env):
+        """[n] device-batched bools, or None if the leaf has no
+        compiled predicate kernel / has quantifier bindings."""
+        if dev_graph is not None and expr[0] == "id" and env.is_empty():
+            vals = dev_graph.batch_predicate(expr[1])
+            if vals is not None:
+                return [bool(v) for v in vals]
+        return None
+
+    def pred_values(expr, env):
+        vals = batch_values(expr, env)
+        if vals is not None:
+            return vals
+        return [_eval_pred(spec, expr, env, states[sid])
+                for sid in range(n)]
+
     for prop_name in spec.temporal_props:
         for kind, p_expr, q_expr, env in _collect_props(spec, prop_name):
             if kind == "gf":
                 # violation automaton: jump to phase 1 on ~P, stay on ~P
-                def bad_here(sid):
-                    return not _eval_pred(spec, p_expr, env, states[sid])
+                bad = [not v for v in pred_values(p_expr, env)]
+                seed = bad
             else:
                 # P ~> Q: phase-1 condition is ~Q; the jump additionally
-                # requires P at the jump state (checked when seeding)
-                def bad_here(sid):
-                    return not _eval_pred(spec, q_expr, env, states[sid])
-            bad = [bad_here(sid) for sid in range(n)]
-            if kind == "leadsto":
-                seed = [bad[sid]
-                        and _eval_pred(spec, p_expr, env, states[sid])
-                        for sid in range(n)]
-            else:
-                seed = bad
+                # requires P at the jump state — P is evaluated only
+                # where ~Q holds unless a device batch is available
+                bad = [not v for v in pred_values(q_expr, env)]
+                pv = batch_values(p_expr, env)
+                if pv is not None:
+                    seed = [bad[sid] and pv[sid] for sid in range(n)]
+                else:
+                    seed = [bad[sid]
+                            and _eval_pred(spec, p_expr, env, states[sid])
+                            for sid in range(n)]
 
             # phase-1 subgraph: states with bad=True, edges bad->bad
             # (+ implicit stutter self-loops).  A fair cycle inside it

@@ -1,0 +1,419 @@
+"""Host-paged BFS engine: the frontier spill tier for defect-scale runs.
+
+The reference's flagship run — exhaustive BFS of VSR.tla at the
+defect-repro constants — drove TLC to >=500 GB of disk, nearly all of
+it queue/state storage, not fingerprints
+(/root/reference/README.md:20; CAPACITY.md).  On a TPU the same wall
+hits sooner: at ~7 KiB per dense state one chip's spare HBM holds
+under a million frontier states, while a defect-scale BFS level can
+exceed that by orders of magnitude.  This engine keeps ONLY the
+fingerprint set resident in device memory (not the binding constraint:
+16 GB of HBM holds ~800 M fingerprint slots) and pages the frontier
+through the device in fixed-size chunks:
+
+  host frontier (numpy; the 125 GB host holds ~17 M dense states)
+      --chunk in-->  device chunk buffer [chunk_tiles x tile states]
+      --level kernel (DeviceBFS._make_level, unchanged)-->
+      next-frontier buffer fills --> DRAIN to host, reset, continue
+
+The drain reuses the level kernel's existing pause protocol: the
+headroom check that raised R_NEXT_GROW in the resident engine (grow
+the buffer in HBM) here means "spill what you have" — the paused tile
+has committed nothing, so the host copies the nn valid rows out,
+zeroes the counter, and re-enters at the same tile.  Transfers are
+sequential block copies proportional to bytes/state x generated/s
+(CAPACITY.md mitigation 1).
+
+Everything else — fingerprinting, invariant evaluation, growth of the
+message table / FPSet / per-action expand buffers, violation handling,
+deadlock detection, trace replay — is inherited from DeviceBFS; the
+two engines run the SAME jitted level pass, so paged results match
+resident results exactly (asserted in tests/test_paged.py).
+
+Checkpoint/resume reuses the level-boundary snapshot format of
+engine/checkpoint.py (the frontier is already host-side here, making
+snapshots cheap).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.values import TLAError
+from .bfs import CheckResult
+from .device_bfs import (DeviceBFS, I32, R_BAG_GROW, R_DEADLOCK,
+                         R_EXPAND_GROW, R_FPSET_GROW, R_NEXT_GROW,
+                         R_SLOT_ERR, R_VIOLATION, RUNNING)
+from .fpset import empty_table, grow, insert_batch
+
+
+class PagedBFS(DeviceBFS):
+    """DeviceBFS with a host-RAM frontier paged through the device.
+
+    With ``retain_levels=True`` every expanded frontier level's host
+    block is kept on ``self.level_blocks`` (gid order) — the state
+    enumeration pass the device liveness graph builder reuses
+    (engine/device_liveness.py)."""
+
+    def __init__(self, *args, retain_levels=False, **kwargs):
+        self.retain_levels = retain_levels
+        self.level_blocks = []
+        super().__init__(*args, **kwargs)
+
+    # -- host-side helpers ---------------------------------------------
+    def _host_zero(self, n):
+        zero = self.codec.zero_state()
+        return {k: np.zeros((n,) + np.shape(v), np.int32)
+                for k, v in zero.items()}
+
+    def _chunk_cap(self):
+        return self.chunk_tiles * self.tile
+
+    def _total_E(self):
+        T = self.tile
+        return sum(min(T * self.kern._lane_count(nm),
+                       max(64, T * self.expand_mults[a]))
+                   for a, nm in enumerate(self.kern.action_names))
+
+    def _pad_init_dense(self, old):
+        for i, d in enumerate(self._init_dense):
+            padded = self.codec.pad_msgs(
+                {k: np.asarray(v)[None] for k, v in d.items()}, old)
+            self._init_dense[i] = {k: v[0] for k, v in padded.items()}
+
+    def run(self, max_states=None, max_depth=None, max_seconds=None,
+            check_deadlock=False, log=None, progress_every=10.0,
+            checkpoint_path=None, checkpoint_every=None,
+            resume_from=None) -> CheckResult:
+        spec = self.spec
+        res = CheckResult()
+        t0 = time.time()
+
+        def emit(msg):
+            if log:
+                log(msg)
+
+        self.spill_count = 0     # drains triggered by a full buffer
+        self.spill_rows = 0      # total rows paged out to host
+        self.level_blocks = []   # fresh per run (retain_levels)
+
+        if resume_from is not None:
+            from .checkpoint import load_checkpoint, spec_digest
+            ck = load_checkpoint(resume_from,
+                                 expect_digest=spec_digest(spec))
+            if (ck.get("extra") or {}).get("sharded"):
+                raise TLAError("checkpoint was written by the sharded "
+                               "engine; resume it there")
+            if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
+                    list(ck["expand_mults"]) != list(self.expand_mults):
+                self.expand_mults = list(ck["expand_mults"])
+                self._build(ck["max_msgs"])
+            table = {"slots": jnp.asarray(ck["slots"])}
+            fp_cap = int(ck["slots"].shape[0])
+            self._init_dense = ck["init_dense"]
+            self._init_states = [self.codec.decode(d)
+                                 for d in ck["init_dense"]]
+            self._h_parent = [ck["h_parent"]]
+            self._h_action = [ck["h_action"]]
+            self._h_param = [ck["h_param"]]
+            self.level_sizes = list(ck["level_sizes"])
+            depth = ck["depth"]
+            fp_count = ck["fp_count"]
+            res.states_generated = ck["states_generated"]
+            t0 -= ck["elapsed"]
+            n_front = ck["n_front"]
+            host_front = {k: np.asarray(v)
+                          for k, v in ck["frontier"].items()}
+            level_base = sum(self.level_sizes[:-1])
+            emit(f"resumed from {resume_from}: depth {depth}, "
+                 f"{fp_count} distinct, frontier {n_front}")
+        else:
+            fp_cap = self.fpset_capacity
+            table = empty_table(fp_cap)
+            init_states = list(spec.init_states())
+            init_dense = [self.codec.encode(st) for st in init_states]
+            init_batch = {k: np.stack([d[k] for d in init_dense])
+                          for k in init_dense[0]}
+            fps = np.asarray(self.kern.fingerprint_batch(init_batch))
+            keep, seen = [], set()
+            for i in range(len(init_dense)):
+                key = tuple(fps[i])
+                if key not in seen:
+                    seen.add(key)
+                    keep.append(i)
+            init_batch = {k: v[keep] for k, v in init_batch.items()}
+            self._init_states = [init_states[i] for i in keep]
+            self._init_dense = [init_dense[i] for i in keep]
+            n0 = len(keep)
+            table, _, _ = insert_batch(
+                table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+            fp_count = n0
+            self._h_parent = [np.full(n0, -1, np.int64)]
+            self._h_action = [np.full(n0, -1, np.int32)]
+            self._h_param = [np.zeros(n0, np.int32)]
+            for i in range(n0):
+                bad = spec.check_invariants(self._init_states[i])
+                if bad:
+                    res.ok = False
+                    res.violated_invariant = bad
+                    res.trace = self._trace(i)
+                    return self._finish(res, t0, 0, fp_count)
+            res.states_generated += len(init_dense)
+            host_front = {k: init_batch[k][:n0].astype(np.int32)
+                          for k in init_batch}
+            n_front = n0
+            level_base = 0
+            depth = 0
+            self.level_sizes = [n0]
+
+        last_progress = time.time()
+        last_checkpoint = time.time()
+        dev_chunk = None        # allocated lazily; realloc on bag growth
+        # the level kernel refuses to commit a tile unless the next
+        # buffer has total_E rows of headroom, so total_E + one tile's
+        # worth is the functional floor; size it larger (the default
+        # next_capacity) to keep drains block-sized rather than
+        # per-tile.  Floored AFTER any resume rebuild (expand_mults /
+        # max_msgs from the checkpoint can enlarge total_E) and
+        # re-floored on every in-run rebuild — a stale floor live-locks
+        # the drain loop (commit never true with an empty buffer).
+        self.next_cap = max(self.next_cap, self._total_E() + self.tile)
+        bufs = self._alloc_bufs(self.next_cap)
+        stop = None
+
+        while n_front > 0 and stop is None:
+            if max_depth is not None and depth >= max_depth:
+                res.error = f"depth limit {max_depth} reached"
+                break
+            if self.retain_levels:
+                self.level_blocks.append(host_front)
+            depth += 1
+            # per-level host accumulators for drained next states and
+            # their (level-relative) trace pointers
+            drained = []
+            d_par, d_act, d_prm = [], [], []
+            n_next_total = 0
+            chunk_start = 0
+            n_c = 0
+            n_next = 0
+
+            def drain():
+                """Page the first n_next rows of the next buffers out to
+                host RAM and reset the counter."""
+                nonlocal n_next_total, n_next
+                if n_next == 0:
+                    return
+                nb, nbp, nba, nbprm = bufs
+                rows, par, act, prm = jax.device_get(
+                    ({k: v[:n_next] for k, v in nb.items()},
+                     nbp[:n_next], nba[:n_next], nbprm[:n_next]))
+                drained.append({k: np.asarray(v) for k, v in rows.items()})
+                # par is chunk-relative; lift to level-relative now
+                d_par.append(np.asarray(par, np.int64) + chunk_start)
+                d_act.append(np.asarray(act))
+                d_prm.append(np.asarray(prm))
+                n_next_total += n_next
+                self.spill_rows += n_next
+                n_next = 0
+
+            def put_chunk():
+                nonlocal dev_chunk
+                cc = self._chunk_cap()
+                if dev_chunk is None:
+                    dev_chunk = {
+                        k: jnp.zeros((cc,) + np.shape(v), np.int32)
+                        for k, v in self.codec.zero_state().items()}
+                dev_chunk = {
+                    k: dev_chunk[k].at[:n_c].set(
+                        host_front[k][chunk_start:chunk_start + n_c])
+                    for k in dev_chunk}
+
+            while chunk_start < n_front and stop is None:
+                n_c = min(self._chunk_cap(), n_front - chunk_start)
+                put_chunk()
+                n_tiles_c = (n_c + self.tile - 1) // self.tile
+                start_t = 0
+                while start_t < n_tiles_c and stop is None:
+                    nb, nbp, nba, nbprm = bufs
+                    out = self._level(
+                        table["slots"], dev_chunk,
+                        jnp.asarray(n_c, I32), jnp.asarray(start_t, I32),
+                        nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
+                        jnp.asarray(bool(check_deadlock)))
+                    table = {"slots": out["slots"]}
+                    bufs = (out["nb"], out["nbp"], out["nba"],
+                            out["nbprm"])
+                    reason, start_t, n_next = (int(out["reason"]),
+                                               int(out["t"]),
+                                               int(out["nn"]))
+                    res.states_generated += int(out["gen"])
+                    fp_count += int(out["dist"])
+
+                    if reason == RUNNING:
+                        pass
+                    elif reason == R_VIOLATION:
+                        vp, va, vprm = (int(v)
+                                        for v in np.asarray(out["viol"]))
+                        gid = level_base + chunk_start + vp
+                        parent_dense = {
+                            k: host_front[k][chunk_start + vp]
+                            for k in host_front}
+                        vstate = self._materialize_one(
+                            parent_dense, va, vprm)
+                        bad = spec.check_invariants(
+                            self.codec.decode(vstate))
+                        if bad is None:
+                            raise TLAError(
+                                "device/interpreter divergence: device "
+                                "invariant kernel reported a violation "
+                                "the interpreter accepts (parent gid "
+                                f"{gid}, action "
+                                f"{self.kern.action_names[va]})")
+                        res.ok = False
+                        res.violated_invariant = bad
+                        res.trace = self._trace(gid, extra=(va, vprm))
+                        res.diameter = depth
+                        return self._finish(res, t0, depth, fp_count)
+                    elif reason == R_NEXT_GROW:
+                        # the spill tier: page the filled buffer out to
+                        # host RAM instead of growing it in HBM
+                        self.spill_count += 1
+                        drain()
+                    elif reason == R_BAG_GROW:
+                        old = self.codec.shape.MAX_MSGS
+                        drain()
+                        self._build(old * 2)
+                        host_front = self.codec.pad_msgs(host_front, old)
+                        drained = [self.codec.pad_msgs(d, old)
+                                   for d in drained]
+                        self.level_blocks = [
+                            self.codec.pad_msgs(b, old)
+                            for b in self.level_blocks]
+                        self._pad_init_dense(old)
+                        dev_chunk = None
+                        self.next_cap = max(
+                            self.next_cap, self._total_E() + self.tile)
+                        bufs = self._alloc_bufs(self.next_cap)
+                        put_chunk()     # same chunk, re-enter at start_t
+                        emit(f"message table grown to "
+                             f"{self.codec.shape.MAX_MSGS} slots "
+                             f"(recompiling)")
+                    elif reason == R_FPSET_GROW:
+                        table = grow(table)
+                        fp_cap *= 4
+                        emit(f"FPSet grown to {fp_cap} slots")
+                    elif reason == R_EXPAND_GROW:
+                        aid = int(out["grow_aid"])
+                        self.expand_mults[aid] *= 2
+                        self._level = jax.jit(
+                            self._make_level(),
+                            donate_argnums=(0, 4, 5, 6, 7))
+                        if self.next_cap < self._total_E() + self.tile:
+                            drain()
+                            self.next_cap = self._total_E() + self.tile
+                            bufs = self._alloc_bufs(self.next_cap)
+                        emit(f"expand buffer for "
+                             f"{self.kern.action_names[aid]} grown to "
+                             f"tile x {self.expand_mults[aid]} "
+                             f"(recompiling)")
+                    elif reason == R_SLOT_ERR:
+                        raise TLAError(
+                            "dense-layout slot collision (a second DVC "
+                            "or recovery response from one source in "
+                            "one view): this restart-era interleaving "
+                            "needs the multi-slot layout (vsr.py "
+                            "docstring)")
+                    elif reason == R_DEADLOCK:
+                        di = int(out["dead"])
+                        gid = level_base + chunk_start + di
+                        res.ok = False
+                        res.error = "deadlock"
+                        res.deadlock_state = self.codec.decode(
+                            {k: host_front[k][chunk_start + di]
+                             for k in host_front})
+                        res.trace = self._trace(gid)
+                        res.diameter = depth
+                        return self._finish(res, t0, depth, fp_count)
+
+                    now = time.time()
+                    if now - last_progress >= progress_every:
+                        last_progress = now
+                        emit(f"depth {depth}: {fp_count} distinct, "
+                             f"{res.states_generated} generated, "
+                             f"{res.states_generated / (now - t0):.0f} "
+                             f"gen/s, "
+                             f"{fp_count / (now - t0):.0f} distinct/s, "
+                             f"frontier {n_front} (host-paged)")
+                    if max_seconds and now - t0 > max_seconds:
+                        stop = f"time budget {max_seconds}s reached"
+                # chunk done (or stopped): spill whatever accumulated
+                drain()
+                chunk_start += n_c
+
+            # ---- level complete: assemble next frontier on host ------
+            if n_next_total:
+                host_next = {
+                    k: np.concatenate([d[k] for d in drained])
+                    for k in host_front}
+                self._h_parent.append(
+                    np.concatenate(d_par) + level_base)
+                self._h_action.append(np.concatenate(d_act))
+                self._h_param.append(np.concatenate(d_prm))
+                self.level_sizes.append(n_next_total)
+            else:
+                host_next = self._host_zero(0)
+            level_base += n_front
+            host_front = host_next
+            n_front = n_next_total
+
+            if stop:
+                res.error = stop
+                break
+            if checkpoint_path and n_front and (
+                    checkpoint_every is None
+                    or time.time() - last_checkpoint >= checkpoint_every):
+                from .checkpoint import save_checkpoint, spec_digest
+                save_checkpoint(
+                    checkpoint_path,
+                    slots=table["slots"], frontier=host_front,
+                    n_front=n_front,
+                    h_parent=np.concatenate(self._h_parent),
+                    h_action=np.concatenate(self._h_action),
+                    h_param=np.concatenate(self._h_param),
+                    init_dense=self._init_dense,
+                    level_sizes=self.level_sizes, depth=depth,
+                    fp_count=fp_count,
+                    states_generated=res.states_generated,
+                    max_msgs=self.codec.shape.MAX_MSGS,
+                    expand_mults=self.expand_mults,
+                    elapsed=time.time() - t0,
+                    digest=spec_digest(spec))
+                last_checkpoint = time.time()
+                emit(f"checkpoint written to {checkpoint_path} "
+                     f"(depth {depth}, {fp_count} distinct)")
+            if n_front == 0:
+                break
+            if max_states and fp_count >= max_states:
+                res.error = f"state limit {max_states} reached"
+                break
+            if fp_count > 0.5 * fp_cap:
+                table = grow(table)
+                fp_cap *= 4
+                emit(f"FPSet grown to {fp_cap} slots")
+
+        res.diameter = depth
+        return self._finish(res, t0, depth, fp_count)
+
+
+def paged_bfs_check(spec, max_states=None, max_depth=None,
+                    check_deadlock=False, tile_size=128, max_msgs=None,
+                    chunk_tiles=64, log=None) -> CheckResult:
+    eng = PagedBFS(spec, max_msgs=max_msgs, tile_size=tile_size,
+                   chunk_tiles=chunk_tiles)
+    return eng.run(max_states=max_states, max_depth=max_depth,
+                   check_deadlock=check_deadlock, log=log)

@@ -70,6 +70,10 @@ class Env:
     def extend(self, mapping):
         return Env(mapping, self)
 
+    def is_empty(self):
+        return not self.mapping and (
+            self.parent is None or self.parent.is_empty())
+
 
 _MISSING = object()
 EMPTY_ENV = Env()
