@@ -18,11 +18,14 @@ pytestmark = requires_reference
 
 ANALYSIS = f"{REFERENCE}/analysis"
 
+# (stem, n_actions, exact distinct/generated at the 500-state bound —
+# the bounded-run counts are deterministic, so they are pinned exactly
+# rather than as >= thresholds)
 CFG_PAIRS = [
-    ("01-view-changes/VR_ASSUME_NEWVIEWCHANGE", 13),
-    ("01-view-changes/VR_INC_RESEND", 14),
-    ("03-state-transfer/VR_STATE_TRANSFER", 16),
-    ("04-application-state/VR_APP_STATE", 16),
+    ("01-view-changes/VR_ASSUME_NEWVIEWCHANGE", 13, 501, 884),
+    ("01-view-changes/VR_INC_RESEND", 14, 501, 942),
+    ("03-state-transfer/VR_STATE_TRANSFER", 16, 501, 842),
+    ("04-application-state/VR_APP_STATE", 16, 501, 838),
 ]
 
 _COMMON = """
@@ -82,27 +85,35 @@ CommitNumberMatchesAppState
 """
 
 
-@pytest.mark.parametrize("stem,n_actions", CFG_PAIRS)
-def test_analysis_spec_checks_with_shipped_cfg(stem, n_actions):
+@pytest.mark.parametrize("stem,n_actions,distinct,generated", CFG_PAIRS)
+def test_analysis_spec_checks_with_shipped_cfg(stem, n_actions,
+                                               distinct, generated):
     spec = load_spec(f"{ANALYSIS}/{stem}.tla", f"{ANALYSIS}/{stem}.cfg")
     assert len(spec.actions) == n_actions
     res = bfs_check(spec, max_states=500)
     assert res.ok, (res.violated_invariant, res.error)
-    assert res.distinct_states >= 500
+    assert res.distinct_states == distinct
+    assert res.states_generated == generated
 
 
-@pytest.mark.parametrize("stem,cfg_text,n_actions", [
-    ("05-replica-recovery/VR_REPLICA_RECOVERY", RECOVERY_CFG, 21),
-    ("05-replica-recovery/VR_REPLICA_RECOVERY_ASYNC_LOG", RECOVERY_CFG, 20),
-    ("06-replica-recovery-cp/VR_REPLICA_RECOVERY_CP", CP_CFG, 22),
+@pytest.mark.parametrize("stem,cfg_text,n_actions,distinct,generated", [
+    ("05-replica-recovery/VR_REPLICA_RECOVERY", RECOVERY_CFG, 21,
+     400, 640),
+    ("05-replica-recovery/VR_REPLICA_RECOVERY_ASYNC_LOG", RECOVERY_CFG,
+     20, 400, 632),
+    ("06-replica-recovery-cp/VR_REPLICA_RECOVERY_CP", CP_CFG, 22,
+     400, 677),
 ])
-def test_recovery_spec_checks_with_synthesized_cfg(stem, cfg_text, n_actions):
+def test_recovery_spec_checks_with_synthesized_cfg(stem, cfg_text,
+                                                   n_actions, distinct,
+                                                   generated):
     mod = parse_module_file(f"{ANALYSIS}/{stem}.tla")
     spec = SpecModel(mod, parse_cfg_text(cfg_text))
     assert len(spec.actions) == n_actions
     res = bfs_check(spec, max_states=400)
     assert res.ok, (res.violated_invariant, res.error)
-    assert res.distinct_states >= 400
+    assert res.distinct_states == distinct
+    assert res.states_generated == generated
 
 
 # ---------------------------------------------------------------------

@@ -24,7 +24,7 @@ import numpy as np
 from ..core.values import FnVal
 from .rr05 import M_RECOVERY, M_RECOVERYRESP, RR05Codec
 from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_OP, H_SRC, H_TYPE,
-                  H_VIEW, H_X, NHDR)
+                  H_VIEW, H_X)
 
 
 class AL05Codec(RR05Codec):
@@ -72,7 +72,7 @@ class AL05Codec(RR05Codec):
         t = self.mtype_id[m.apply("type")]
         if t not in (M_RECOVERY, M_RECOVERYRESP):
             return super(RR05Codec, self).encode_msg_row(m)
-        hdr = np.zeros(NHDR, np.int32)
+        hdr = np.zeros(self.NHDR, np.int32)
         log = np.zeros(self.shape.MAX_OPS, np.int32)
         get = m.get
         hdr[H_TYPE] = t

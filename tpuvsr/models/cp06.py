@@ -30,8 +30,8 @@ import numpy as np
 from ..core.values import FnVal, TLAError
 from .rr05 import RR05Codec
 from .st03 import MSGTYPE_NAMES as ST03_MSGTYPE_NAMES
-from .vsr import (H_COMMIT, H_CP, H_DEST, H_FIRST, H_FLAG, H_OP, H_SRC,
-                  H_TYPE, H_VIEW, H_X, NHDR)
+from .vsr import (CP_NHDR, H_COMMIT, H_CP, H_DEST, H_FIRST, H_FLAG, H_OP, H_SRC,
+                  H_TYPE, H_VIEW, H_X)
 
 M_RECOVERY, M_RECOVERYRESP = 8, 9          # same codes as RR05/AL05
 M_GETCP, M_NEWCP = 10, 11
@@ -46,6 +46,8 @@ CP_FORM_TYPES = (4, 5)          # M_DVC, M_SV always; others by flag
 
 
 class CP06Codec(RR05Codec):
+    NHDR = CP_NHDR       # + H_FLAG/H_CP columns (dual-mode replies)
+
     def __init__(self, constants, shape=None, max_msgs=None):
         super().__init__(constants, shape=shape, max_msgs=max_msgs)
         self.noop = constants["NoOp"]
@@ -161,7 +163,7 @@ class CP06Codec(RR05Codec):
 
     def encode_msg_row(self, m: FnVal):
         t = self.mtype_id[m.apply("type")]
-        hdr = np.zeros(NHDR, np.int32)
+        hdr = np.zeros(self.NHDR, np.int32)
         entry = 0
         log = np.zeros(self.shape.MAX_OPS, np.int32)
         cp = np.zeros(self.shape.MAX_OPS, np.int32)

@@ -107,8 +107,7 @@ class RR05Codec(AS04Codec):
         t = self.mtype_id[m.apply("type")]
         if t not in (M_RECOVERY, M_RECOVERYRESP):
             return super().encode_msg_row(m)
-        from .vsr import NHDR
-        hdr = np.zeros(NHDR, np.int32)
+        hdr = np.zeros(self.NHDR, np.int32)
         log = np.zeros(self.shape.MAX_OPS, np.int32)
         get = m.get
         hdr[H_TYPE] = t

@@ -81,6 +81,8 @@ class ST03Codec:
     """Host-side bridge between interpreter state dicts and the dense
     ST03 layout (same interface as vsr.VSRCodec)."""
 
+    NHDR = NHDR          # header columns (CP06Codec widens to CP_NHDR)
+
     def __init__(self, constants, shape: ST03Shape = None, max_msgs=None):
         self.constants = constants
         self.shape = shape or shape_from_cfg(constants, max_msgs=max_msgs)
@@ -112,7 +114,7 @@ class ST03Codec:
             "sent_dvc": z(s.R), "sent_sv": z(s.R),
             "no_prog": z(s.R), "np_ctr": z(),
             "m_present": z(s.MAX_MSGS), "m_count": z(s.MAX_MSGS),
-            "m_hdr": z(s.MAX_MSGS, NHDR),
+            "m_hdr": z(s.MAX_MSGS, self.NHDR),
             "m_entry": z(s.MAX_MSGS),
             "m_log": z(s.MAX_MSGS, s.MAX_OPS),
             "aux_svc": z(), "aux_acked": z(s.V),
@@ -158,7 +160,7 @@ class ST03Codec:
                            and dest is self.anydest) else dest
 
     def encode_msg_row(self, m: FnVal):
-        hdr = np.zeros(NHDR, np.int32)
+        hdr = np.zeros(self.NHDR, np.int32)
         entry = 0
         log = np.zeros(self.shape.MAX_OPS, np.int32)
         t = self.mtype_id[m.apply("type")]

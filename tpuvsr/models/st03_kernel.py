@@ -37,7 +37,7 @@ from .st03 import (ANYDEST, ERR_BAG_OVERFLOW, M_DVC, M_GETSTATE,
                    M_NEWSTATE, M_PREPARE, M_PREPAREOK, M_SV, M_SVC,
                    NORMAL, STATETRANSFER, VIEWCHANGE, ST03Codec)
 from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_LNV, H_OP, H_SRC, H_TYPE,
-                  H_VIEW, H_X, NHDR)
+                  H_VIEW, H_X)
 
 I32 = jnp.int32
 INF = np.int32(0x7FFFFFFF)
@@ -85,6 +85,7 @@ class ST03Kernel:
         self.shape = s = codec.shape
         self.R, self.V, self.M = s.R, s.V, s.MAX_MSGS
         self.MAX_OPS = s.MAX_OPS
+        self.NHDR = codec.NHDR
         if perms is None:
             perms = np.arange(s.V + 1, dtype=np.int32)[None, :]
         self.perms = np.asarray(perms, dtype=np.int32)
@@ -120,7 +121,7 @@ class ST03Kernel:
 
     def _nmsg(self):
         # hdr + entry + log + count
-        return NHDR + 1 + self.MAX_OPS + 1
+        return self.NHDR + 1 + self.MAX_OPS + 1
 
     def _rep_shape(self, k):
         s = self.shape
@@ -142,7 +143,7 @@ class ST03Kernel:
     # ==================================================================
     def _row(self, type_, view=0, op=0, commit=0, dest=0, src=0,
              first=0, lnv=0, entry=0, log=None, x=0):
-        hdr = jnp.zeros((NHDR,), I32)
+        hdr = jnp.zeros((self.NHDR,), I32)
         for col, v in ((H_TYPE, type_), (H_VIEW, view), (H_OP, op),
                        (H_COMMIT, commit), (H_DEST, dest), (H_SRC, src),
                        (H_FIRST, first), (H_LNV, lnv), (H_X, x)):

@@ -71,12 +71,16 @@ MSGTYPE_NAMES = {
     M_RECOVERYRESP: "RecoveryResponseMsg",
 }
 
-# Message header columns (hdr[M, NHDR]).  H_FLAG/H_CP exist for the
-# CP06 dual-mode replies (flag 0/1 + checkpoint number, CP06:404-431);
-# they stay zero for every other model.
+# Message header columns (hdr[M, NHDR]).  H_FLAG/H_CP exist only in
+# the CP06 layout (dual-mode replies: flag 0/1 + checkpoint number,
+# CP06:404-431); every other model's hdr plane stops at NHDR = 9
+# columns — the header width is a Codec class attribute (CP06Codec
+# overrides it to CP_NHDR) so the pre-checkpoint models don't pay two
+# always-zero hashed columns per slot (the r2->r3 bench regression).
 (H_TYPE, H_VIEW, H_OP, H_COMMIT, H_DEST, H_SRC, H_X, H_FIRST, H_LNV,
  H_FLAG, H_CP) = range(11)
-NHDR = 11
+NHDR = 9
+CP_NHDR = 11
 
 # Log-entry columns (LogEntryType, VSR.tla:157-161)
 E_VIEW, E_OPER, E_CLIENT, E_REQ = range(4)
@@ -144,6 +148,8 @@ class VSRCodec:
     that hold the kernel to the interpreter oracle.
     """
 
+    NHDR = NHDR          # header columns (CP06Codec widens to CP_NHDR)
+
     def __init__(self, constants, shape: VSRShape = None, max_msgs=None):
         self.constants = constants
         self.shape = shape or shape_from_cfg(constants, max_msgs=max_msgs)
@@ -187,7 +193,7 @@ class VSRCodec:
             "rec_log_len": z(s.R, s.R),
             "rec_op": z(s.R, s.R), "rec_commit": z(s.R, s.R),
             "m_present": z(s.MAX_MSGS), "m_count": z(s.MAX_MSGS),
-            "m_hdr": z(s.MAX_MSGS, NHDR),
+            "m_hdr": z(s.MAX_MSGS, self.NHDR),
             "m_entry": z(s.MAX_MSGS, NENT),
             "m_log": z(s.MAX_MSGS, s.MAX_OPS, NENT),
             "m_log_len": z(s.MAX_MSGS), "m_has_log": z(s.MAX_MSGS),
@@ -237,7 +243,7 @@ class VSRCodec:
     def encode_msg_row(self, m: FnVal):
         """One bag-domain record -> dense row pieces (hdr, entry, log,
         log_len, has_log)."""
-        hdr = np.zeros(NHDR, np.int32)
+        hdr = np.zeros(self.NHDR, np.int32)
         entry = np.zeros(NENT, np.int32)
         log = np.zeros((self.shape.MAX_OPS, NENT), np.int32)
         log_len = 0

@@ -65,7 +65,7 @@ from .vsr import (E_CLIENT, E_OPER, E_REQ, E_VIEW, ERR_BAG_OVERFLOW,
                   ERR_DVC_OVERFLOW, ERR_REC_OVERFLOW, H_COMMIT, H_DEST,
                   H_FIRST, H_LNV, H_OP, H_SRC, H_TYPE, H_VIEW, H_X,
                   M_DVC, M_GETSTATE, M_NEWSTATE, M_PREPARE, M_PREPAREOK,
-                  M_RECOVERY, M_RECOVERYRESP, M_SV, M_SVC, NENT, NHDR,
+                  M_RECOVERY, M_RECOVERYRESP, M_SV, M_SVC, NENT,
                   NORMAL, RECOVERING, T_EXEC, T_OP, T_REQ, VIEWCHANGE,
                   VSRCodec)
 
@@ -108,6 +108,7 @@ class VSRKernel:
         self.shape = s = codec.shape
         self.R, self.V, self.M = s.R, s.V, s.MAX_MSGS
         self.MAX_OPS = s.MAX_OPS
+        self.NHDR = codec.NHDR
         # value-id permutation table for symmetry canonicalization
         # ([P, V+1], row 0 of each perm maps padding 0 -> 0)
         if perms is None:
@@ -134,7 +135,7 @@ class VSRKernel:
         rng = np.random.default_rng(0xC0FFEE)
         nrep = 1 + sum(int(np.prod(self._rep_shape(k))) // s.R
                        for k in REP_KEYS)      # replica id + per-r slices
-        nmsg = NHDR + NENT + self.MAX_OPS * NENT + 3
+        nmsg = self.NHDR + NENT + self.MAX_OPS * NENT + 3
         self._k_rep = jnp.asarray(
             rng.integers(1, 2**32, size=(4, nrep), dtype=np.uint64)
             .astype(np.uint32) | 1)
@@ -177,7 +178,7 @@ class VSRKernel:
     def _row(self, type_, view=0, op=0, commit=0, dest=0, src=0, x=0,
              first=0, lnv=0, entry=None, log=None, log_len=0, has_log=0):
         z = jnp.zeros
-        hdr = z((NHDR,), I32).at[:9].set(
+        hdr = z((self.NHDR,), I32).at[:9].set(
             jnp.stack([jnp.asarray(v, I32) for v in
                        (type_, view, op, commit, dest, src, x, first,
                         lnv)]))
