@@ -8,7 +8,15 @@ walks are dominated by message-delivery lanes and essentially never
 thread the SendGetState truncation window.
 
 Usage: python scripts/defect_hunt.py [walkers] [depth] [max_seconds]
-       [seed] [swarm_sigma]
+       [seed] [swarm_sigma] [mode]
+
+Modes (the r4 ablation axis, VERDICT item 6):
+  uniform  — TLC's uniform-over-successors draw (no action weighting)
+  flat     — two-stage sampling, uniform over enabled ACTIONS (the
+             round-3 default: action_weights={} resolves to all-ones)
+  weighted — two-stage sampling with real weights biased toward the
+             defect path (SendGetState truncation + view changes)
+  guided   — weighted + importance splitting (hunt_score resampling)
 """
 
 import json
@@ -28,6 +36,31 @@ depth = int(sys.argv[2]) if len(sys.argv) > 2 else 48
 max_seconds = float(sys.argv[3]) if len(sys.argv) > 3 else 600
 seed = int(sys.argv[4]) if len(sys.argv) > 4 else 0
 sigma = float(sys.argv[5]) if len(sys.argv) > 5 else 1.0
+mode = sys.argv[6] if len(sys.argv) > 6 else os.environ.get(
+    "TPUVSR_HUNT_MODE",
+    "guided" if os.environ.get("TPUVSR_HUNT_GUIDED", "1") == "1"
+    else "flat")
+
+# Real action weights biased toward the defect path: the violation
+# needs view changes interleaved with the SendGetState truncation
+# (VSR.tla:491-516) and the final ReceiveSV log wipe (TRACE:554-577);
+# unlisted actions weigh 1.
+WEIGHTS = {
+    "TimerSendSVC": 3.0,
+    "SendGetState": 6.0,
+    "SendDVC": 2.0,
+    "SendSV": 2.0,
+    "ReceiveSV": 2.0,
+    "ReceiveClientRequest": 2.0,
+}
+
+MODES = {
+    "uniform": dict(action_weights=None, guided=False, swarm=0.0),
+    "flat": dict(action_weights={}, guided=False, swarm=sigma),
+    "weighted": dict(action_weights=WEIGHTS, guided=False, swarm=sigma),
+    "guided": dict(action_weights=WEIGHTS, guided=True, swarm=sigma),
+}
+mcfg = MODES[mode]
 
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
@@ -44,12 +77,12 @@ spec = SpecModel(mod, cfg)
 import jax
 print(f"backend: {jax.default_backend()}", file=sys.stderr)
 
-guided = os.environ.get("TPUVSR_HUNT_GUIDED", "1") == "1"
+guided = mcfg["guided"]
 t0 = time.time()
 sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=8, max_msgs=48,
-                      action_weights={}, swarm_sigma=sigma,
-                      guided=guided)
-print(f"build: {time.time()-t0:.1f}s guided={guided} "
+                      action_weights=mcfg["action_weights"],
+                      swarm_sigma=mcfg["swarm"], guided=guided)
+print(f"build: {time.time()-t0:.1f}s mode={mode} "
       f"(compile on first chunk)", file=sys.stderr, flush=True)
 
 t0 = time.time()
@@ -70,7 +103,8 @@ if res.trace:
     result = {"time_to_violation_s": round(ttv, 1),
               "violated": res.violated_invariant,
               "walkers": walkers, "depth": depth, "seed": seed,
-              "swarm_sigma": sigma, "guided": guided,
+              "swarm_sigma": mcfg["swarm"], "guided": guided,
+              "mode": mode,
               "walks": res.walks, "steps": res.steps,
               "trace_len": len(res.trace),
               "final_action": res.trace[-1].action_name,

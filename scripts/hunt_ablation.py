@@ -1,0 +1,69 @@
+"""Hunt ablation (VERDICT r3 item 6): measure time-to-violation of the
+state-transfer defect for each sampling mode on fixed seeds.
+
+Modes: uniform (TLC's uniform-over-successors), flat (two-stage,
+action-uniform — the round-3 default), weighted (real defect-path
+weights), guided (weighted + importance splitting).  Each (mode, seed)
+runs scripts/defect_hunt.py in a subprocess with a shared wall-clock
+budget; a run that ends without a violation records a timeout at the
+budget.  Results append to scripts/hunt_ablation.json after every run
+so a killed sweep keeps its finished rows.
+
+Usage: python scripts/hunt_ablation.py [budget_s] [seeds] [walkers] [depth]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "scripts", "hunt_ablation.json")
+
+budget = float(sys.argv[1]) if len(sys.argv) > 1 else 1500.0
+seeds = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+walkers = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
+depth = int(sys.argv[4]) if len(sys.argv) > 4 else 40
+
+MODES = ["uniform", "flat", "weighted", "guided"]
+
+results = {"budget_s": budget, "walkers": walkers, "depth": depth,
+           "runs": []}
+if os.path.exists(OUT):
+    with open(OUT) as f:
+        results = json.load(f)
+
+done = {(r["mode"], r["seed"]) for r in results["runs"]}
+
+for mode in MODES:
+    for seed in range(1, seeds + 1):
+        if (mode, seed) in done:
+            continue
+        print(f"=== {mode} seed {seed}", flush=True)
+        t0 = time.time()
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "defect_hunt.py"),
+             str(walkers), str(depth), str(budget), str(seed), "1.0",
+             mode],
+            capture_output=True, text=True,
+            timeout=budget * 2 + 3600)
+        row = {"mode": mode, "seed": seed,
+               "elapsed_s": round(time.time() - t0, 1)}
+        hit = None
+        for line in p.stdout.splitlines():
+            if line.startswith("{") and "time_to_violation_s" in line:
+                hit = json.loads(line)
+        if hit:
+            row.update(time_to_violation_s=hit["time_to_violation_s"],
+                       steps=hit["steps"], walks=hit["walks"],
+                       trace_len=hit["trace_len"], violated=True)
+        else:
+            row.update(time_to_violation_s=None, violated=False,
+                       note=f"no violation within {budget}s budget")
+        results["runs"].append(row)
+        print(f"  -> {row}", flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+print("done")

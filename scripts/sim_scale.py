@@ -62,6 +62,8 @@ out = {
     "ok": res.ok,
     "violated": res.violated_invariant,
     "backend": backend,
+    "dispatch": sim.dispatch,
+    "group_caps": list(sim.group_caps),
 }
 print(json.dumps(out))
 with open(os.path.join(REPO, "scripts", "sim_scale.json"), "w") as f:
