@@ -99,18 +99,23 @@ R_BAG_GROW = 3
 R_FPSET_GROW = 4
 R_NEXT_GROW = 5
 R_SLOT_ERR = 6
+R_DEADLOCK = 7
 R_BUCKET_GROW = 8
 
 
 def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
-                       tile: int, bucket_cap: int):
+                       tile: int, bucket_cap: int,
+                       check_deadlock: bool = False):
     """Build the jitted one-tile sharded BFS step.
 
     step(tables, frontier, n_front, nb, nbp, nba, nbprm, nn, base_gid)
       -> (tables, nb, nbp, nba, nbprm, nn, reason, viol, gen, dist,
           fatal)
     Every array is sharded over `axis`; scalars come back as [D] arrays
-    (one per device; identical where globally agreed)."""
+    (one per device; identical where globally agreed).  With
+    ``check_deadlock`` a frontier state with no enabled successor
+    pauses the level with R_DEADLOCK and its device-local row index in
+    the `dead` output (-1 on devices without a witness)."""
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
@@ -191,9 +196,16 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                     b_st[k] = b_st[k].at[d, idx].set(
                         flat[k][perm], mode="drop")
 
+            # deadlock: a valid frontier state with no enabled lane
+            dead_l = valid & ~en.any(axis=1) if check_deadlock else \
+                jnp.zeros((T,), bool)
+            dead_i = jnp.where(dead_l.any() & (c["dead"] < 0),
+                               base + jnp.argmax(dead_l), c["dead"]
+                               ).astype(jnp.int32)
+
             # global pre-exchange abort vote
-            flags = jnp.stack([viol_l.any(), bag_err, slot_err, ovf_b]
-                              ).astype(jnp.int32)
+            flags = jnp.stack([viol_l.any(), bag_err, slot_err, ovf_b,
+                               dead_l.any()]).astype(jnp.int32)
             gflags = jax.lax.psum(flags, axis) > 0
             abort_pre = gflags.any()
 
@@ -239,16 +251,18 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 jnp.where(gflags[2], R_SLOT_ERR,
                           jnp.where(gflags[1], R_BAG_GROW,
                                     jnp.where(gflags[3], R_BUCKET_GROW,
+                                              jnp.where(gflags[4],
+                                                        R_DEADLOCK,
                                               jnp.where(abort_room,
                                                         R_NEXT_GROW,
-                                                        RUNNING)))))
+                                                        RUNNING))))))
             reason = jnp.where((reason == RUNNING) & g_povf,
                                R_FPSET_GROW, reason)
             return {
                 "t": jnp.where(commit & ~g_povf, t + 1, t),
                 "reason": jnp.where(c["reason"] == RUNNING, reason,
                                     c["reason"]),
-                "viol": viol,
+                "viol": viol, "dead": dead_i,
                 "slots": slots2,
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": nn + jnp.where(commit, n_fresh, 0),
@@ -263,6 +277,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             "t": start_t[0],
             "reason": jnp.asarray(RUNNING, jnp.int32),
             "viol": jnp.full((3,), -1, jnp.int32),
+            "dead": jnp.asarray(-1, jnp.int32),
             "slots": tables["slots"],
             "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
             "nn": nn0[0],
@@ -274,13 +289,14 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
         return ({"slots": out["slots"][None]},
                 out["nb"], out["nbp"], out["nba"], out["nbprm"],
                 one(out["nn"]), one(out["t"]), one(out["reason"]),
-                out["viol"][None], one(out["gen"]), one(out["sent"]))
+                out["viol"][None], one(out["gen"]), one(out["sent"]),
+                one(out["dead"]))
 
     sp = P(axis)
     step = jax.jit(jax.shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 11,
+        out_specs=(sp,) * 12,
         check_vma=False))
     return step
 
@@ -296,7 +312,7 @@ class ShardedBFS:
 
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=512, next_capacity=1 << 12,
-                 fpset_capacity=1 << 14):
+                 fpset_capacity=1 << 14, check_deadlock=False):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
@@ -306,6 +322,7 @@ class ShardedBFS:
         self.N = next_capacity          # per-device frontier capacity
         self.fp_cap = fpset_capacity    # per-device FPSet slots
         self.inv_names = list(spec.cfg.invariants)
+        self._ckd = bool(check_deadlock)
         self._mat = {}
         self._build(max_msgs)
 
@@ -317,7 +334,8 @@ class ShardedBFS:
         self._mat = {}
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
                                         self.axis, self.tile,
-                                        self.bucket_cap)
+                                        self.bucket_cap,
+                                        check_deadlock=self._ckd)
         self._sh = NamedSharding(self.mesh, P(self.axis))
 
     # borrowed single-device helpers (same attribute contract)
@@ -356,7 +374,8 @@ class ShardedBFS:
         return self._put(out.reshape((D * new_cap,) + host.shape[2:]))
 
     def run(self, max_depth=None, max_states=None, max_seconds=None,
-            log=None) -> "CheckResult":
+            log=None, check_deadlock=None, checkpoint_path=None,
+            checkpoint_every=None, resume_from=None) -> "CheckResult":
         import time as _time
         from ..core.values import TLAError
         from ..engine.bfs import CheckResult
@@ -370,61 +389,10 @@ class ShardedBFS:
             if log:
                 log(msg)
 
-        tables = make_sharded_tables(self.mesh, self.axis, self.fp_cap)
+        if check_deadlock is not None and bool(check_deadlock) != self._ckd:
+            self._ckd = bool(check_deadlock)
+            self._build(self.codec.shape.MAX_MSGS)
         sharded_ins = make_sharded_insert(self.mesh, self.axis)
-
-        # --- init states: dedup, assign to owner devices --------------
-        init_states = list(spec.init_states())
-        dense = [codec.encode(st) for st in init_states]
-        batch = {k: np.stack([d[k] for d in dense]) for k in dense[0]}
-        fps = np.asarray(self.kern.fingerprint_batch(batch))
-        keep, seen = [], set()
-        for i in range(len(dense)):
-            t = tuple(fps[i])
-            if t not in seen:
-                seen.add(t)
-                keep.append(i)
-        owners = (np.asarray(route(jnp.asarray(fps[keep])))
-                  % np.uint32(D)).astype(int)
-        order = np.argsort(owners, kind="stable")
-        keep = [keep[i] for i in order]
-        owners = owners[order]
-        self._init_states = [init_states[i] for i in keep]
-        n0 = len(keep)
-        counts0 = np.bincount(owners, minlength=D)
-
-        F = self.N
-        front, _p0, _a0, _m0 = self._alloc_frontier(F)
-        self._dev_distinct = counts0.astype(np.int64).copy()
-        host_front = {k: np.array(v) for k, v in front.items()}
-        pos = 0
-        for d in range(D):
-            for j in range(int(counts0[d])):
-                row = dense[keep[pos]]
-                for k in host_front:
-                    host_front[k][d * F + j] = row[k]
-                pos += 1
-        front = {k: self._put(v) for k, v in host_front.items()}
-        n_front = self._put(counts0.astype(np.int32))
-        tables, _fr, ovf = sharded_ins(
-            tables, jnp.asarray(fps[keep]),
-            jnp.ones((n0,), bool))
-        assert not bool(np.asarray(ovf).any())
-        fp_count = n0
-
-        self._h_parent = [np.full(n0, -1, np.int64)]
-        self._h_action = [np.full(n0, -1, np.int32)]
-        self._h_param = [np.zeros(n0, np.int32)]
-        self.level_sizes = [n0]
-        base_dev = np.concatenate([[0], np.cumsum(counts0)[:-1]])
-        for i, st in enumerate(self._init_states):
-            bad = spec.check_invariants(st)
-            if bad:
-                res.ok = False
-                res.violated_invariant = bad
-                res.trace = self._trace(i)
-                return self._finish(res, t0, 0, fp_count)
-        res.states_generated += len(dense)
 
         # exchange metrics: useful rows shipped vs static wire volume
         # (all_to_all always moves full D x bucket_cap buckets).  Bytes
@@ -440,6 +408,119 @@ class ShardedBFS:
         exch_bytes_useful = 0
         exch_bytes_wire = 0
 
+        if resume_from is not None:
+            # --- resume from a level-boundary snapshot ----------------
+            from ..engine.checkpoint import load_checkpoint, spec_digest
+            ck = load_checkpoint(resume_from,
+                                 expect_digest=spec_digest(spec))
+            ex = ck["extra"] or {}
+            if not ex.get("sharded"):
+                raise TLAError("checkpoint was written by the "
+                               "single-device engine; resume it there")
+            if len(ex["shard_counts"]) != D:
+                raise TLAError(
+                    f"checkpoint has {len(ex['shard_counts'])} FPSet "
+                    f"shards, this mesh has {D}; refusing to resume")
+            if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
+                    ex["bucket_cap"] != self.bucket_cap:
+                self.bucket_cap = int(ex["bucket_cap"])
+                self._build(ck["max_msgs"])
+            slots = np.asarray(ck["slots"])
+            self.fp_cap = int(slots.shape[1])
+            tables = {"slots": self._put(slots)}
+            counts0 = np.asarray(ex["shard_counts"], np.int64)
+            self.N = max(self.N, int(counts0.max()))
+            codec = self.codec
+            self._init_states = [codec.decode(d)
+                                 for d in ck["init_dense"]]
+            self._h_parent = [ck["h_parent"]]
+            self._h_action = [ck["h_action"]]
+            self._h_param = [ck["h_param"]]
+            self.level_sizes = list(ck["level_sizes"])
+            depth0 = ck["depth"]
+            fp_count = ck["fp_count"]
+            res.states_generated = ck["states_generated"]
+            t0 -= ck["elapsed"]
+            self._dev_distinct = np.asarray(ex["dev_distinct"], np.int64)
+            xc = ex.get("exchange") or {}
+            exch_rows_useful = xc.get("useful_rows", 0)
+            exch_rows_wire = xc.get("wire_rows", 0)
+            exch_bytes_useful = xc.get("useful_bytes", 0)
+            exch_bytes_wire = xc.get("wire_bytes", 0)
+            F = self.N
+            front, _p0, _a0, _m0 = self._alloc_frontier(F)
+            host_front = {k: np.array(v) for k, v in front.items()}
+            rows = ck["frontier"]
+            pos = 0
+            for d in range(D):
+                for j in range(int(counts0[d])):
+                    for k in host_front:
+                        host_front[k][d * F + j] = rows[k][pos]
+                    pos += 1
+            front = {k: self._put(v) for k, v in host_front.items()}
+            n_front = self._put(counts0.astype(np.int32))
+            base_dev = (sum(self.level_sizes[:-1])
+                        + np.concatenate([[0], np.cumsum(counts0)[:-1]]))
+            emit(f"resumed from {resume_from}: depth {depth0}, "
+                 f"{fp_count} distinct, frontier {int(counts0.sum())}")
+        else:
+            tables = make_sharded_tables(self.mesh, self.axis,
+                                         self.fp_cap)
+
+            # --- init states: dedup, assign to owner devices ----------
+            init_states = list(spec.init_states())
+            dense = [codec.encode(st) for st in init_states]
+            batch = {k: np.stack([d[k] for d in dense]) for k in dense[0]}
+            fps = np.asarray(self.kern.fingerprint_batch(batch))
+            keep, seen = [], set()
+            for i in range(len(dense)):
+                t = tuple(fps[i])
+                if t not in seen:
+                    seen.add(t)
+                    keep.append(i)
+            owners = (np.asarray(route(jnp.asarray(fps[keep])))
+                      % np.uint32(D)).astype(int)
+            order = np.argsort(owners, kind="stable")
+            keep = [keep[i] for i in order]
+            owners = owners[order]
+            self._init_states = [init_states[i] for i in keep]
+            n0 = len(keep)
+            counts0 = np.bincount(owners, minlength=D)
+
+            F = self.N
+            front, _p0, _a0, _m0 = self._alloc_frontier(F)
+            self._dev_distinct = counts0.astype(np.int64).copy()
+            host_front = {k: np.array(v) for k, v in front.items()}
+            pos = 0
+            for d in range(D):
+                for j in range(int(counts0[d])):
+                    row = dense[keep[pos]]
+                    for k in host_front:
+                        host_front[k][d * F + j] = row[k]
+                    pos += 1
+            front = {k: self._put(v) for k, v in host_front.items()}
+            n_front = self._put(counts0.astype(np.int32))
+            tables, _fr, ovf = sharded_ins(
+                tables, jnp.asarray(fps[keep]),
+                jnp.ones((n0,), bool))
+            assert not bool(np.asarray(ovf).any())
+            fp_count = n0
+
+            self._h_parent = [np.full(n0, -1, np.int64)]
+            self._h_action = [np.full(n0, -1, np.int32)]
+            self._h_param = [np.zeros(n0, np.int32)]
+            self.level_sizes = [n0]
+            depth0 = 0
+            base_dev = np.concatenate([[0], np.cumsum(counts0)[:-1]])
+            for i, st in enumerate(self._init_states):
+                bad = spec.check_invariants(st)
+                if bad:
+                    res.ok = False
+                    res.violated_invariant = bad
+                    res.trace = self._trace(i)
+                    return self._finish(res, t0, 0, fp_count)
+            res.states_generated += len(dense)
+
         def _attach_exchange(r):
             r.exchange = {
                 "row_bytes": _row_bytes(),
@@ -453,8 +534,9 @@ class ShardedBFS:
                  f"{exch_rows_wire} wire rows "
                  f"({exch_bytes_wire / 1e6:.1f} MB)")
 
-        depth = 0
+        depth = depth0
         last_progress = t0
+        last_checkpoint = _time.time()
         while int(np.asarray(n_front).sum()) > 0:
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
@@ -466,7 +548,7 @@ class ShardedBFS:
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
                 (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
-                 viol_out, gen_out, sent_out) = self._step(
+                 viol_out, gen_out, sent_out, dead_out) = self._step(
                     tables, front, n_front, start_t,
                     nb, nbp, nba, nbprm, nn, base_gid)
                 reason = int(np.asarray(reason_out)[0])
@@ -497,6 +579,20 @@ class ShardedBFS:
                     raise TLAError(
                         "dense-layout slot collision in sharded BFS "
                         "(see models/vsr.py docstring)")
+                if reason == R_DEADLOCK:
+                    dd = np.asarray(dead_out)
+                    d = int(np.nonzero(dd >= 0)[0][0])
+                    di = int(dd[d])
+                    gid = int(base_dev[d]) + di
+                    res.ok = False
+                    res.error = "deadlock"
+                    res.deadlock_state = self.codec.decode(
+                        {k: np.asarray(v[d * F + di])
+                         for k, v in front.items()})
+                    res.trace = self._trace(gid)
+                    res.diameter = depth
+                    _attach_exchange(res)
+                    return self._finish(res, t0, depth, fp_count)
                 if reason == R_BAG_GROW:
                     old = self.codec.shape.MAX_MSGS
                     self._build(old * 2)
@@ -526,7 +622,8 @@ class ShardedBFS:
                     self.bucket_cap *= 2
                     self._step = make_sharded_level(
                         self.kern, self._inv, self.mesh, self.axis,
-                        self.tile, self.bucket_cap)
+                        self.tile, self.bucket_cap,
+                        check_deadlock=self._ckd)
                     emit(f"exchange bucket grown to {self.bucket_cap} "
                          f"(recompiling)")
                 elif reason == R_NEXT_GROW:
@@ -571,6 +668,44 @@ class ShardedBFS:
             front = nb
             F = self.N
             n_front = nn
+
+            if checkpoint_path and n_next and (
+                    checkpoint_every is None or
+                    _time.time() - last_checkpoint >= checkpoint_every):
+                from ..engine.checkpoint import (save_checkpoint,
+                                                 spec_digest)
+                save_checkpoint(
+                    checkpoint_path,
+                    slots=np.asarray(tables["slots"]),
+                    frontier={k: self._pull_rows(v, nn_h)
+                              for k, v in front.items()},
+                    n_front=n_next,
+                    h_parent=np.concatenate(self._h_parent),
+                    h_action=np.concatenate(self._h_action),
+                    h_param=np.concatenate(self._h_param),
+                    init_dense=[self.codec.encode(st)
+                                for st in self._init_states],
+                    level_sizes=self.level_sizes, depth=depth,
+                    fp_count=fp_count,
+                    states_generated=res.states_generated,
+                    max_msgs=self.codec.shape.MAX_MSGS,
+                    expand_mults=[],
+                    elapsed=_time.time() - t0,
+                    digest=spec_digest(spec),
+                    extra={"sharded": True,
+                           "shard_counts": [int(x) for x in nn_h],
+                           "bucket_cap": self.bucket_cap,
+                           "fp_cap": self.fp_cap, "N": self.N,
+                           "dev_distinct": [int(x) for x in
+                                            self._dev_distinct],
+                           "exchange": {
+                               "useful_rows": exch_rows_useful,
+                               "wire_rows": exch_rows_wire,
+                               "useful_bytes": exch_bytes_useful,
+                               "wire_bytes": exch_bytes_wire}})
+                last_checkpoint = _time.time()
+                emit(f"checkpoint written to {checkpoint_path} "
+                     f"(depth {depth}, {fp_count} distinct)")
 
             now = _time.time()
             if now - last_progress >= 10.0 and log:
