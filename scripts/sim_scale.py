@@ -40,7 +40,22 @@ import jax
 backend = jax.default_backend()
 print(f"backend: {backend}", file=sys.stderr, flush=True)
 
-sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=25, max_msgs=64)
+# reuse the previous run's calibrated dispatch-group caps (same
+# walker count) so the measurement starts at steady state instead of
+# paying the cap-growth recompiles inside the budget
+prev_caps = None
+prev_path = os.path.join(REPO, "scripts", "sim_scale.json")
+if os.path.exists(prev_path):
+    try:
+        with open(prev_path) as f:
+            prev = json.load(f)
+        if prev.get("walkers") == walkers and prev.get("group_caps"):
+            prev_caps = list(prev["group_caps"])
+    except ValueError:
+        pass
+
+sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=25, max_msgs=64,
+                      group_caps=prev_caps)
 t0 = time.time()
 res = sim.run(num=num, depth=100, seed=0, max_seconds=max_seconds,
               log=lambda m: print(f"sim: {m} ({time.time()-t0:.0f}s)",

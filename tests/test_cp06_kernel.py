@@ -143,6 +143,23 @@ def test_device_bfs_levels_match_interpreter():
     assert got.distinct_states == sum(sizes)
 
 
+@pytest.mark.slow
+def test_cp06_device_fixpoint_exact():
+    """Full-fixpoint differential (VERDICT r3 item 5): the CP06 device
+    engine must reach the measured interpreter fixpoint exactly —
+    137,524 distinct / 364,538 generated / diameter 29 at R=3,
+    Values={v1}, timer=1, CrashLimit=1 (scripts/fixpoints.json,
+    3,791 s interpreter run)."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    spec, _codec, _kern = _load()
+    eng = DeviceBFS(spec, tile_size=128)
+    res = eng.run()
+    assert res.ok and res.error is None
+    assert res.distinct_states == 137524
+    assert res.states_generated == 364538
+    assert res.diameter == 29
+
+
 def test_registry_resolves_cp06():
     from tpuvsr.models import registry
     mod = parse_module_file(CP06_TLA)
