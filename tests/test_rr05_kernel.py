@@ -138,6 +138,24 @@ def test_device_bfs_levels_match_interpreter():
     assert got.distinct_states == sum(sizes)
 
 
+@pytest.mark.slow
+def test_device_bfs_deep_levels_match_interpreter():
+    """Deeper bounded-depth differential (VERDICT r3 item 5: recovery-
+    era kernels were held only to depth-5 level counts).  Depth 11
+    covers the crash/recovery/completion cycle at its widest pre-limit
+    levels; exact per-level sizes."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+
+    spec, _codec, _kern = _load()
+    depth = 11
+    sizes = interp_level_sizes(spec, depth)
+    eng = DeviceBFS(spec, tile_size=128)
+    got = eng.run(max_depth=depth)
+    assert got.ok
+    assert eng.level_sizes == sizes
+    assert got.distinct_states == sum(sizes)
+
+
 def test_registry_resolves_rr05():
     from tpuvsr.models import registry
     mod = parse_module_file(RR05_TLA)
