@@ -157,18 +157,36 @@ def main():
     # hunt sampling-mode ablation (scripts/hunt_ablation.py), and the
     # device-vs-interpreter liveness graph build
     # (scripts/liveness_speedup.py)
+    # plus the recorded live-TPU artifacts (bench_tpu_run.json is a
+    # full bench run captured while the flapping axon tunnel was up;
+    # tpu_tests.json is the TPU-backend differential-suite status) so a
+    # cpu-fallback end-of-round run still carries the real-TPU numbers
     for key, fname in (("defect_hunt", "hunt_result.json"),
                        ("sim_scale", "sim_scale.json"),
                        ("defect_bfs_window", "defect_window.json"),
                        ("hunt_ablation", "hunt_ablation.json"),
-                       ("liveness_speedup", "liveness_speedup.json")):
+                       ("liveness_speedup", "liveness_speedup.json"),
+                       ("sim_scale_wide", "sim_scale_wide.json"),
+                       ("tpu_run", "bench_tpu_run.json"),
+                       ("tpu_tests", "tpu_tests.json"),
+                       ("tile_sweep", "tile_sweep.json")):
         p = os.path.join(REPO, "scripts", fname)
         if os.path.exists(p):
             try:
                 with open(p) as f:
-                    RESULT[key] = json.load(f)
+                    loaded = json.load(f)
             except ValueError:
-                pass
+                continue
+            if key == "tpu_run":
+                # a captured full bench run carries its own attachments;
+                # strip them so re-capturing stdout back to
+                # bench_tpu_run.json can never nest runs recursively
+                for k in ("defect_hunt", "sim_scale", "sim_scale_wide",
+                          "defect_bfs_window", "hunt_ablation",
+                          "liveness_speedup", "tpu_run", "tpu_tests",
+                          "tile_sweep"):
+                    loaded.pop(k, None)
+            RESULT[key] = loaded
     print(f"bench: device {res.distinct_states} distinct "
           f"({res.error or 'fixpoint'}), {dev_sps:.0f} generated/s, "
           f"{distinct_sps:.0f} distinct/s, diameter {res.diameter}",

@@ -30,7 +30,8 @@ from tpuvsr.frontend.parser import parse_module_file
 REFERENCE = os.environ.get(
     "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
 ANALYSIS = f"{REFERENCE}/analysis"
-OUT = os.path.join(REPO, "scripts", "fixpoints.json")
+OUT = os.environ.get("TPUVSR_FIXPOINT_OUT",
+                     os.path.join(REPO, "scripts", "fixpoints.json"))
 
 max_states = int(sys.argv[1]) if len(sys.argv) > 1 else 3_000_000
 only = sys.argv[2] if len(sys.argv) > 2 else ""

@@ -3,9 +3,10 @@
 
 Runs as many walks of the target shape as the time budget allows and
 records measured walks/s + the projected wall clock for the full 1e6
-— honest about backend and completion.  Writes scripts/sim_scale.json.
+— honest about backend and completion.  Writes scripts/<out> (arg 4,
+default sim_scale.json).
 
-Usage: python scripts/sim_scale.py [walkers] [max_seconds] [num_walks]
+Usage: python scripts/sim_scale.py [walkers] [max_seconds] [num_walks] [out.json]
 """
 
 import json
@@ -23,6 +24,7 @@ if os.environ.get("TPUVSR_TPU") != "1":
 walkers = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
 max_seconds = float(sys.argv[2]) if len(sys.argv) > 2 else 900
 num = int(sys.argv[3]) if len(sys.argv) > 3 else 10**6
+out_name = sys.argv[4] if len(sys.argv) > 4 else "sim_scale.json"
 
 from tpuvsr.engine.device_sim import DeviceSimulator
 from tpuvsr.engine.spec import SpecModel
@@ -81,5 +83,5 @@ out = {
     "group_caps": list(sim.group_caps),
 }
 print(json.dumps(out))
-with open(os.path.join(REPO, "scripts", "sim_scale.json"), "w") as f:
+with open(os.path.join(REPO, "scripts", out_name), "w") as f:
     json.dump(out, f, indent=1)

@@ -1,0 +1,64 @@
+"""Tile-size sweep of the device BFS engine on the flagship small
+config — finds the throughput-optimal tile for the current backend.
+
+The r4 first TPU bench (tile=256, the CPU-tuned default) measured the
+tunneled v5e SLOWER than the 1-core CPU fallback (1,654 vs 6,564
+distinct/s): at tile 256 each while_loop iteration does too little
+parallel work to cover the TPU's per-iteration overheads.  This sweep
+measures distinct/s at several tiles so bench.py can pick a per-backend
+default honestly.
+
+Usage: [TPUVSR_TPU=1] python scripts/tile_sweep.py [tile ...]
+Writes scripts/tile_sweep.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import ensure_backend, force_cpu
+
+if os.environ.get("TPUVSR_TPU") != "1":
+    force_cpu()
+    backend = "cpu"
+else:
+    backend = ensure_backend(log=lambda m: print(f"[sweep] {m}",
+                                                 file=sys.stderr,
+                                                 flush=True))
+
+from __graft_entry__ import _small_spec
+from tpuvsr.engine.device_bfs import DeviceBFS
+
+tiles = [int(a) for a in sys.argv[1:]] or [256, 512, 1024, 2048]
+OUT = os.path.join(REPO, "scripts", "tile_sweep.json")
+
+spec = _small_spec()
+rows = []
+for tile in tiles:
+    eng = DeviceBFS(spec, tile_size=tile, fpset_capacity=1 << 21,
+                    next_capacity=1 << 15, expand_mult=2,
+                    expand_mults={"ReceiveMatchingSVC": 4, "SendDVC": 4})
+    t0 = time.time()
+    eng.run(max_depth=6)                      # compile + warm
+    compile_s = time.time() - t0
+    res = eng.run()                           # timed full fixpoint
+    row = {
+        "tile": tile,
+        "backend": backend,
+        "compile_s": round(compile_s, 1),
+        "distinct": res.distinct_states,
+        "generated": res.states_generated,
+        "elapsed_s": round(res.elapsed, 2),
+        "distinct_per_s": round(res.distinct_states / res.elapsed, 1),
+        "generated_per_s": round(res.states_generated / res.elapsed, 1),
+        "fixpoint": res.error is None,
+    }
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+    with open(OUT, "w") as f:
+        json.dump({"rows": rows}, f, indent=1)
+print("done", file=sys.stderr)

@@ -1,0 +1,186 @@
+"""TPU job queue: waits for the flapping axon tunnel and runs the
+round's TPU workload whenever the tunnel is up, one job at a time
+(the chip is single-tenant), with a hard timeout per job so a mid-job
+flap cannot wedge the queue.
+
+The first r4 TPU session proved the failure mode this guards against:
+the tunnel came up, bench.py completed on backend "tpu", then the
+tunnel died ~25 min later and the in-flight differential pytest hung
+forever on a dead RPC (zero CPU, state wait_woken) and had to be
+killed.  Probe first, bound everything, record every attempt.
+
+State: scripts/tpu_queue_state.json (job -> done/attempts).
+Log:   scripts/tpu_queue_log.jsonl (one line per attempt).
+Test results aggregate into scripts/tpu_tests.json (attached to bench).
+
+Run detached:  python scripts/tpu_queue.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import probe_tpu
+
+STATE = os.path.join(SCRIPTS, "tpu_queue_state.json")
+LOG = os.path.join(SCRIPTS, "tpu_queue_log.jsonl")
+TESTS_OUT = os.path.join(SCRIPTS, "tpu_tests.json")
+
+MODULES = ["vsr", "a01", "i01", "st03", "as04", "rr05", "al05", "cp06"]
+
+ENV_TEST = {"TPUVSR_TEST_BACKEND": "tpu"}
+ENV_TPU = {"TPUVSR_TPU": "1"}
+
+# (name, argv, timeout_s, extra_env) — priority order tuned for short
+# tunnel windows: flagship-kernel differential first (correctness
+# evidence for everything after), then the graded perf artifacts, then
+# the remaining modules' differentials, then the slow tier.
+JOBS = [
+    ("difftest-vsr",
+     [sys.executable, "-m", "pytest", "tests/test_vsr_kernel.py",
+      "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST),
+]
+JOBS += [
+    ("tile-sweep",
+     [sys.executable, "scripts/tile_sweep.py", "512", "1024", "2048"],
+     2400, ENV_TPU),
+    # walkers depth max_seconds seed sigma mode
+    ("defect-hunt",
+     [sys.executable, "scripts/defect_hunt.py",
+      "4096", "48", "1200", "1", "1.0", "guided"], 2000, ENV_TPU),
+    # walkers max_seconds num — 4096 reuses the calibrated group caps;
+    # the wide job then exploits the TPU's parallel headroom
+    ("sim-scale",
+     [sys.executable, "scripts/sim_scale.py",
+      "4096", "1500", "1000000"], 2100, ENV_TPU),
+    ("sim-scale-wide",
+     [sys.executable, "scripts/sim_scale.py",
+      "16384", "1500", "1000000", "sim_scale_wide.json"], 2100, ENV_TPU),
+    # seconds tile chunk_tiles — wider tiles than the CPU run (256/16):
+    # the first TPU bench showed tile-256 starves the chip
+    ("defect-window",
+     [sys.executable, "scripts/defect_bfs_window.py",
+      "900", "1024", "16"], 1800, ENV_TPU),
+]
+for m in MODULES[1:]:
+    JOBS.append((f"difftest-{m}",
+                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
+                  "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST))
+for m in MODULES:
+    JOBS.append((f"difftest-slow-{m}",
+                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
+                  "-q", "-m", "slow", "--tb=line"], 5400, ENV_TEST))
+
+MAX_ATTEMPTS = 3
+
+
+def load_state():
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {}
+
+
+def save_state(st):
+    with open(STATE, "w") as f:
+        json.dump(st, f, indent=1)
+
+
+def log(rec):
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def update_tests_json(st):
+    tests = {}
+    for name, info in st.items():
+        if name.startswith("difftest"):
+            tests[name] = {k: info.get(k) for k in
+                           ("done", "attempts", "rc", "tail")}
+    out = {
+        "backend": "tpu (axon tunnel, v5e)",
+        "what": ("per-module kernel differential pytest runs executed "
+                 "with TPUVSR_TEST_BACKEND=tpu — the device kernels "
+                 "held to the interpreter oracle under the real TPU "
+                 "lowering (TPU!=CPU lowering caught a real miscompile "
+                 "once: device_sim.py lax.switch incident)"),
+        "jobs": tests,
+        "passed": sum(1 for t in tests.values() if t.get("done")),
+        "total": len(tests),
+    }
+    with open(TESTS_OUT, "w") as f:
+        json.dump(out, f, indent=1)
+
+
+def run_job(name, argv, timeout, extra_env):
+    env = dict(os.environ)
+    env.update(extra_env)
+    t0 = time.time()
+    try:
+        p = subprocess.Popen(argv, cwd=REPO, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True,
+                             start_new_session=True)
+        try:
+            out, _ = p.communicate(timeout=timeout)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            os.killpg(p.pid, signal.SIGKILL)
+            out, _ = p.communicate()
+            rc = -9
+    except Exception as e:  # noqa: BLE001
+        return -1, f"launcher error: {e}", time.time() - t0
+    tail = "\n".join((out or "").strip().splitlines()[-6:])
+    return rc, tail, time.time() - t0
+
+
+def main():
+    st = load_state()
+    deadline = time.time() + float(
+        os.environ.get("TPU_QUEUE_MAX_HOURS", "12")) * 3600
+    while time.time() < deadline:
+        pending = [j for j in JOBS
+                   if not st.get(j[0], {}).get("done")
+                   and st.get(j[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
+        if not pending:
+            log({"event": "queue-drained"})
+            break
+        n = probe_tpu(90)
+        if n <= 0:
+            log({"event": "tunnel-down"})
+            time.sleep(180)
+            continue
+        name, argv, timeout, extra_env = pending[0]
+        log({"event": "start", "job": name})
+        rc, tail, el = run_job(name, argv, timeout, extra_env)
+        info = st.setdefault(name, {"attempts": 0})
+        # a failure with the tunnel dead afterwards is a flap, not a
+        # job failure: the conftest probe-refusal, a -9 hard timeout,
+        # or a mid-job RPC hang all leave rc!=0 without the job ever
+        # running against a live tunnel — don't burn an attempt
+        flap = rc != 0 and probe_tpu(90) <= 0
+        if not flap:
+            info["attempts"] += 1
+        info["rc"] = rc
+        info["tail"] = tail
+        info["elapsed_s"] = round(el, 1)
+        info["done"] = (rc == 0)
+        save_state(st)
+        update_tests_json(st)
+        log({"event": "finish", "job": name, "rc": rc, "flap": flap,
+             "elapsed_s": round(el, 1), "tail": tail[-400:]})
+    log({"event": "queue-exit"})
+
+
+if __name__ == "__main__":
+    main()

@@ -7,18 +7,42 @@ import sys
 # must OVERRIDE the session env: the image bakes JAX_PLATFORMS=axon and
 # a sitecustomize that registers the tunneled-TPU plugin, whose backend
 # init hangs every process when the tunnel is down — force pure CPU.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+TEST_BACKEND = os.environ.get("TPUVSR_TEST_BACKEND", "cpu")
+if TEST_BACKEND == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 # sitecustomize may have imported jax already (to register the plugin),
 # in which case the env var was captured before we set it — override the
 # live config too.
 import jax  # noqa: E402
-jax.config.update("jax_platforms", "cpu")
+if TEST_BACKEND == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+elif TEST_BACKEND == "tpu":
+    # TPUVSR_TEST_BACKEND=tpu: keep the session backend (axon tunnel)
+    # so the differential suite runs against the real TPU lowering.
+    # TPU != CPU lowering has already produced one real miscompile
+    # (device_sim.py lax.switch incident) — this is the correctness
+    # check VERDICT r3 item 1 asks for.  Probe first with a timeout:
+    # backend init against a dead tunnel hangs every process forever
+    # (the r4 flap hung a whole differential run mid-suite).
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from tpuvsr.platform_select import probe_tpu
+    if probe_tpu(90) <= 0:
+        raise SystemExit(
+            "TPUVSR_TEST_BACKEND=tpu but the TPU tunnel is unreachable "
+            "(probe timed out); refusing to start a suite that would "
+            "hang at first backend init")
+    print(f"conftest: running tests on backend "
+          f"{os.environ.get('JAX_PLATFORMS', 'autodetect')}")
+else:
+    raise SystemExit(
+        f"unknown TPUVSR_TEST_BACKEND={TEST_BACKEND!r} (cpu|tpu)")
 # persistent compilation cache: the big jitted level/step kernels take
 # minutes to compile on CPU; cache them across test processes
 jax.config.update("jax_compilation_cache_dir",

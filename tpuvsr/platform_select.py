@@ -45,11 +45,19 @@ def ensure_backend(log=None, probe_timeout=60):
 
 def probe_tpu(timeout=60):
     """Return the number of TPU devices visible through the tunnel, or
-    0 if the probe fails/hangs (dead tunnel)."""
+    0 if the probe fails/hangs (dead tunnel).
+
+    The probe subprocess inherits the environment, so the child counts
+    only non-CPU devices: a cpu-pinned parent (the documented hang
+    workaround) or a bare environment with no accelerator plugin then
+    probes 0 instead of reporting its own CPU devices as TPUs — seen
+    live in r4 when a cpu-pinned dryrun parent probed "8 TPU devices"
+    from its own virtual CPU mesh."""
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; print(len(jax.devices()))"],
+             "import jax; print(sum(1 for d in jax.devices()"
+             " if d.platform != 'cpu'))"],
             capture_output=True, text=True, timeout=timeout)
         if r.returncode == 0 and r.stdout.strip():
             return int(r.stdout.strip().splitlines()[-1])
