@@ -110,37 +110,43 @@ JOBS = [
     ("06-replica-recovery-cp/VR_REPLICA_RECOVERY_CP", CP_CFG, None),
 ]
 
-results = {}
-if os.path.exists(OUT):
-    with open(OUT) as f:
-        results = json.load(f)
+def main():
+    results = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            results = json.load(f)
 
-for stem, cfg_text, overrides in JOBS:
-    if only and only not in stem:
-        continue
-    print(f"=== {stem}", flush=True)
-    spec = load(stem, cfg_text, overrides)
-    t0 = time.time()
-    res = bfs_check(spec, max_states=max_states,
-                    log=lambda m: print(f"  {m}", flush=True))
-    el = time.time() - t0
-    entry = {
-        "constants": {k: repr(v) for k, v in sorted(
-            spec.ev.constants.items())
-            if k in ("ReplicaCount", "Values", "StartViewOnTimerLimit",
-                     "RestartEmptyLimit", "CrashLimit",
-                     "NoProgressChangeLimit", "ClientCount")},
-        "ok": res.ok,
-        "fixpoint": res.error is None,
-        "distinct": res.distinct_states,
-        "generated": res.states_generated,
-        "diameter": res.diameter,
-        "elapsed_s": round(el, 1),
-        "violated": res.violated_invariant,
-        "error": res.error,
-    }
-    results[stem] = entry
-    print(f"  -> {entry}", flush=True)
-    with open(OUT, "w") as f:
-        json.dump(results, f, indent=1, sort_keys=True)
-print("done")
+    for stem, cfg_text, overrides in JOBS:
+        if only and only not in stem:
+            continue
+        print(f"=== {stem}", flush=True)
+        spec = load(stem, cfg_text, overrides)
+        t0 = time.time()
+        res = bfs_check(spec, max_states=max_states,
+                        log=lambda m: print(f"  {m}", flush=True))
+        el = time.time() - t0
+        entry = {
+            "constants": {k: repr(v) for k, v in sorted(
+                spec.ev.constants.items())
+                if k in ("ReplicaCount", "Values",
+                         "StartViewOnTimerLimit", "RestartEmptyLimit",
+                         "CrashLimit", "NoProgressChangeLimit",
+                         "ClientCount")},
+            "ok": res.ok,
+            "fixpoint": res.error is None,
+            "distinct": res.distinct_states,
+            "generated": res.states_generated,
+            "diameter": res.diameter,
+            "elapsed_s": round(el, 1),
+            "violated": res.violated_invariant,
+            "error": res.error,
+        }
+        results[stem] = entry
+        print(f"  -> {entry}", flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

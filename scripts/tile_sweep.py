@@ -56,6 +56,12 @@ for tile in tiles:
         "distinct_per_s": round(res.distinct_states / res.elapsed, 1),
         "generated_per_s": round(res.states_generated / res.elapsed, 1),
         "fixpoint": res.error is None,
+        # the flagship config's pinned fixpoint — a row that misses it
+        # is a CORRECTNESS failure at that tile width, not a datapoint
+        # (first seen: tile 1024 on axon produced 58,957 distinct /
+        # 147,728 generated — duplicate states entering the frontier)
+        "correct": (res.distinct_states == 43941
+                    and res.states_generated == 118746),
     }
     rows.append(row)
     print(json.dumps(row), flush=True)

@@ -1,0 +1,260 @@
+"""Independent spot oracles for the interpreter engine (VERDICT r3
+missing-item 4): every exact state count in the differential suite is
+interpreter-measured, and the interpreter and the device kernels share
+authorship — a common-mode semantic error would be invisible to the
+differential tests.  These micro-specs pin the semantically risky
+machinery (bag tombstones, VIEW/ghost split, symmetry orbits,
+Quantify-over-tombstone quorum counting, deterministic CHOOSE) against
+closed-form state counts derived combinatorially in the comments, NOT
+measured — an error in the corresponding interpreter semantics shifts
+the count and fails the formula, independent of any measured oracle.
+"""
+
+from tpuvsr.engine.bfs import bfs_check
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_text
+
+
+def _spec(module_src, cfg_src):
+    return SpecModel(parse_module_text(module_src),
+                     parse_cfg_text(cfg_src))
+
+
+# ---------------------------------------------------------------------
+# 1. Bag upsert / tombstone lifecycle (VSR:228-245 semantics in
+#    miniature).  Each of K=3 messages moves independently through
+#    unsent -> in flight (count 1) -> consumed (count-0 tombstone,
+#    domain entry retained).  State space = 3^K = 27.
+#    If consumption DROPPED the domain entry (the classic bag bug),
+#    consumed would equal unsent and the count would collapse to 2^K=8;
+#    if tombstones blocked re-send detection it would diverge upward.
+# ---------------------------------------------------------------------
+
+BAG = """---- MODULE MicroBag ----
+EXTENDS Naturals, FiniteSets
+CONSTANTS Msgs
+VARIABLES bag
+
+SendFunc(m, msgs) ==
+    IF m \\in DOMAIN msgs
+    THEN [msgs EXCEPT ![m] = @ + 1]
+    ELSE msgs @@ (m :> 1)
+
+DiscardFunc(m, msgs) ==
+    [msgs EXCEPT ![m] = @ - 1]
+
+Init == bag = [m \\in {} |-> 0]
+
+SendOne ==
+    \\E m \\in Msgs :
+        /\\ m \\notin DOMAIN bag
+        /\\ bag' = SendFunc(m, bag)
+
+Consume ==
+    \\E m \\in DOMAIN bag :
+        /\\ bag[m] > 0
+        /\\ bag' = DiscardFunc(m, bag)
+
+Next == SendOne \\/ Consume
+====
+"""
+
+BAG_CFG = """CONSTANTS
+    Msgs = {m1, m2, m3}
+INIT Init
+NEXT Next
+"""
+
+
+def test_bag_tombstone_state_count():
+    res = bfs_check(_spec(BAG, BAG_CFG))
+    assert res.ok
+    assert res.distinct_states == 27   # 3 lifecycle stages ^ 3 messages
+    # diameter is in TLC's depth convention (states on the longest
+    # shortest path, incl. init — TRACE is 24 states / 23 actions):
+    # 2K = 6 actions -> 7 states
+    assert res.diameter == 7
+
+
+# ---------------------------------------------------------------------
+# 2. VIEW projection / ghost split (SURVEY §2.4).  x walks 0..3; a
+#    ghost counter counts every step but is excluded from the VIEW.
+#    Reachable full states are (x, ghost<=Limit) pairs, but dedup is on
+#    the projection <<x>> alone: distinct = 4.  If aux leaked into the
+#    fingerprint the count would be 4*(Limit+1)=12-ish; if the VIEW were
+#    ignored entirely for invariants, GhostVisible would not trip.
+# ---------------------------------------------------------------------
+
+GHOST = """---- MODULE MicroGhost ----
+EXTENDS Naturals
+VARIABLES x, aux_steps
+
+view == <<x>>
+
+Init == x = 0 /\\ aux_steps = 0
+
+Step ==
+    /\\ x < 3
+    /\\ x' = x + 1
+    /\\ aux_steps' = aux_steps + 1
+
+Next == Step
+
+GhostVisible == aux_steps <= 2
+====
+"""
+
+GHOST_CFG = """INIT Init
+NEXT Next
+VIEW view
+"""
+
+
+def test_view_projection_dedup_count():
+    res = bfs_check(_spec(GHOST, GHOST_CFG))
+    assert res.ok
+    assert res.distinct_states == 4    # projected states x in 0..3
+
+
+def test_ghost_still_visible_to_invariants():
+    res = bfs_check(_spec(GHOST, GHOST_CFG + "INVARIANT GhostVisible\n"))
+    # the x=3 state is only reached with aux_steps=3 > 2: the invariant
+    # must evaluate on the FULL state even though aux is outside VIEW
+    assert not res.ok
+    assert res.violated_invariant == "GhostVisible"
+
+
+# ---------------------------------------------------------------------
+# 3. Symmetry orbit counting (VSR:151, VSR.cfg:31).  Two slots each
+#    assigned once from symmetric Values={v1,v2} (Nil start).  Full
+#    space: {Nil,v1,v2}^2 = 9 assignments.  Orbits under S_2 acting on
+#    {v1,v2} (Burnside): swap fixes only the all-Nil state, so
+#    orbits = (9 + 1)/2 = 5.  A canonicalization that missed a plane
+#    (e.g. only slot 1) yields 6-8; no symmetry yields 9.
+# ---------------------------------------------------------------------
+
+SYMM = """---- MODULE MicroSymm ----
+EXTENDS Naturals, TLC
+CONSTANTS Values, Nil
+VARIABLES slots
+
+symmValues == Permutations(Values)
+
+Init == slots = [i \\in 1..2 |-> Nil]
+
+Assign ==
+    \\E i \\in 1..2, v \\in Values :
+        /\\ slots[i] = Nil
+        /\\ slots' = [slots EXCEPT ![i] = v]
+
+Next == Assign
+====
+"""
+
+SYMM_CFG = """CONSTANTS
+    Values = {v1, v2}
+    Nil = Nil
+INIT Init
+NEXT Next
+SYMMETRY symmValues
+"""
+
+
+def test_symmetry_orbit_count():
+    res = bfs_check(_spec(SYMM, SYMM_CFG))
+    assert res.ok
+    assert res.distinct_states == 5    # Burnside: (9 + 1) / 2
+
+
+def test_no_symmetry_full_count():
+    cfg = SYMM_CFG.replace("SYMMETRY symmValues\n", "")
+    res = bfs_check(_spec(SYMM, cfg))
+    assert res.ok
+    assert res.distinct_states == 9    # 3^2 raw assignments
+
+
+# ---------------------------------------------------------------------
+# 4. Processed-message quorum over count-0 tombstones (A01:478-482 in
+#    miniature).  K=3 pre-seeded messages; consuming decrements to 0;
+#    Commit is enabled once Quantify counts >= Q=2 tombstones and
+#    latches a flag.  Reachable: consumed-subset S (2^3=8) with flag=0,
+#    plus flag=1 for every S with |S| >= 2 reachable after commit
+#    (C(3,2)+C(3,3) = 4): total 12.
+#    If Quantify read count>0 entries or tombstones were dropped from
+#    DOMAIN, Commit would never enable and the count collapses to 8.
+# ---------------------------------------------------------------------
+
+QUORUM = """---- MODULE MicroQuorum ----
+EXTENDS Naturals, FiniteSets, FiniteSetsExt
+CONSTANTS Msgs
+VARIABLES bag, committed
+
+Init ==
+    /\\ bag = [m \\in Msgs |-> 1]
+    /\\ committed = 0
+
+Consume ==
+    \\E m \\in DOMAIN bag :
+        /\\ bag[m] > 0
+        /\\ bag' = [bag EXCEPT ![m] = @ - 1]
+        /\\ UNCHANGED committed
+
+Commit ==
+    /\\ committed = 0
+    /\\ Quantify(DOMAIN bag, LAMBDA m : bag[m] = 0) >= 2
+    /\\ committed' = 1
+    /\\ UNCHANGED bag
+
+Next == Consume \\/ Commit
+====
+"""
+
+QUORUM_CFG = """CONSTANTS
+    Msgs = {m1, m2, m3}
+INIT Init
+NEXT Next
+"""
+
+
+def test_tombstone_quorum_count():
+    res = bfs_check(_spec(QUORUM, QUORUM_CFG))
+    assert res.ok
+    assert res.distinct_states == 12   # 2^3 + (C(3,2) + C(3,3))
+    assert res.diameter == 5           # 4 actions -> 5 states (TLC depth)
+
+
+# ---------------------------------------------------------------------
+# 5. Deterministic CHOOSE (SURVEY §2.7.5).  An action re-picks a value
+#    via CHOOSE from a 3-element set every step; determinism means the
+#    same pick every evaluation, so the reachable space is exactly
+#    {unpicked, picked-once}: 2 states.  A nondeterministic CHOOSE
+#    (fingerprint instability) yields up to 4.
+# ---------------------------------------------------------------------
+
+CHOOSE = """---- MODULE MicroChoose ----
+EXTENDS Naturals
+CONSTANTS Values, Nil
+VARIABLES pick
+
+Init == pick = Nil
+
+Pick ==
+    pick' = CHOOSE v \\in Values : TRUE
+
+Next == Pick
+====
+"""
+
+CHOOSE_CFG = """CONSTANTS
+    Values = {v1, v2, v3}
+    Nil = Nil
+INIT Init
+NEXT Next
+"""
+
+
+def test_choose_deterministic_state_count():
+    res = bfs_check(_spec(CHOOSE, CHOOSE_CFG))
+    assert res.ok
+    assert res.distinct_states == 2    # Nil, then one stable pick
