@@ -35,6 +35,19 @@ INTERP_STATES = int(os.environ.get("BENCH_INTERP_STATES", "3000"))
 T0 = time.time()
 DEADLINE = T0 + 0.92 * BUDGET_S
 
+# round-artifact attachments: key -> scripts/<file>.  Also the strip
+# list for captured bench_tpu_run.json (anti-recursive-nesting) —
+# bench_capture.py imports this, keep it the single source of truth.
+ATTACHMENTS = (("defect_hunt", "hunt_result.json"),
+               ("sim_scale", "sim_scale.json"),
+               ("defect_bfs_window", "defect_window.json"),
+               ("hunt_ablation", "hunt_ablation.json"),
+               ("liveness_speedup", "liveness_speedup.json"),
+               ("sim_scale_wide", "sim_scale_wide.json"),
+               ("tpu_run", "bench_tpu_run.json"),
+               ("tpu_tests", "tpu_tests.json"),
+               ("tile_sweep", "tile_sweep.json"))
+
 RESULT = {
     "metric": "VSR.tla BFS distinct states/sec (R=3, |Values|=1, timer=1)",
     "value": 0.0,
@@ -58,10 +71,6 @@ def emit(code=0):
 def _on_signal(signum, frame):
     RESULT["phase"] += f" (signal {signum})"
     emit(1)
-
-
-signal.signal(signal.SIGTERM, _on_signal)
-signal.signal(signal.SIGINT, _on_signal)
 
 
 def _probe_default_backend(timeout=180):
@@ -109,19 +118,42 @@ def main():
     # fresh full run on the SAME instance (jits are cached by closure)
     RESULT["phase"] = "compile"
     tile = int(os.environ.get("BENCH_TILE", "256"))
+    # fused mode (default): whole fixpoint in O(1) dispatches — the
+    # per-level host round-trips are the runtime on a tunneled TPU
+    # (r4 first TPU run: 26.6 s for a 24-level space ~ 1.1 s/level)
+    fused = os.environ.get("BENCH_FUSED", "1") != "0"
+    RESULT["mode"] = "fused" if fused else "chunked"
     eng = DeviceBFS(spec, tile_size=tile, fpset_capacity=1 << 21,
                     next_capacity=1 << 15, expand_mult=2,
                     expand_mults={"ReceiveMatchingSVC": 4, "SendDVC": 4})
+    runner = eng.run_fused if fused else eng.run
     t0 = time.time()
-    eng.run(max_depth=6)
+    runner(max_depth=6)
     compile_s = time.time() - t0
     RESULT["compile_s"] = round(compile_s, 1)
     print(f"bench: compile+warmup {compile_s:.1f}s", file=sys.stderr)
 
     RESULT["phase"] = "device-bfs"
     t0 = time.time()
-    res = eng.run(max_seconds=max(30.0, DEADLINE - time.time()),
-                  log=lambda m: print(f"bench: {m}", file=sys.stderr))
+    res = runner(max_seconds=max(30.0, DEADLINE - time.time()),
+                 log=lambda m: print(f"bench: {m}", file=sys.stderr))
+    if fused and res.error is None and res.distinct_states != 43941:
+        # self-check against the pinned fixpoint: a fused-pass
+        # miscount must never become the graded number silently —
+        # fall back to the chunked engine (tile-1024 precedent:
+        # width-dependent TPU mis-exploration)
+        RESULT["fused_mismatch_distinct"] = res.distinct_states
+        RESULT["mode"] = "chunked (fused self-check failed)"
+        print(f"bench: FUSED SELF-CHECK FAILED "
+              f"({res.distinct_states} != 43941); falling back",
+              file=sys.stderr)
+        eng2 = DeviceBFS(spec, tile_size=tile, fpset_capacity=1 << 21,
+                         next_capacity=1 << 15, expand_mult=2,
+                         expand_mults={"ReceiveMatchingSVC": 4,
+                                       "SendDVC": 4})
+        eng2.run(max_depth=6)
+        runner = eng2.run
+        res = runner(max_seconds=max(30.0, DEADLINE - time.time()))
     dev_sps = res.states_generated / res.elapsed
     distinct_sps = res.distinct_states / res.elapsed
     RESULT.update({
@@ -141,7 +173,7 @@ def main():
     # columns widening EVERY model's m_hdr plane 9 -> 11 — is fixed by
     # the per-codec NHDR, see models/vsr.py)
     if time.time() < DEADLINE - 60 and res.error is None:
-        res2 = eng.run(max_seconds=max(30.0, DEADLINE - time.time()))
+        res2 = runner(max_seconds=max(30.0, DEADLINE - time.time()))
         RESULT["run2_distinct_per_s"] = round(
             res2.distinct_states / res2.elapsed, 1)
     RESULT["regression_note"] = (
@@ -161,15 +193,7 @@ def main():
     # full bench run captured while the flapping axon tunnel was up;
     # tpu_tests.json is the TPU-backend differential-suite status) so a
     # cpu-fallback end-of-round run still carries the real-TPU numbers
-    for key, fname in (("defect_hunt", "hunt_result.json"),
-                       ("sim_scale", "sim_scale.json"),
-                       ("defect_bfs_window", "defect_window.json"),
-                       ("hunt_ablation", "hunt_ablation.json"),
-                       ("liveness_speedup", "liveness_speedup.json"),
-                       ("sim_scale_wide", "sim_scale_wide.json"),
-                       ("tpu_run", "bench_tpu_run.json"),
-                       ("tpu_tests", "tpu_tests.json"),
-                       ("tile_sweep", "tile_sweep.json")):
+    for key, fname in ATTACHMENTS:
         p = os.path.join(REPO, "scripts", fname)
         if os.path.exists(p):
             try:
@@ -181,10 +205,7 @@ def main():
                 # a captured full bench run carries its own attachments;
                 # strip them so re-capturing stdout back to
                 # bench_tpu_run.json can never nest runs recursively
-                for k in ("defect_hunt", "sim_scale", "sim_scale_wide",
-                          "defect_bfs_window", "hunt_ablation",
-                          "liveness_speedup", "tpu_run", "tpu_tests",
-                          "tile_sweep"):
+                for k, _f in ATTACHMENTS:
                     loaded.pop(k, None)
             RESULT[key] = loaded
     print(f"bench: device {res.distinct_states} distinct "
@@ -195,6 +216,10 @@ def main():
 
 
 if __name__ == "__main__":
+    # registered here, not at import: bench_capture.py imports this
+    # module for ATTACHMENTS and must keep its own signal behavior
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     try:
         main()
     except BaseException as e:  # noqa: BLE001 — always emit the JSON

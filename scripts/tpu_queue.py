@@ -50,6 +50,19 @@ JOBS = [
       "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST),
 ]
 JOBS += [
+    # fused-mode bench (run_fused: whole fixpoint in O(1) dispatches —
+    # the per-level tunnel RTTs were the entire 26.6s of the first TPU
+    # run); captures scripts/bench_tpu_run.json
+    ("bench-fused",
+     [sys.executable, "scripts/bench_capture.py"], 2400,
+     {**ENV_TPU, "BENCH_FUSED": "1", "BENCH_BUDGET_S": "1800"}),
+    # fused-vs-chunked differential ON the TPU lowering (the tile-1024
+    # incident shows width-dependent TPU miscompiles are real) — the
+    # FULL file: the slow test is the one at realistic width (tile 64,
+    # flagship 43,941-state config, violation-trace differential)
+    ("difftest-fused",
+     [sys.executable, "-m", "pytest", "tests/test_fused_bfs.py",
+      "-q", "--tb=line"], 5400, ENV_TEST),
     ("tile-sweep",
      [sys.executable, "scripts/tile_sweep.py", "512", "1024", "2048"],
      2400, ENV_TPU),
@@ -65,11 +78,12 @@ JOBS += [
     ("sim-scale-wide",
      [sys.executable, "scripts/sim_scale.py",
       "16384", "1500", "1000000", "sim_scale_wide.json"], 2100, ENV_TPU),
-    # seconds tile chunk_tiles — wider tiles than the CPU run (256/16):
-    # the first TPU bench showed tile-256 starves the chip
+    # seconds tile chunk_tiles — tile 512, NOT 1024: the tile sweep
+    # showed 1024 mis-explores on axon (58,957 distinct vs pinned
+    # 43,941 — see tile_sweep.json note), and 512 is as fast
     ("defect-window",
      [sys.executable, "scripts/defect_bfs_window.py",
-      "900", "1024", "16"], 1800, ENV_TPU),
+      "900", "512", "16"], 1800, ENV_TPU),
 ]
 for m in MODULES[1:]:
     JOBS.append((f"difftest-{m}",

@@ -31,6 +31,10 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    BFS; TLC's -checkpoint)
   -checkpointdir P snapshot directory (default: <spec>.ckpt)
   -recover PATH    resume a BFS run from a snapshot (TLC's -recover)
+  -fused           device BFS: whole fixpoint in O(1) dispatches (no
+                   per-level host syncs — the remote-TPU mode; not
+                   combinable with -checkpoint/-recover or temporal
+                   properties)
   -json            emit a one-line JSON result summary
 """
 
@@ -66,6 +70,10 @@ def build_parser():
     p.add_argument("-recover", default=None, metavar="PATH")
     p.add_argument("-json", action="store_true")
     p.add_argument("-maxseconds", type=float, default=None)
+    p.add_argument("-fused", action="store_true",
+                   help="device engine: run the whole fixpoint in O(1)"
+                        " dispatches (no per-level host syncs; remote-"
+                        "TPU mode; excludes -checkpoint/-recover)")
     return p
 
 
@@ -145,19 +153,36 @@ def main(argv=None):
                 eng = PagedBFS(spec, retain_levels=True)
             else:
                 eng = (PagedBFS if engine == "paged" else DeviceBFS)(spec)
-            res = eng.run(
-                max_states=args.maxstates, max_seconds=args.maxseconds,
-                check_deadlock=args.deadlock, log=log,
-                checkpoint_path=(ckpt_dir if args.checkpoint or
-                                 args.recover else None),
-                # checkpoint_every=None means "every level boundary";
-                # a resumed run without an explicit -checkpoint gets
-                # TLC's default 30-minute cadence instead of an
-                # unrequested full snapshot per level
-                checkpoint_every=(args.checkpoint * 60.0
-                                  if args.checkpoint else
-                                  30 * 60.0 if args.recover else None),
-                resume_from=args.recover)
+            use_fused = (args.fused and isinstance(eng, DeviceBFS)
+                         and not isinstance(eng, PagedBFS))
+            if args.fused and not use_fused:
+                log("-fused needs the plain device engine (no temporal "
+                    "properties / -fpset paged); using chunked run")
+            if use_fused and (args.checkpoint or args.recover):
+                log("-fused excludes -checkpoint/-recover; "
+                    "using chunked run")
+                use_fused = False
+            if use_fused:
+                res = eng.run_fused(
+                    max_states=args.maxstates,
+                    max_seconds=args.maxseconds,
+                    check_deadlock=args.deadlock, log=log)
+            else:
+                res = eng.run(
+                    max_states=args.maxstates,
+                    max_seconds=args.maxseconds,
+                    check_deadlock=args.deadlock, log=log,
+                    checkpoint_path=(ckpt_dir if args.checkpoint or
+                                     args.recover else None),
+                    # checkpoint_every=None means "every level
+                    # boundary"; a resumed run without an explicit
+                    # -checkpoint gets TLC's default 30-minute cadence
+                    # instead of an unrequested full snapshot per level
+                    checkpoint_every=(args.checkpoint * 60.0
+                                      if args.checkpoint else
+                                      30 * 60.0 if args.recover
+                                      else None),
+                    resume_from=args.recover)
         else:
             if args.checkpoint or args.recover:
                 log("checkpoint/recover is a device-engine feature; "

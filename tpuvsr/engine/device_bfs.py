@@ -124,30 +124,28 @@ class DeviceBFS:
         self._mat = {}          # action id -> jitted single-action fn
         self._level = jax.jit(self._make_level(),
                               donate_argnums=(0, 4, 5, 6, 7))
+        self._ml = None         # fused pass, built lazily (run_fused)
 
-    def _make_level(self):
+    def _tile_body_factory(self):
+        """Build the one-tile expansion body shared by the chunked
+        level pass (_make_level) and the fused multi-level pass
+        (_make_multilevel).  Returns (caps, total_E, make_body) where
+        make_body(frontier, n_front, want_deadlock) closes over the
+        (possibly traced) frontier and count."""
         kern = self.kern
         inv = self._inv
         T = self.tile
-        K = self.chunk_tiles
         incremental = self.hash_mode == "incremental"
 
-        def level(slots, frontier, n_front, start_t,
-                  nb, nbp, nba, nbprm, n_next0, want_deadlock):
-            N_cap = nbp.shape[0]
+        # per-action compaction capacities (adaptive; R_EXPAND_GROW
+        # carries the overflowing action so only it grows)
+        caps = [min(T * kern._lane_count(nm),
+                    max(64, T * self.expand_mults[a]))
+                for a, nm in enumerate(kern.action_names)]
+        total_E = sum(caps)
+
+        def make_body(frontier, n_front, want_deadlock):
             F_cap = frontier["status"].shape[0]
-            n_tiles = (n_front + T - 1) // T
-
-            def cond(c):
-                return ((c["t"] < n_tiles) & (c["t"] < start_t + K)
-                        & (c["reason"] == RUNNING))
-
-            # per-action compaction capacities (adaptive; R_EXPAND_GROW
-            # carries the overflowing action so only it grows)
-            caps = [min(T * kern._lane_count(nm),
-                        max(64, T * self.expand_mults[a]))
-                    for a, nm in enumerate(kern.action_names)]
-            total_E = sum(caps)
 
             def body(c):
                 t = c["t"]
@@ -161,6 +159,7 @@ class DeviceBFS:
 
                 slots = c["slots"]
                 nb, nbp, nba, nbprm = c["nb"], c["nbp"], c["nba"], c["nbprm"]
+                N_cap = nbp.shape[0]
                 nn, dist = c["nn"], c["dist"]
                 reason, viol = c["reason"], c["viol"]
                 en_any = jnp.zeros((T,), bool)
@@ -294,6 +293,24 @@ class DeviceBFS:
                     "gen": c["gen"] + jnp.where(commit, gen_local, 0),
                 }
 
+            return body
+
+        return caps, total_E, make_body
+
+    def _make_level(self):
+        T = self.tile
+        K = self.chunk_tiles
+        _caps, _tot, make_body = self._tile_body_factory()
+
+        def level(slots, frontier, n_front, start_t,
+                  nb, nbp, nba, nbprm, n_next0, want_deadlock):
+            n_tiles = (n_front + T - 1) // T
+
+            def cond(c):
+                return ((c["t"] < n_tiles) & (c["t"] < start_t + K)
+                        & (c["reason"] == RUNNING))
+
+            body = make_body(frontier, n_front, want_deadlock)
             init = {
                 "t": jnp.asarray(start_t, I32),
                 "reason": jnp.asarray(RUNNING, I32),
@@ -309,6 +326,136 @@ class DeviceBFS:
             return jax.lax.while_loop(cond, body, init)
 
         return level
+
+    def _make_multilevel(self):
+        """The fused pass: an OUTER device while_loop over whole BFS
+        levels (ping-pong frontier buffers, on-device trace-pointer and
+        level-size accumulation), so a run to fixpoint is ONE dispatch
+        with zero per-level host syncs — on a remote/tunneled TPU the
+        per-level round-trips are the whole runtime (BENCH r4: 1654
+        distinct/s fused vs 26.6 s ~ 1.1 s/level unfused for a 24-level
+        space).  Pause protocol is unchanged: growth events exit the
+        outer loop with (start_t, nn, gen_level) preserved so the host
+        grows the structure and re-enters mid-level."""
+        T = self.tile
+        _caps, _tot, make_body = self._tile_body_factory()
+
+        def multilevel(slots, front, nb, nbp, nba, nbprm,
+                       tpp, tpa, tpm, lvl_buf,
+                       n_front, start_t, nn0, gen_level0, depth0,
+                       level_base0, fp_count0,
+                       want_deadlock, max_depth, max_states, max_lvls):
+            F_cap = nbp.shape[0]
+            TP_CAP = tpp.shape[0]
+            LVL_CAP = lvl_buf.shape[0]
+            # max_lvls (traced, <= LVL_CAP) bounds levels per dispatch
+            # so the host can check wall-clock budgets between
+            # dispatches without recompiling
+            idx = jnp.arange(F_cap, dtype=I32)
+
+            def ocond(c):
+                return ((c["reason"] == RUNNING) & (c["n_front"] > 0)
+                        & (c["depth"] < max_depth)
+                        & (c["fp_count"] < max_states)
+                        & (c["lvl_cur"] < max_lvls)
+                        & (c["level_base"] + c["n_front"] + F_cap
+                           <= TP_CAP))
+
+            def obody(c):
+                n_front_l = c["n_front"]
+                n_tiles = (n_front_l + T - 1) // T
+                body = make_body(c["front"], n_front_l, want_deadlock)
+
+                def icond(ic):
+                    return (ic["t"] < n_tiles) & (ic["reason"] == RUNNING)
+
+                iinit = {
+                    "t": c["start_t"],
+                    "reason": jnp.asarray(RUNNING, I32),
+                    "viol": jnp.full((3,), -1, I32),
+                    "dead": jnp.asarray(-1, I32),
+                    "grow_aid": jnp.asarray(-1, I32),
+                    "slots": c["slots"],
+                    "nb": c["nb"], "nbp": c["nbp"], "nba": c["nba"],
+                    "nbprm": c["nbprm"],
+                    "nn": c["nn"],
+                    "dist": jnp.asarray(0, I32),
+                    "gen": c["gen_level"],
+                }
+                r = jax.lax.while_loop(icond, body, iinit)
+                committed = r["reason"] == RUNNING
+                n_next = r["nn"]
+                # gids of the completed level start right after the
+                # current frontier's; stable across pause/resume since
+                # nn persists
+                dest_base = c["level_base"] + n_front_l
+
+                live = committed & (idx < n_next)
+                sdest = jnp.where(live, dest_base + idx, TP_CAP)
+                tpp = c["tpp"].at[sdest].set(
+                    r["nbp"] + c["level_base"], mode="drop")
+                tpa = c["tpa"].at[sdest].set(r["nba"], mode="drop")
+                tpm = c["tpm"].at[sdest].set(r["nbprm"], mode="drop")
+                # record only non-empty levels (run() parity: the final
+                # expansion that generates nothing is counted in depth
+                # but never appended to level_sizes)
+                record = committed & (n_next > 0)
+                lvl_buf = c["lvl_buf"].at[
+                    jnp.where(record, c["lvl_cur"], LVL_CAP)
+                ].set(n_next, mode="drop")
+
+                # ping-pong: the completed level's buffer becomes the
+                # frontier, the old frontier becomes scratch
+                swap = committed
+                front = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(swap, a, b),
+                    r["nb"], c["front"])
+                nb = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(swap, a, b),
+                    c["front"], r["nb"])
+                return {
+                    "slots": r["slots"],
+                    "front": front, "nb": nb,
+                    "nbp": r["nbp"], "nba": r["nba"],
+                    "nbprm": r["nbprm"],
+                    "tpp": tpp, "tpa": tpa, "tpm": tpm,
+                    "lvl_buf": lvl_buf,
+                    "n_front": jnp.where(swap, n_next, n_front_l),
+                    "start_t": jnp.where(swap, 0, r["t"]),
+                    "nn": jnp.where(swap, 0, n_next),
+                    "gen_level": jnp.where(swap, 0, r["gen"]),
+                    "gen": c["gen"] + jnp.where(swap, r["gen"], 0),
+                    "depth": c["depth"] + jnp.where(swap, 1, 0),
+                    "level_base": jnp.where(swap, dest_base,
+                                            c["level_base"]),
+                    "fp_count": c["fp_count"] + r["dist"],
+                    "lvl_cur": c["lvl_cur"] + jnp.where(record, 1, 0),
+                    "reason": r["reason"],
+                    "viol": r["viol"], "dead": r["dead"],
+                    "grow_aid": r["grow_aid"],
+                }
+
+            init = {
+                "slots": slots, "front": front, "nb": nb,
+                "nbp": nbp, "nba": nba, "nbprm": nbprm,
+                "tpp": tpp, "tpa": tpa, "tpm": tpm, "lvl_buf": lvl_buf,
+                "n_front": jnp.asarray(n_front, I32),
+                "start_t": jnp.asarray(start_t, I32),
+                "nn": jnp.asarray(nn0, I32),
+                "gen_level": jnp.asarray(gen_level0, I32),
+                "gen": jnp.asarray(0, I32),
+                "depth": jnp.asarray(depth0, I32),
+                "level_base": jnp.asarray(level_base0, I32),
+                "fp_count": jnp.asarray(fp_count0, I32),
+                "lvl_cur": jnp.asarray(0, I32),
+                "reason": jnp.asarray(RUNNING, I32),
+                "viol": jnp.full((3,), -1, I32),
+                "dead": jnp.asarray(-1, I32),
+                "grow_aid": jnp.asarray(-1, I32),
+            }
+            return jax.lax.while_loop(ocond, obody, init)
+
+        return multilevel
 
     # ------------------------------------------------------------------
     # growth handlers
@@ -342,6 +489,46 @@ class DeviceBFS:
               for k, v in zero.items()}
         return (nb, jnp.zeros((cap,), I32), jnp.zeros((cap,), I32),
                 jnp.zeros((cap,), I32))
+
+    def _register_init(self, res):
+        """Encode, dedup, and FPSet-register the initial states; seed
+        the host pointer store and check invariants on them (shared by
+        run() and run_fused() — the two must stay observationally
+        identical).  Returns (table, init_batch, n0, viol_index);
+        viol_index is non-None when an init state violates, with
+        res.trace already built."""
+        spec, codec = self.spec, self.codec
+        table = empty_table(self.fpset_capacity)
+        init_states = list(spec.init_states())
+        init_dense = [codec.encode(st) for st in init_states]
+        init_batch = {k: np.stack([d[k] for d in init_dense])
+                      for k in init_dense[0]}
+        fps = np.asarray(self.kern.fingerprint_batch(init_batch))
+        keep, seen = [], set()
+        for i in range(len(init_dense)):
+            key = tuple(fps[i])
+            if key not in seen:
+                seen.add(key)
+                keep.append(i)
+        init_batch = {k: v[keep] for k, v in init_batch.items()}
+        self._init_states = [init_states[i] for i in keep]
+        self._init_dense = [init_dense[i] for i in keep]
+        n0 = len(keep)
+        table, _, _ = insert_batch(
+            table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+        # host trace store: gid -> (parent gid, action, param)
+        self._h_parent = [np.full(n0, -1, np.int64)]
+        self._h_action = [np.full(n0, -1, np.int32)]
+        self._h_param = [np.zeros(n0, np.int32)]
+        for i in range(n0):
+            bad = spec.check_invariants(self._init_states[i])
+            if bad:
+                res.ok = False
+                res.violated_invariant = bad
+                res.trace = self._trace(i)
+                return table, init_batch, n0, i
+        res.states_generated += len(init_dense)
+        return table, init_batch, n0, None
 
     def run(self, max_states=None, max_depth=None, max_seconds=None,
             check_deadlock=False, log=None, progress_every=10.0,
@@ -393,39 +580,10 @@ class DeviceBFS:
                  f"{fp_count} distinct, frontier {n_front}")
         else:
             fp_cap = self.fpset_capacity
-            table = empty_table(fp_cap)
-
-            # --- register init states (host path, tiny) ---------------
-            init_states = list(spec.init_states())
-            init_dense = [codec.encode(st) for st in init_states]
-            init_batch = {k: np.stack([d[k] for d in init_dense])
-                          for k in init_dense[0]}
-            fps = np.asarray(self.kern.fingerprint_batch(init_batch))
-            keep, seen = [], set()
-            for i in range(len(init_dense)):
-                key = tuple(fps[i])
-                if key not in seen:
-                    seen.add(key)
-                    keep.append(i)
-            init_batch = {k: v[keep] for k, v in init_batch.items()}
-            self._init_states = [init_states[i] for i in keep]
-            self._init_dense = [init_dense[i] for i in keep]
-            n0 = len(keep)
-            table, _, _ = insert_batch(
-                table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+            table, init_batch, n0, viol = self._register_init(res)
             fp_count = n0
-            # host trace store: gid -> (parent gid, action, param)
-            self._h_parent = [np.full(n0, -1, np.int64)]
-            self._h_action = [np.full(n0, -1, np.int32)]
-            self._h_param = [np.zeros(n0, np.int32)]
-            for i in range(n0):
-                bad = spec.check_invariants(self._init_states[i])
-                if bad:
-                    res.ok = False
-                    res.violated_invariant = bad
-                    res.trace = self._trace(i)
-                    return self._finish(res, t0, 0, fp_count)
-            res.states_generated += len(init_dense)
+            if viol is not None:
+                return self._finish(res, t0, 0, fp_count)
 
             # --- device frontier + next buffers -----------------------
             f_cap = max(self.next_cap, n0)
@@ -458,9 +616,12 @@ class DeviceBFS:
                     jnp.asarray(bool(check_deadlock)))
                 table = {"slots": out["slots"]}
                 bufs = (out["nb"], out["nbp"], out["nba"], out["nbprm"])
+                # ONE host round-trip for all control scalars — separate
+                # int() pulls cost one tunnel RTT each on a remote TPU
+                sc = jax.device_get([out["reason"], out["t"], out["nn"],
+                                     out["gen"], out["dist"]])
                 reason, start_t, n_next, gen_add, dist_add = (
-                    int(out["reason"]), int(out["t"]), int(out["nn"]),
-                    int(out["gen"]), int(out["dist"]))
+                    int(x) for x in sc)
                 res.states_generated += gen_add
                 fp_count += dist_add
 
@@ -539,11 +700,16 @@ class DeviceBFS:
             # ---- level complete: pull trace pointers, swap buffers ---
             nb, nbp, nba, nbprm = bufs
             if n_next:
-                par, act, prm = jax.device_get(
-                    (nbp[:n_next], nba[:n_next], nbprm[:n_next]))
-                self._h_parent.append(np.asarray(par, np.int64) + level_base)
-                self._h_action.append(np.asarray(act, np.int32))
-                self._h_param.append(np.asarray(prm, np.int32))
+                # async pointer fetch: the copies overlap the next
+                # level's compute and are only materialized on demand
+                # (_flush_pointers) — a blocking device_get here costs
+                # a full tunnel RTT per level on a remote TPU
+                par, act, prm = nbp[:n_next], nba[:n_next], nbprm[:n_next]
+                for a in (par, act, prm):
+                    a.copy_to_host_async()
+                self._h_parent.append((par, level_base))
+                self._h_action.append(act)
+                self._h_param.append(prm)
                 self.level_sizes.append(n_next)
             level_base += n_front
             # the old frontier set becomes the next scratch buffer set
@@ -554,6 +720,7 @@ class DeviceBFS:
                     checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
+                self._flush_pointers()
                 save_checkpoint(
                     checkpoint_path,
                     slots=table["slots"], frontier=front, n_front=n_next,
@@ -590,6 +757,207 @@ class DeviceBFS:
         return self._finish(res, t0, depth, fp_count)
 
     # ------------------------------------------------------------------
+    # fused run: whole fixpoint in O(1) dispatches
+    # ------------------------------------------------------------------
+    def run_fused(self, max_states=None, max_depth=None,
+                  max_seconds=None, check_deadlock=False, log=None,
+                  levels_per_dispatch=256) -> CheckResult:
+        """Like run(), but through the fused multi-level pass
+        (_make_multilevel): the whole reachable space is explored in a
+        handful of dispatches (one, absent growth pauses), eliminating
+        the per-level host round-trips that dominate on a remote TPU.
+        Trace pointers and level sizes accumulate on device and are
+        pulled once at the end.  No checkpoint/resume (use run() for
+        long preemptible jobs)."""
+        spec, codec = self.spec, self.codec
+        res = CheckResult()
+        t0 = time.time()
+
+        def emit(msg):
+            if log:
+                log(msg)
+
+        fp_cap = self.fpset_capacity
+        table, init_batch, n0, viol = self._register_init(res)
+        if viol is not None:
+            return self._finish(res, t0, 0, n0)
+
+        # ping-pong buffers share one capacity in fused mode
+        f_cap = max(self.next_cap, n0)
+        front, nbp, nba, nbprm = self._alloc_bufs(f_cap)
+        front = {k: front[k].at[:n0].set(init_batch[k]) for k in front}
+        nb, _, _, _ = self._alloc_bufs(f_cap)
+        tp_cap = max(4 * f_cap, 1 << 16)
+        tpp = jnp.full((tp_cap,), -1, I32)
+        tpa = jnp.full((tp_cap,), -1, I32)
+        tpm = jnp.zeros((tp_cap,), I32)
+        lvl_buf = jnp.zeros((levels_per_dispatch,), I32)
+
+        # 0/None both mean "no limit" (run() parity: `if max_states
+        # and ...` treats 0 as falsy — a literal 0 in ocond would
+        # make every dispatch return immediately and livelock)
+        md = int(max_depth) if max_depth else 2**31 - 1
+        ms = int(max_states) if max_states else 2**31 - 1
+        n_front, start_t, nn, gen_level = n0, 0, 0, 0
+        depth, level_base, fp_count = 0, 0, n0
+        self.level_sizes = [n0]
+        # adaptive dispatch quantum: small first dispatches give the
+        # host early wall-clock checkpoints for max_seconds, growing
+        # toward levels_per_dispatch so steady state stays O(1)
+        # dispatches (on a remote TPU the extra early syncs are noise)
+        quantum = 4 if max_seconds else levels_per_dispatch
+
+        def set_pointers(n):
+            self._h_parent = [np.asarray(tpp[:n]).astype(np.int64)]
+            self._h_action = [np.asarray(tpa[:n])]
+            self._h_param = [np.asarray(tpm[:n])]
+
+        while True:
+            if self._ml is None:
+                self._ml = jax.jit(self._make_multilevel(),
+                                   donate_argnums=tuple(range(10)))
+            out = self._ml(
+                table["slots"], front, nb, nbp, nba, nbprm,
+                tpp, tpa, tpm, lvl_buf,
+                jnp.asarray(n_front, I32), jnp.asarray(start_t, I32),
+                jnp.asarray(nn, I32), jnp.asarray(gen_level, I32),
+                jnp.asarray(depth, I32), jnp.asarray(level_base, I32),
+                jnp.asarray(fp_count, I32),
+                jnp.asarray(bool(check_deadlock)),
+                jnp.asarray(md, I32), jnp.asarray(ms, I32),
+                jnp.asarray(min(quantum, levels_per_dispatch), I32))
+            quantum = min(quantum * 4, levels_per_dispatch)
+            table = {"slots": out["slots"]}
+            front, nb = out["front"], out["nb"]
+            nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
+            tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
+            lvl_buf = out["lvl_buf"]
+            sc = jax.device_get(
+                [out[k] for k in ("reason", "n_front", "start_t", "nn",
+                                  "gen_level", "gen", "depth",
+                                  "level_base", "fp_count", "lvl_cur")])
+            (reason, n_front, start_t, nn, gen_level, gen_add, depth,
+             level_base, fp_count, lvl_cur) = (int(x) for x in sc)
+            res.states_generated += gen_add
+            if lvl_cur:
+                self.level_sizes.extend(
+                    int(x) for x in np.asarray(lvl_buf[:lvl_cur]))
+            emit(f"depth {depth}: {fp_count} distinct, "
+                 f"{res.states_generated} generated "
+                 f"({fp_count / (time.time() - t0):.0f} distinct/s)")
+
+            if reason == RUNNING:
+                if n_front == 0:
+                    break                           # fixpoint
+                if max_depth is not None and depth >= max_depth:
+                    res.error = f"depth limit {max_depth} reached"
+                    break
+                if max_states and fp_count >= max_states:
+                    res.error = f"state limit {max_states} reached"
+                    break
+                if max_seconds and time.time() - t0 > max_seconds:
+                    res.error = f"time budget {max_seconds}s reached"
+                    break
+                if level_base + n_front + f_cap > tp_cap:
+                    add = tp_cap                     # double
+                    tpp = jnp.concatenate(
+                        [tpp, jnp.full((add,), -1, I32)])
+                    tpa = jnp.concatenate(
+                        [tpa, jnp.full((add,), -1, I32)])
+                    tpm = jnp.concatenate(
+                        [tpm, jnp.zeros((add,), I32)])
+                    tp_cap += add
+                    emit(f"trace-pointer store grown to {tp_cap}")
+                # else: level counter full — drained above, re-enter
+                continue
+            if reason == R_VIOLATION:
+                # committed tiles of the in-flight level count (run()
+                # adds per-chunk gen on every call incl. the last)
+                res.states_generated += gen_level
+                vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
+                gid = level_base + vp
+                parent_dense = self._fetch_row(front, vp)
+                vstate = self._materialize_one(parent_dense, va, vprm)
+                bad = spec.check_invariants(self.codec.decode(vstate))
+                if bad is None:
+                    raise TLAError(
+                        "device/interpreter divergence: device "
+                        "invariant kernel reported a violation the "
+                        "interpreter accepts (parent gid "
+                        f"{gid}, action {self.kern.action_names[va]})")
+                set_pointers(level_base + n_front)
+                res.ok = False
+                res.violated_invariant = bad
+                res.trace = self._trace(gid, extra=(va, vprm))
+                # depth counts committed levels; the violation is in
+                # the in-progress one (chunked run() parity)
+                res.diameter = depth + 1
+                return self._finish(res, t0, depth + 1, fp_count)
+            if reason == R_DEADLOCK:
+                res.states_generated += gen_level
+                di = int(out["dead"])
+                set_pointers(level_base + n_front)
+                res.ok = False
+                res.error = "deadlock"
+                res.deadlock_state = self.codec.decode(
+                    self._fetch_row(front, di))
+                res.trace = self._trace(level_base + di)
+                res.diameter = depth + 1
+                return self._finish(res, t0, depth + 1, fp_count)
+            if reason == R_BAG_GROW:
+                front, nb = self._grow_msgs([front, nb])
+                emit(f"message table grown to "
+                     f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
+            elif reason == R_FPSET_GROW:
+                table = grow(table)
+                fp_cap *= 4
+                emit(f"FPSet grown to {fp_cap} slots")
+            elif reason == R_NEXT_GROW:
+                old_cap = nbp.shape[0]
+                front, nbp, nba, nbprm = self._grow_next(
+                    (front, nbp, nba, nbprm))
+                f_cap = nbp.shape[0]
+                nb = {k: jnp.concatenate(
+                    [v, jnp.zeros((f_cap - old_cap,) + v.shape[1:],
+                                  v.dtype)]) for k, v in nb.items()}
+                emit(f"frontier buffers grown to {f_cap}")
+            elif reason == R_EXPAND_GROW:
+                aid = int(out["grow_aid"])
+                self.expand_mults[aid] *= 2
+                self._level = jax.jit(self._make_level(),
+                                      donate_argnums=(0, 4, 5, 6, 7))
+                self._ml = None
+                emit(f"expand buffer for "
+                     f"{self.kern.action_names[aid]} grown to tile x "
+                     f"{self.expand_mults[aid]} (recompiling)")
+            elif reason == R_SLOT_ERR:
+                raise TLAError(
+                    "dense-layout slot collision (a second DVC or "
+                    "recovery response from one source in one view): "
+                    "this restart-era interleaving needs the "
+                    "multi-slot layout (vsr.py docstring)")
+
+        # a limit break straight after a growth pause still carries an
+        # in-flight level's committed-tile gen (run() adds per chunk)
+        res.states_generated += gen_level
+        set_pointers(fp_count if reason == RUNNING and n_front == 0
+                     else level_base + n_front)
+        res.diameter = depth
+        return self._finish(res, t0, depth, fp_count)
+
+    # ------------------------------------------------------------------
+    def _flush_pointers(self):
+        """Materialize any still-on-device trace-pointer levels (the
+        per-level fetches are issued async)."""
+        for i, v in enumerate(self._h_parent):
+            if isinstance(v, tuple):
+                arr, off = v
+                self._h_parent[i] = np.asarray(arr).astype(np.int64) + off
+        for lst in (self._h_action, self._h_param):
+            for i, v in enumerate(lst):
+                if not isinstance(v, np.ndarray):
+                    lst[i] = np.asarray(v, np.int32)
+
     def _fetch_row(self, batch, i):
         return {k: np.asarray(v[i]) for k, v in batch.items()}
 
@@ -616,6 +984,7 @@ class DeviceBFS:
         """Walk the host pointer table back to an init state, then
         replay the recorded (action, param) chain through the kernel to
         materialize each state, emitting TRACE-format entries."""
+        self._flush_pointers()
         parent = np.concatenate(self._h_parent)
         action = np.concatenate(self._h_action)
         param = np.concatenate(self._h_param)

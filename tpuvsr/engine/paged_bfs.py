@@ -49,7 +49,7 @@ from .bfs import CheckResult
 from .device_bfs import (DeviceBFS, I32, R_BAG_GROW, R_DEADLOCK,
                          R_EXPAND_GROW, R_FPSET_GROW, R_NEXT_GROW,
                          R_SLOT_ERR, R_VIOLATION, RUNNING)
-from .fpset import empty_table, grow, insert_batch
+from .fpset import grow
 
 
 class PagedBFS(DeviceBFS):
@@ -134,36 +134,10 @@ class PagedBFS(DeviceBFS):
                  f"{fp_count} distinct, frontier {n_front}")
         else:
             fp_cap = self.fpset_capacity
-            table = empty_table(fp_cap)
-            init_states = list(spec.init_states())
-            init_dense = [self.codec.encode(st) for st in init_states]
-            init_batch = {k: np.stack([d[k] for d in init_dense])
-                          for k in init_dense[0]}
-            fps = np.asarray(self.kern.fingerprint_batch(init_batch))
-            keep, seen = [], set()
-            for i in range(len(init_dense)):
-                key = tuple(fps[i])
-                if key not in seen:
-                    seen.add(key)
-                    keep.append(i)
-            init_batch = {k: v[keep] for k, v in init_batch.items()}
-            self._init_states = [init_states[i] for i in keep]
-            self._init_dense = [init_dense[i] for i in keep]
-            n0 = len(keep)
-            table, _, _ = insert_batch(
-                table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+            table, init_batch, n0, viol = self._register_init(res)
             fp_count = n0
-            self._h_parent = [np.full(n0, -1, np.int64)]
-            self._h_action = [np.full(n0, -1, np.int32)]
-            self._h_param = [np.zeros(n0, np.int32)]
-            for i in range(n0):
-                bad = spec.check_invariants(self._init_states[i])
-                if bad:
-                    res.ok = False
-                    res.violated_invariant = bad
-                    res.trace = self._trace(i)
-                    return self._finish(res, t0, 0, fp_count)
-            res.states_generated += len(init_dense)
+            if viol is not None:
+                return self._finish(res, t0, 0, fp_count)
             host_front = {k: init_batch[k][:n0].astype(np.int32)
                           for k in init_batch}
             n_front = n0
