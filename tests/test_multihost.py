@@ -1,0 +1,60 @@
+"""DCN-tier test: the sharded BFS driver as a true multi-process JAX
+job (2 processes x 4 CPU devices, jax.distributed + gloo collectives —
+the same SPMD program that spans TPU hosts over DCN in production).
+
+The worker (scripts/multihost_bfs.py --worker) runs the flagship small
+config depth-limited and rank 0 writes the level sizes; they must
+equal the interpreter oracle's exact per-level frontier sizes — any
+divergence in the cross-process exchange, ownership routing, or
+replicated host pulls shifts a level count.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import requires_reference, vsr_spec
+
+pytestmark = requires_reference
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "multihost_bfs.py")
+
+
+def _gloo_available():
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@pytest.mark.slow
+def test_multiprocess_sharded_bfs_matches_interpreter(tmp_path):
+    if not _gloo_available():
+        pytest.skip("gloo CPU collectives unavailable")
+    from tests.conftest import interp_level_sizes
+
+    depth = 6
+    spec = vsr_spec()
+    want = interp_level_sizes(spec, depth)
+
+    out_path = tmp_path / "multihost.json"
+    env = dict(os.environ)
+    env.update({"TPUVSR_MH_DEPTH": str(depth),
+                "TPUVSR_MH_OUT": str(out_path),
+                "TPUVSR_MH_PORT": "9781",
+                "TPUVSR_MH_TIMEOUT": "1500"})
+    r = subprocess.run([sys.executable, SCRIPT], env=env,
+                       capture_output=True, text=True, timeout=1800)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    with open(out_path) as f:
+        got = json.load(f)
+    assert got["processes"] == 2
+    assert got["global_devices"] == 8
+    assert got["level_sizes"] == want
+    assert got["distinct_states"] == sum(want)

@@ -26,13 +26,18 @@ def _mesh8():
 def test_sharded_bfs_levels_match_single_device():
     """The full multi-chip BFS driver must produce identical per-level
     frontier sizes and distinct-state counts as the single-device
-    engine (depth-limited for test speed)."""
+    engine.  Depth 8 with tile 8 forces MULTI-TILE levels (per-device
+    frontier > tile from level ~5): r2-r4 carried a dedup regression
+    where each tile inserted into the step's constant table argument
+    instead of the carried one, so tile t+1 re-admitted tile t's
+    successors — invisible at single-tile depths (the old depth-4
+    version of this test)."""
     spec = vsr_spec()
-    sbfs = ShardedBFS(spec, _mesh8(), tile=16, bucket_cap=512,
+    sbfs = ShardedBFS(spec, _mesh8(), tile=8, bucket_cap=512,
                       next_capacity=1 << 10, fpset_capacity=1 << 12)
-    res = sbfs.run(max_depth=4)
+    res = sbfs.run(max_depth=8)
     eng = DeviceBFS(spec, tile_size=64)
-    res1 = eng.run(max_depth=4)
+    res1 = eng.run(max_depth=8)
     assert sbfs.level_sizes == eng.level_sizes
     assert res.distinct_states == res1.distinct_states
     assert res.states_generated == res1.states_generated

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.fpset import dedup_batch, insert_core
+from .multihost import make_replicator, put_sharded
 
 U32 = jnp.uint32
 
@@ -55,9 +56,9 @@ def make_sharded_tables(mesh, axis, capacity_per_device):
     """Global FPSet: one independent shard per device, stacked on the
     leading (sharded) axis."""
     n = mesh.shape[axis]
-    tabs = {"slots": jnp.zeros((n, capacity_per_device, 5), U32)}
     sh = NamedSharding(mesh, P(axis))
-    return jax.device_put(tabs, sh)
+    return {"slots": put_sharded(
+        np.zeros((n, capacity_per_device, 5), np.uint32), sh)}
 
 
 # ======================================================================
@@ -228,8 +229,16 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 (~room).astype(jnp.int32), axis) > 0
             commit = ~abort_pre & ~abort_room
 
+            # insert into the CARRIED table (c["slots"]), not the
+            # step argument: the argument is constant across the tile
+            # while_loop, so using it dropped every prior tile's
+            # inserts — tile t+1 re-admitted tile t's successors and
+            # any level needing >1 tile/device flooded the next
+            # frontier with duplicates (caught by the multihost
+            # depth-14 artifact: 518,843 "distinct" in a 43,941-state
+            # space; scripts/bucket_repro.py pins the level-8 onset)
             new_tab, fresh, probe_ovf = insert_core(
-                tables, i_fps[perm2], cand2 & commit)
+                {"slots": slots}, i_fps[perm2], cand2 & commit)
             slots2 = new_tab["slots"]
             dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1, N
                              ).astype(jnp.int32)
@@ -337,16 +346,29 @@ class ShardedBFS:
                                         self.bucket_cap,
                                         check_deadlock=self._ckd)
         self._sh = NamedSharding(self.mesh, P(self.axis))
+        self._rep_sh = NamedSharding(self.mesh, P())
+        # multi-process: host pulls of globally-sharded arrays must
+        # reshard to replicated first (parallel/multihost.py)
+        self._pull = make_replicator(self.mesh)
 
     # borrowed single-device helpers (same attribute contract)
     from ..engine.device_bfs import DeviceBFS as _DB
     _materialize_one = _DB._materialize_one
     _trace = _DB._trace
     _fetch_row = _DB._fetch_row
+
+    def _flush_pointers(self):
+        """No-op: the sharded driver's pointer pulls are synchronous
+        (they ride the per-level collective gather already)."""
     del _DB
 
     def _put(self, arr):
-        return jax.device_put(arr, self._sh)
+        return put_sharded(arr, self._sh)
+
+    def _rep(self, arr):
+        """Host value (identical on all processes) -> replicated
+        global array (a P() input of the sharded kernels)."""
+        return put_sharded(arr, self._rep_sh)
 
     def _alloc_frontier(self, cap):
         zero = self.codec.zero_state()
@@ -359,13 +381,13 @@ class ShardedBFS:
     def _pull_rows(self, garr, counts):
         """Gather per-device live rows of a [D*cap, ...] global array."""
         cap = garr.shape[0] // self.D
-        host = np.asarray(garr)
+        host = self._pull(garr)
         return np.concatenate(
             [host[d * cap:d * cap + int(counts[d])]
              for d in range(self.D)], axis=0)
 
     def _grow_global(self, garr, old_cap, new_cap):
-        host = np.asarray(garr)
+        host = self._pull(garr)
         D = self.D
         host = host.reshape((D, old_cap) + host.shape[1:])
         pad = np.zeros((D, new_cap - old_cap) + host.shape[2:],
@@ -448,8 +470,9 @@ class ShardedBFS:
             exch_bytes_useful = xc.get("useful_bytes", 0)
             exch_bytes_wire = xc.get("wire_bytes", 0)
             F = self.N
-            front, _p0, _a0, _m0 = self._alloc_frontier(F)
-            host_front = {k: np.array(v) for k, v in front.items()}
+            zero = self.codec.zero_state()
+            host_front = {k: np.zeros((D * F,) + np.shape(v), np.int32)
+                          for k, v in zero.items()}
             rows = ck["frontier"]
             pos = 0
             for d in range(D):
@@ -488,9 +511,13 @@ class ShardedBFS:
             counts0 = np.bincount(owners, minlength=D)
 
             F = self.N
-            front, _p0, _a0, _m0 = self._alloc_frontier(F)
             self._dev_distinct = counts0.astype(np.int64).copy()
-            host_front = {k: np.array(v) for k, v in front.items()}
+            # build the initial frontier host-side (zeros + init rows)
+            # and scatter once: pulling a freshly-allocated GLOBAL
+            # array is illegal in multi-process mode
+            zero = self.codec.zero_state()
+            host_front = {k: np.zeros((D * F,) + np.shape(v), np.int32)
+                          for k, v in zero.items()}
             pos = 0
             for d in range(D):
                 for j in range(int(counts0[d])):
@@ -501,9 +528,9 @@ class ShardedBFS:
             front = {k: self._put(v) for k, v in host_front.items()}
             n_front = self._put(counts0.astype(np.int32))
             tables, _fr, ovf = sharded_ins(
-                tables, jnp.asarray(fps[keep]),
-                jnp.ones((n0,), bool))
-            assert not bool(np.asarray(ovf).any())
+                tables, self._rep(fps[keep]),
+                self._rep(np.ones((n0,), bool)))
+            assert not bool(self._pull(ovf).any())
             fp_count = n0
 
             self._h_parent = [np.full(n0, -1, np.int64)]
@@ -537,7 +564,22 @@ class ShardedBFS:
         depth = depth0
         last_progress = t0
         last_checkpoint = _time.time()
-        while int(np.asarray(n_front).sum()) > 0:
+
+        # multi-process SPMD discipline: any control decision based on
+        # wall clocks must be rank-agreed, or ranks issue mismatched
+        # collectives (rank 0 enters the checkpoint pull — a reshard
+        # collective — while rank 1 proceeds to the next level's step).
+        # Rank 0's verdict is broadcast; single-process it's a no-op.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            def agree(flag):
+                return bool(int(multihost_utils.broadcast_one_to_all(
+                    np.int32(bool(flag)))))
+        else:
+            def agree(flag):
+                return bool(flag)
+        while int(self._pull(n_front).sum()) > 0:
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
                 break
@@ -551,15 +593,15 @@ class ShardedBFS:
                  viol_out, gen_out, sent_out, dead_out) = self._step(
                     tables, front, n_front, start_t,
                     nb, nbp, nba, nbprm, nn, base_gid)
-                reason = int(np.asarray(reason_out)[0])
-                sent = int(np.asarray(sent_out).sum())
+                reason = int(self._pull(reason_out)[0])
+                sent = int(self._pull(sent_out).sum())
                 exch_rows_useful += sent
                 exch_bytes_useful += sent * _row_bytes()
                 start_t = t_out
                 if reason == RUNNING:
                     break
                 if reason == R_VIOLATION:
-                    vrows = np.asarray(viol_out)
+                    vrows = self._pull(viol_out)
                     sel = vrows[vrows[:, 0] >= 0][0]
                     gid, va, vprm = (int(x) for x in sel)
                     res.ok = False
@@ -580,14 +622,14 @@ class ShardedBFS:
                         "dense-layout slot collision in sharded BFS "
                         "(see models/vsr.py docstring)")
                 if reason == R_DEADLOCK:
-                    dd = np.asarray(dead_out)
+                    dd = self._pull(dead_out)
                     d = int(np.nonzero(dd >= 0)[0][0])
                     di = int(dd[d])
                     gid = int(base_dev[d]) + di
                     res.ok = False
                     res.error = "deadlock"
                     res.deadlock_state = self.codec.decode(
-                        {k: np.asarray(v[d * F + di])
+                        {k: self._pull(v[d * F + di])
                          for k, v in front.items()})
                     res.trace = self._trace(gid)
                     res.diameter = depth
@@ -599,7 +641,7 @@ class ShardedBFS:
 
                     # pad the message-table axis of every state array
                     def pad_msgs_global(g_dict, cap):
-                        host = {k: np.asarray(v).reshape(
+                        host = {k: self._pull(v).reshape(
                             (D, cap) + v.shape[1:])
                             for k, v in g_dict.items()}
                         out = {}
@@ -636,7 +678,7 @@ class ShardedBFS:
                     self.N = new_n
                     emit(f"next-frontier grown to {new_n}/device")
                 elif reason == R_FPSET_GROW:
-                    slots = np.asarray(tables["slots"])
+                    slots = self._pull(tables["slots"])
                     grown = [fp_grow({"slots": jnp.asarray(slots[d])}
                                      )["slots"] for d in range(D)]
                     self.fp_cap = int(grown[0].shape[0])
@@ -647,11 +689,11 @@ class ShardedBFS:
                     raise TLAError(f"unknown sharded reason {reason}")
 
             # committed tiles this level x full static bucket volume
-            wire = int(np.asarray(start_t).max()) * D * D * self.bucket_cap
+            wire = int(self._pull(start_t).max()) * D * D * self.bucket_cap
             exch_rows_wire += wire
             exch_bytes_wire += wire * _row_bytes()
-            nn_h = np.asarray(nn)
-            gen_h = int(np.asarray(gen_out).sum())
+            nn_h = self._pull(nn)
+            gen_h = int(self._pull(gen_out).sum())
             res.states_generated += gen_h
             n_next = int(nn_h.sum())
             fp_count += n_next
@@ -669,40 +711,45 @@ class ShardedBFS:
             F = self.N
             n_front = nn
 
-            if checkpoint_path and n_next and (
+            if checkpoint_path and n_next and agree(
                     checkpoint_every is None or
                     _time.time() - last_checkpoint >= checkpoint_every):
                 from ..engine.checkpoint import (save_checkpoint,
                                                  spec_digest)
-                save_checkpoint(
-                    checkpoint_path,
-                    slots=np.asarray(tables["slots"]),
-                    frontier={k: self._pull_rows(v, nn_h)
-                              for k, v in front.items()},
-                    n_front=n_next,
-                    h_parent=np.concatenate(self._h_parent),
-                    h_action=np.concatenate(self._h_action),
-                    h_param=np.concatenate(self._h_param),
-                    init_dense=[self.codec.encode(st)
-                                for st in self._init_states],
-                    level_sizes=self.level_sizes, depth=depth,
-                    fp_count=fp_count,
-                    states_generated=res.states_generated,
-                    max_msgs=self.codec.shape.MAX_MSGS,
-                    expand_mults=[],
-                    elapsed=_time.time() - t0,
-                    digest=spec_digest(spec),
-                    extra={"sharded": True,
-                           "shard_counts": [int(x) for x in nn_h],
-                           "bucket_cap": self.bucket_cap,
-                           "fp_cap": self.fp_cap, "N": self.N,
-                           "dev_distinct": [int(x) for x in
-                                            self._dev_distinct],
-                           "exchange": {
-                               "useful_rows": exch_rows_useful,
-                               "wire_rows": exch_rows_wire,
-                               "useful_bytes": exch_bytes_useful,
-                               "wire_bytes": exch_bytes_wire}})
+                # the pulls are collectives in multi-process mode —
+                # every process participates; only rank 0 writes
+                ck_slots = self._pull(tables["slots"])
+                ck_front = {k: self._pull_rows(v, nn_h)
+                            for k, v in front.items()}
+                if jax.process_index() == 0:
+                    save_checkpoint(
+                        checkpoint_path,
+                        slots=ck_slots,
+                        frontier=ck_front,
+                        n_front=n_next,
+                        h_parent=np.concatenate(self._h_parent),
+                        h_action=np.concatenate(self._h_action),
+                        h_param=np.concatenate(self._h_param),
+                        init_dense=[self.codec.encode(st)
+                                    for st in self._init_states],
+                        level_sizes=self.level_sizes, depth=depth,
+                        fp_count=fp_count,
+                        states_generated=res.states_generated,
+                        max_msgs=self.codec.shape.MAX_MSGS,
+                        expand_mults=[],
+                        elapsed=_time.time() - t0,
+                        digest=spec_digest(spec),
+                        extra={"sharded": True,
+                               "shard_counts": [int(x) for x in nn_h],
+                               "bucket_cap": self.bucket_cap,
+                               "fp_cap": self.fp_cap, "N": self.N,
+                               "dev_distinct": [int(x) for x in
+                                                self._dev_distinct],
+                               "exchange": {
+                                   "useful_rows": exch_rows_useful,
+                                   "wire_rows": exch_rows_wire,
+                                   "useful_bytes": exch_bytes_useful,
+                                   "wire_bytes": exch_bytes_wire}})
                 last_checkpoint = _time.time()
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
@@ -713,7 +760,7 @@ class ShardedBFS:
                 emit(f"depth {depth}: {fp_count} distinct, "
                      f"{res.states_generated} generated, "
                      f"{fp_count / (now - t0):.0f} distinct/s")
-            if max_seconds and now - t0 > max_seconds:
+            if max_seconds and agree(now - t0 > max_seconds):
                 res.error = f"time budget {max_seconds}s reached"
                 break
             if max_states and fp_count >= max_states:
@@ -721,7 +768,7 @@ class ShardedBFS:
                 break
             # proactive shard growth keeps in-level probe overflow rare
             if self._dev_distinct.max() > 0.4 * self.fp_cap:
-                slots = np.asarray(tables["slots"])
+                slots = self._pull(tables["slots"])
                 grown = [fp_grow({"slots": jnp.asarray(slots[d])}
                                  )["slots"] for d in range(D)]
                 self.fp_cap = int(grown[0].shape[0])
