@@ -169,3 +169,32 @@ def test_registry_resolves_cp06():
     assert registry.has_device_model(spec)
     codec, kern = registry.make_model(spec)
     assert kern.action_names == ACTION_NAMES
+
+
+def test_invariants_match_interpreter_on_gc_states():
+    """Per-state invariant parity on states with a GC'd (NoOp) log
+    prefix — the CP06 invariants go through the OpOf indirection
+    (CP06:1219-1246: a NoOp log slot defers to app state), which the
+    inherited raw-log versions missed: the device engine falsely
+    flagged NoLogDivergence on recovered/checkpointed replicas (caught
+    by the run()'s loud-fail divergence check at gid 1446)."""
+    import jax.numpy as jnp
+
+    spec, codec, kern = _load()
+    states = explore_states(spec, 2600)
+    gcd = [s for s in states
+           if any("NoOp" in str(s["rep_log"].apply(r))
+                  for r in sorted(s["replicas"]))]
+    assert gcd, "exploration never produced a NoOp'd log"
+    inv_names = list(spec.cfg.invariants)
+    combined = kern.invariant_fn(inv_names)
+    per = {n: kern.invariant_fn([n]) for n in inv_names}
+    for s in gcd[::2]:
+        dense = codec.encode(s)
+        darr = {k: jnp.asarray(v) for k, v in dense.items()}
+        dev_ok = bool(combined(darr))
+        interp_bad = spec.check_invariants(s)
+        if dev_ok != (interp_bad is None):
+            detail = {n: bool(f(darr)) for n, f in per.items()}
+            raise AssertionError(
+                f"device per-invariant={detail} interp_bad={interp_bad}")

@@ -66,7 +66,6 @@ class CP06Kernel(RR05Kernel):
         return jnp.where(arr > self.V, arr,
                          perm[jnp.clip(arr, 0, self.V)])
 
-    _replica_has_op = ST03Kernel._replica_has_op
     act_receive_client_request = ST03Kernel.act_receive_client_request
     act_execute_op = AS04Kernel.act_execute_op
 
@@ -715,6 +714,44 @@ class CP06Kernel(RR05Kernel):
         filled = st["app"] != 0                               # [R, P]
         want = pos[None, :] < st["commit"][:, None]
         return (filled == want).all()
+
+    def _op_of(self, st):
+        """OpOf (CP06:1219-1222): a NoOp (GC'd) log slot defers to the
+        app-state entry.  The inherited raw-log invariants are WRONG
+        for CP06 — a recovered/checkpointed replica's log prefix is
+        NoOps while its app state carries the real operations (device
+        falsely flagged NoLogDivergence on such states; the engine's
+        loud-fail divergence check caught it at gid 1446 of the small
+        fixpoint config)."""
+        return jnp.where(st["log"] == self.NOOP, st["app"], st["log"])
+
+    def _replica_has_op(self, st):
+        # ReplicaHasOp (CP06:1244-1246) goes through OpOf, so a value
+        # surviving only in app state after log GC still counts
+        v_ids = jnp.arange(1, self.V + 1, dtype=I32)
+        op_of = self._op_of(st)
+        return (op_of[:, :, None] == v_ids[None, None, :]).any(axis=1)
+
+    def inv_no_log_divergence(self, st):
+        # CP06:1224-1231: both-committed ops compared through OpOf
+        pos = jnp.arange(self.MAX_OPS, dtype=I32)
+        comm = pos[None, :] < st["commit"][:, None]          # [R, P]
+        op_of = self._op_of(st)
+        diff = op_of[:, None, :] != op_of[None, :, :]
+        both = comm[:, None, :] & comm[None, :, :]
+        return ~(both & diff).any()
+
+    def inv_no_app_state_divergence(self, st):
+        # CP06:1234-1240: pairwise app divergence on both-committed
+        # ops, OR any committed app entry equal to NoLogEntry ("would
+        # indicate a bug in the spec" — r1=r2 makes the \E catch it)
+        pos = jnp.arange(self.MAX_OPS, dtype=I32)
+        comm = pos[None, :] < st["commit"][:, None]          # [R, P]
+        app_diff = st["app"][:, None, :] != st["app"][None, :, :]
+        both = comm[:, None, :] & comm[None, :, :]
+        pair_viol = (both & app_diff).any()
+        noop_viol = ((st["app"] == self.NOOP) & comm).any()
+        return ~(pair_viol | noop_viol)
 
     INVARIANT_FNS = dict(
         RR05Kernel.INVARIANT_FNS,
