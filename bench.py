@@ -46,7 +46,9 @@ ATTACHMENTS = (("defect_hunt", "hunt_result.json"),
                ("sim_scale_wide", "sim_scale_wide.json"),
                ("tpu_run", "bench_tpu_run.json"),
                ("tpu_tests", "tpu_tests.json"),
-               ("tile_sweep", "tile_sweep.json"))
+               ("tile_sweep", "tile_sweep.json"),
+               ("multihost", "multihost.json"),
+               ("recovery_fixpoints", "recovery_fixpoints.json"))
 
 RESULT = {
     "metric": "VSR.tla BFS distinct states/sec (R=3, |Values|=1, timer=1)",
