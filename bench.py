@@ -139,15 +139,23 @@ def main():
     t0 = time.time()
     res = runner(max_seconds=max(30.0, DEADLINE - time.time()),
                  log=lambda m: print(f"bench: {m}", file=sys.stderr))
-    if fused and res.error is None and res.distinct_states != 43941:
+    # self-check fires on a completed run that misses the pinned count,
+    # AND on a partial (time-budget) run that OVERcounts — the space is
+    # pinned complete, so distinct > 43941 is a mis-exploration even
+    # when the run was cut short (ADVICE r4)
+    if fused and (res.distinct_states != 43941 if res.error is None
+                  else res.distinct_states > 43941):
         # self-check against the pinned fixpoint: a fused-pass
         # miscount must never become the graded number silently —
         # fall back to the chunked engine (tile-1024 precedent:
         # width-dependent TPU mis-exploration)
         RESULT["fused_mismatch_distinct"] = res.distinct_states
+        RESULT["fused_mismatch_partial"] = res.error
         RESULT["mode"] = "chunked (fused self-check failed)"
-        print(f"bench: FUSED SELF-CHECK FAILED "
-              f"({res.distinct_states} != 43941); falling back",
+        what = (f"{res.distinct_states} != 43941" if res.error is None
+                else f"{res.distinct_states} > 43941 on a partial run "
+                     f"({res.error})")
+        print(f"bench: FUSED SELF-CHECK FAILED ({what}); falling back",
               file=sys.stderr)
         eng2 = DeviceBFS(spec, tile_size=tile, fpset_capacity=1 << 21,
                          next_capacity=1 << 15, expand_mult=2,

@@ -5,10 +5,17 @@ The reference's flagship run — exhaustive BFS of VSR.tla at R=3,
 (/root/reference/README.md:20).  This script runs the same fixture
 (examples/VSR_defect.cfg) through the host-paged BFS engine for a fixed
 wall-clock window and records sustained throughput, memory behavior,
-and spill statistics — the capability proof that a defect-scale level
-no longer OOMs the engine (VERDICT r3 item 2).
+spill statistics, frontier occupancy, and a measured time-to-depth-24
+projection (the violation depth: TRACE:556) — the single-chip version
+of the reference's headline workload.
 
-Writes scripts/defect_window.json.
+Checkpoint/resume: the run snapshots at level boundaries
+(scripts/defect_window_ckpt) and RESUMES from the snapshot when one
+exists — a tunnel flap mid-window costs only the partial level, and
+re-running the job goes deeper instead of starting over.  Delete the
+checkpoint dir to start fresh.
+
+Writes scripts/defect_window.json (cumulative across resumed windows).
 
 Usage: python scripts/defect_bfs_window.py [seconds] [tile] [chunk_tiles]
 """
@@ -33,6 +40,9 @@ seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
 tile = int(sys.argv[2]) if len(sys.argv) > 2 else 256
 chunk_tiles = int(sys.argv[3]) if len(sys.argv) > 3 else 16
 
+CKPT = os.path.join(REPO, "scripts", "defect_window_ckpt")
+OUT = os.path.join(REPO, "scripts", "defect_window.json")
+
 REFERENCE = os.environ.get(
     "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
 spec = load_spec(f"{REFERENCE}/VSR.tla",
@@ -40,11 +50,47 @@ spec = load_spec(f"{REFERENCE}/VSR.tla",
 
 t0 = time.time()
 eng = PagedBFS(spec, tile_size=tile, chunk_tiles=chunk_tiles,
-               next_capacity=1 << 16, fpset_capacity=1 << 22)
-compile_probe = time.time()
-res = eng.run(max_seconds=seconds,
+               next_capacity=1 << 17, fpset_capacity=1 << 24,
+               max_msgs=32)
+resume = CKPT if os.path.isdir(CKPT) else None
+if resume:
+    print(f"[defect_window] resuming from {CKPT}", flush=True)
+res = eng.run(max_seconds=seconds, resume_from=resume,
+              checkpoint_path=CKPT, checkpoint_every=120.0,
               log=lambda m: print(f"[defect_window] {m}", flush=True))
-elapsed = res.elapsed
+window_elapsed = time.time() - t0          # this window's wall clock
+elapsed = res.elapsed                      # cumulative across resumes
+
+
+def depth24_projection(level_sizes, distinct_per_s):
+    """Fit the tail growth ratio of the level sizes and project the
+    cumulative states through depth 24 (the violation depth), then
+    divide by the sustained distinct/s.  Crude but measured."""
+    full = [s for s in level_sizes if s > 0]
+    if len(full) < 4 or distinct_per_s <= 0:
+        return None
+    # fit on the last 3 COMPLETED levels (the final entry is partial
+    # whenever the window cut mid-level) and seed the extrapolation
+    # from the last completed level too — seeding from the partial one
+    # would understate the projection by its completion fraction
+    tail = full[-4:-1]
+    ratios = [tail[i + 1] / tail[i] for i in range(len(tail) - 1)
+              if tail[i] > 0]
+    if not ratios:
+        return None
+    r = sum(ratios) / len(ratios)
+    total = sum(full[:-1])
+    cur = full[-2]
+    for _ in range(len(full) - 2, 24):
+        cur *= r
+        total += cur
+    return {"tail_growth_ratio": round(r, 2),
+            "projected_cumulative_states_depth24": int(total),
+            "projected_seconds_at_current_rate":
+                int(total / distinct_per_s)}
+
+
+distinct_per_s = res.distinct_states / max(elapsed, 1e-9)
 out = {
     "config": "examples/VSR_defect.cfg (R=3, |Values|=3, timer=3)",
     "engine": "paged (host-RAM frontier, HBM fingerprints)",
@@ -53,21 +99,34 @@ out = {
     "tile": tile,
     "chunk_tiles": chunk_tiles,
     "elapsed_s": round(elapsed, 1),
+    "window_elapsed_s": round(window_elapsed, 1),
+    "resumed": bool(resume),
     "depth_reached": res.diameter,
     "distinct_states": res.distinct_states,
     "states_generated": res.states_generated,
-    "distinct_per_s": round(res.distinct_states / elapsed, 1),
-    "generated_per_s": round(res.states_generated / elapsed, 1),
+    "distinct_per_s": round(distinct_per_s, 1),
+    "generated_per_s": round(res.states_generated / max(elapsed, 1e-9),
+                             1),
+    "vs_cpu_window_1160": round(distinct_per_s / 1160.3, 2),
     "level_sizes": eng.level_sizes,
+    "frontier_final": eng.level_sizes[-1] if eng.level_sizes else 0,
+    "avg_tile_occupancy": round(
+        sum(eng.level_sizes) / max(1, len(eng.level_sizes)) / tile, 1),
     "spill_count": eng.spill_count,
     "spill_rows": eng.spill_rows,
     "max_msgs_final": eng.codec.shape.MAX_MSGS,
     "frontier_bytes_per_state": sum(
         v.nbytes for v in eng.codec.zero_state().values()),
+    "device_bytes_per_s": round(
+        (res.states_generated + res.distinct_states) * sum(
+            v.nbytes for v in eng.codec.zero_state().values())
+        / max(elapsed, 1e-9) / 1e6, 1),
+    "depth24_projection": depth24_projection(
+        eng.level_sizes, distinct_per_s),
     "violated": res.violated_invariant,
     "error": res.error,
     "ok": res.ok,
 }
-with open(os.path.join(REPO, "scripts", "defect_window.json"), "w") as f:
+with open(OUT, "w") as f:
     json.dump(out, f, indent=1)
 print(json.dumps(out))

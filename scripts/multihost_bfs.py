@@ -86,7 +86,8 @@ def launcher():
     rcs, outs = launch(
         [sys.executable, os.path.abspath(__file__), "--worker"],
         nproc=nproc, local_devices=4,
-        port=int(os.environ.get("TPUVSR_MH_PORT", "9761")),
+        port=(int(os.environ["TPUVSR_MH_PORT"])
+              if "TPUVSR_MH_PORT" in os.environ else None),
         timeout=float(os.environ.get("TPUVSR_MH_TIMEOUT", "2400")),
         extra_env={"TPUVSR_MH_DEPTH":
                    os.environ.get("TPUVSR_MH_DEPTH", "0"),

@@ -20,6 +20,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the sweep IS the validation tool the engine's tile-width guard defers
+# to — it must be able to run the unvalidated widths it grades
+os.environ.setdefault("TPUVSR_UNSAFE_TILE", "1")
+
 from tpuvsr.platform_select import ensure_backend, force_cpu
 
 if os.environ.get("TPUVSR_TPU") != "1":

@@ -40,36 +40,34 @@ MODULES = ["vsr", "a01", "i01", "st03", "as04", "rr05", "al05", "cp06"]
 ENV_TEST = {"TPUVSR_TEST_BACKEND": "tpu"}
 ENV_TPU = {"TPUVSR_TPU": "1"}
 
-# (name, argv, timeout_s, extra_env) — priority order tuned for short
-# tunnel windows: flagship-kernel differential first (correctness
-# evidence for everything after), then the graded perf artifacts, then
-# the remaining modules' differentials, then the slow tier.
+# (name, argv, timeout_s, extra_env) — ROUND 5 priority order for
+# ~45-min tunnel windows (VERDICT r4 "next round" items 1-3, 6, 8):
+#   1. miscompile repro ladder (localize the tile-1024 TPU divergence;
+#      everything else's trust rests on it),
+#   2. defect-config paged window on the chip (the graded headline:
+#      >=10x the CPU window's 1,160 distinct/s), resumable via
+#      checkpoint so flapped windows extend instead of restarting,
+#   3. a fresh full bench capture,
+#   4. the 7 remaining per-module differential suites under the TPU
+#      lowering (difftest-vsr passed in r4, state carries over),
+#   5. configs[2] simulation scale + the guided hunt on TPU,
+#   6. the RR05 deep pin, extra defect depth, and the slow tier.
 JOBS = [
-    ("difftest-vsr",
-     [sys.executable, "-m", "pytest", "tests/test_vsr_kernel.py",
-      "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST),
-]
-JOBS += [
-    # fused-mode bench (run_fused: whole fixpoint in O(1) dispatches —
-    # the per-level tunnel RTTs were the entire 26.6s of the first TPU
-    # run); captures scripts/bench_tpu_run.json
+    ("miscompile-repro",
+     [sys.executable, "scripts/tpu_miscompile_repro.py"], 3600,
+     ENV_TPU),
+    ("defect-window",
+     [sys.executable, "scripts/defect_bfs_window.py",
+      "1800", "512", "32"], 3300, ENV_TPU),
     ("bench-fused",
      [sys.executable, "scripts/bench_capture.py"], 2400,
      {**ENV_TPU, "BENCH_FUSED": "1", "BENCH_BUDGET_S": "1800"}),
-    # fused-vs-chunked differential ON the TPU lowering (the tile-1024
-    # incident shows width-dependent TPU miscompiles are real) — the
-    # FULL file: the slow test is the one at realistic width (tile 64,
-    # flagship 43,941-state config, violation-trace differential)
-    ("difftest-fused",
-     [sys.executable, "-m", "pytest", "tests/test_fused_bfs.py",
-      "-q", "--tb=line"], 5400, ENV_TEST),
-    ("tile-sweep",
-     [sys.executable, "scripts/tile_sweep.py", "512", "1024", "2048"],
-     2400, ENV_TPU),
-    # walkers depth max_seconds seed sigma mode
-    ("defect-hunt",
-     [sys.executable, "scripts/defect_hunt.py",
-      "4096", "48", "1200", "1", "1.0", "guided"], 2000, ENV_TPU),
+]
+for m in MODULES:
+    JOBS.append((f"difftest-{m}",
+                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
+                  "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST))
+JOBS += [
     # walkers max_seconds num — 4096 reuses the calibrated group caps;
     # the wide job then exploits the TPU's parallel headroom
     ("sim-scale",
@@ -78,17 +76,25 @@ JOBS += [
     ("sim-scale-wide",
      [sys.executable, "scripts/sim_scale.py",
       "16384", "1500", "1000000", "sim_scale_wide.json"], 2100, ENV_TPU),
-    # seconds tile chunk_tiles — tile 512, NOT 1024: the tile sweep
-    # showed 1024 mis-explores on axon (58,957 distinct vs pinned
-    # 43,941 — see tile_sweep.json note), and 512 is as fast
-    ("defect-window",
+    # walkers depth max_seconds seed sigma mode
+    ("defect-hunt",
+     [sys.executable, "scripts/defect_hunt.py",
+      "4096", "48", "1200", "1", "1.0", "guided"], 2000, ENV_TPU),
+    ("rr05-deep",
+     [sys.executable, "scripts/rr05_deep.py", "1500", "512", "32"],
+     2700, ENV_TPU),
+    # a second window resumes the defect checkpoint and goes deeper
+    ("defect-window-2",
      [sys.executable, "scripts/defect_bfs_window.py",
-      "900", "512", "16"], 1800, ENV_TPU),
+      "1800", "512", "32"], 3300, ENV_TPU),
+    # fused-vs-chunked differential ON the TPU lowering
+    ("difftest-fused",
+     [sys.executable, "-m", "pytest", "tests/test_fused_bfs.py",
+      "-q", "--tb=line"], 5400, ENV_TEST),
+    ("rr05-deep-2",
+     [sys.executable, "scripts/rr05_deep.py", "1500", "512", "32"],
+     2700, ENV_TPU),
 ]
-for m in MODULES[1:]:
-    JOBS.append((f"difftest-{m}",
-                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
-                  "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST))
 for m in MODULES:
     JOBS.append((f"difftest-slow-{m}",
                  [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",

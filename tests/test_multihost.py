@@ -25,11 +25,18 @@ SCRIPT = os.path.join(REPO, "scripts", "multihost_bfs.py")
 
 
 def _gloo_available():
-    import jax
+    # probe in a throwaway subprocess: doing the config.update in this
+    # process would leak the gloo setting into every other test
+    # collected in the same pytest run (ADVICE r4)
     try:
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        return True
-    except Exception:  # noqa: BLE001
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update("
+             "'jax_cpu_collectives_implementation', 'gloo')"],
+            capture_output=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
         return False
 
 

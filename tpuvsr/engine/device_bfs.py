@@ -39,6 +39,7 @@ the next tier (SURVEY.md §5 distributed backend, parallel/sharded_bfs).
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -59,11 +60,10 @@ I32 = jnp.int32
 # The jitted level kernel takes minutes to build; persist compiled
 # binaries across processes (bench, CLI, tests share one cache).
 if not jax.config.jax_compilation_cache_dir:
-    import os as _os
     jax.config.update(
         "jax_compilation_cache_dir",
-        _os.environ.get("TPUVSR_JAX_CACHE",
-                        _os.path.expanduser("~/.cache/tpuvsr_jax")))
+        os.environ.get("TPUVSR_JAX_CACHE",
+                       os.path.expanduser("~/.cache/tpuvsr_jax")))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 # level-kernel stop reasons
@@ -80,11 +80,29 @@ R_EXPAND_GROW = 8    # per-action enabled-lane compaction buffer too small
 _value_perm_table = registry.value_perm_table
 
 
+# Largest tile width validated against the pinned fixpoint counts on
+# the real TPU (axon): tile=1024 mis-explored the flagship config
+# (58,957 distinct vs pinned 43,941 — scripts/tile_sweep.json), an
+# unresolved TPU-lowering correctness failure.  Until a re-run sweep
+# marks wider tiles `correct: true`, the engine refuses them on
+# accelerator backends (CPU lowering is validated at all widths).
+MAX_VALIDATED_TPU_TILE = 512
+
+
 class DeviceBFS:
     def __init__(self, spec: SpecModel, max_msgs=None, tile_size=128,
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
-                 expand_mults=None):
+                 expand_mults=None, model_factory=None):
+        if (tile_size > MAX_VALIDATED_TPU_TILE
+                and os.environ.get("TPUVSR_UNSAFE_TILE") != "1"
+                and jax.default_backend() != "cpu"):
+            raise TLAError(
+                f"tile_size={tile_size} exceeds the largest width "
+                f"validated against pinned counts on a TPU backend "
+                f"({MAX_VALIDATED_TPU_TILE}; tile=1024 mis-explored on "
+                f"axon — scripts/tile_sweep.json).  Set "
+                f"TPUVSR_UNSAFE_TILE=1 to override for diagnosis runs.")
         self.spec = spec
         self.tile = tile_size
         self.fpset_capacity = fpset_capacity
@@ -99,6 +117,10 @@ class DeviceBFS:
         self.expand_mults = expand_mults
         self._expand_mult_default = expand_mult
         self.inv_names = list(spec.cfg.invariants)
+        # model_factory(spec, max_msgs=..) -> (codec, kernel); default
+        # is the hand-kernel registry, tests/the CLI can pass the
+        # AST-compiled factory (lower/compile.make_compiled_model)
+        self._model_factory = model_factory or registry.make_model
         self._build(max_msgs)
 
     # ------------------------------------------------------------------
@@ -108,7 +130,8 @@ class DeviceBFS:
         """(Re)build codec, kernel, and the jitted level pass for a
         message-table bound; called again on bag growth."""
         spec = self.spec
-        self.codec, self.kern = registry.make_model(spec, max_msgs=max_msgs)
+        self.codec, self.kern = self._model_factory(spec,
+                                                    max_msgs=max_msgs)
         names = self.kern.action_names
         if self.expand_mults is None:
             self.expand_mults = [self._expand_mult_default] * len(names)
@@ -793,10 +816,13 @@ class DeviceBFS:
         tpm = jnp.zeros((tp_cap,), I32)
         lvl_buf = jnp.zeros((levels_per_dispatch,), I32)
 
-        # 0/None both mean "no limit" (run() parity: `if max_states
-        # and ...` treats 0 as falsy — a literal 0 in ocond would
-        # make every dispatch return immediately and livelock)
-        md = int(max_depth) if max_depth else 2**31 - 1
+        # run() parity on the limit conventions: max_depth=0 is a real
+        # limit there (`is not None` — stops before the first level)
+        # while max_states=0 means unlimited (`if max_states and ...`);
+        # md/ms must encode the SAME semantics the host checks below
+        # use, or a md=0 run explores a whole dispatch quantum before
+        # the host notices (ADVICE r4)
+        md = 2**31 - 1 if max_depth is None else int(max_depth)
         ms = int(max_states) if max_states else 2**31 - 1
         n_front, start_t, nn, gen_level = n0, 0, 0, 0
         depth, level_base, fp_count = 0, 0, n0

@@ -32,6 +32,7 @@ reachable-set sizes.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -41,6 +42,14 @@ import jax.numpy as jnp
 
 U32 = jnp.uint32
 MAX_PROBES = 64
+
+# Experimental hedge for the tile-1024 axon mis-exploration
+# (scripts/tile_sweep.json): if the claim-then-verify scatter->gather
+# pair is being fused/reordered by the TPU lowering, an optimization
+# barrier between the claim write and the verify read forces the
+# ordering.  Off by default; scripts/tpu_miscompile_repro.py flips it
+# in a subprocess to test the hypothesis on hardware.
+_CLAIM_BARRIER = os.environ.get("TPUVSR_FPSET_BARRIER", "0") == "1"
 
 
 def empty_table(capacity: int):
@@ -117,6 +126,8 @@ def insert_core(table, fps, mask):
         # read-back names a single winner even among equal fingerprints
         cidx = jnp.where(empty, idx, jnp.uint32(cap))  # OOB drops the write
         slots = slots.at[cidx].set(payload, mode="drop")
+        if _CLAIM_BARRIER:
+            slots = jax.lax.optimization_barrier(slots)
         post = slots[idx]
         won = empty & (post == payload).all(axis=1)
         # a lane that saw empty but reads back its own (tag, row) under

@@ -1,0 +1,1369 @@
+"""AST -> JAX lowering: compile reference actions to device kernels
+(SURVEY.md §7.4 `lower/`; VERDICT r4 "what's missing" item 1).
+
+The hand kernels (models/*_kernel.py) prove the dense layout and the
+engine contract; this module generates the per-action guard/action
+functions FROM THE PARSED SPEC instead of by hand.  The pipeline:
+
+    frontend AST --ir.extract_action--> lane binders + conjunct tree
+                 --Lowerer------------> (guard_fn, action_fn) closures
+                                        over the dense state
+
+Design decisions (and their honesty boundaries):
+
+* The dense LAYOUT stays declared per spec family (the codec classes in
+  models/): the compiler consumes it, it does not yet synthesize one.
+  What is generated from the AST: every guard, every state mutation,
+  the lane binders, the lane->replica map for incremental
+  fingerprinting, and the invariant kernels.
+* The message-algebra combinators ``SendFunc``/``BroadcastFunc``/
+  ``DiscardFunc`` (A01:152-169 — identical in every corpus module) are
+  intrinsics lowered to the kernel base's bag primitives (`_bag_send`,
+  `_broadcast`, `_bag_discard`).  Their *wrappers* (Send, SendOnce,
+  Broadcast, Discard, DiscardAndSend, DiscardAndBroadcast,
+  SendAsReceived) are NOT special-cased: they inline from their spec
+  definitions like any other operator, which also surfaces their
+  embedded guards (``messages[d] > 0``, A01:189) as compiled guard
+  conjuncts.
+* Evaluation is eager with clipped indexing (the §2.7.1 lazy-semantics
+  hazard is neutralized by masking, exactly as in the hand kernels).
+* ``CHOOSE m \\in DOMAIN messages : P(m)`` lowers to a vectorized
+  candidate mask + deterministic lexicographic tie-break on the record
+  columns in ``value_key`` field order (alphabetical), matching the
+  interpreter's deterministic CHOOSE (core/values.py:169-195).
+* Inner quantifiers over the bag/dynamic ranges vectorize onto fresh
+  broadcast axes (var at nesting depth d -> axis -(d+1)); quantifiers
+  over static sets unroll.
+* A disjunction of primed branches (SendDVC's SendAsReceived/Send
+  split, A01:493-496; ReceiveSV's IF, A01:631-637) compiles both
+  branches and selects elementwise; branches must be guard-exclusive,
+  which every corpus action satisfies.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.values import ModelValue, TLAError, tla_eq
+from ..frontend.tla_ast import Def
+from .ir import (D_MSGS, D_REPLICAS, D_SUBSETS, D_VALUES, contains_prime,
+                 extract_action)
+
+I32 = jnp.int32
+INF = jnp.int32(0x7FFFFFFF)
+
+# header column layout (models/vsr.py)
+from ..models.vsr import (H_COMMIT, H_DEST, H_FIRST, H_LNV, H_OP,  # noqa: E402
+                          H_SRC, H_TYPE, H_VIEW, H_X)
+
+# message record field -> (header column, value space)
+MSG_FIELD_COLS = {
+    "type": (H_TYPE, "mtype"),
+    "view_number": (H_VIEW, None),
+    "op_number": (H_OP, None),
+    "commit_number": (H_COMMIT, None),
+    "dest": (H_DEST, "replica"),
+    "source": (H_SRC, "replica"),
+    "last_normal_vn": (H_LNV, None),
+    "first_op": (H_FIRST, None),
+    "x": (H_X, None),
+}
+
+# per-message-type record fields, for the deterministic-CHOOSE key
+# (alphabetical = value_key order for records); log/message expand to
+# their plane columns
+MSG_TYPE_FIELDS = {
+    "PrepareMsg": ("commit_number", "dest", "message", "op_number",
+                   "source", "type", "view_number"),
+    "PrepareOkMsg": ("dest", "op_number", "source", "type",
+                     "view_number"),
+    "StartViewChangeMsg": ("dest", "source", "type", "view_number"),
+    "DoViewChangeMsg": ("commit_number", "dest", "last_normal_vn",
+                        "log", "op_number", "source", "type",
+                        "view_number"),
+    "StartViewMsg": ("commit_number", "dest", "log", "op_number",
+                     "source", "type", "view_number"),
+    "GetStateMsg": ("dest", "op_number", "source", "type",
+                    "view_number"),
+    "NewStateMsg": ("commit_number", "dest", "first_op", "log",
+                    "op_number", "source", "type", "view_number"),
+}
+
+# state variable -> dense plane binding for the ST03 layout family
+# (kind, plane, space)
+VAR_KINDS = {
+    "rep_status": ("rep", "status", "status"),
+    "rep_view_number": ("rep", "view", None),
+    "rep_op_number": ("rep", "op", None),
+    "rep_commit_number": ("rep", "commit", None),
+    "rep_last_normal_view": ("rep", "lnv", None),
+    "rep_sent_dvc": ("rep", "sent_dvc", "bool"),
+    "rep_sent_sv": ("rep", "sent_sv", "bool"),
+    "no_progress": ("rep", "no_prog", "bool"),
+    "rep_log": ("replog", "log", None),
+    "rep_peer_op_number": ("repfn", "peer_op", None),
+    "no_progress_ctr": ("glob", "np_ctr", None),
+    "aux_svc": ("glob", "aux_svc", None),
+    "aux_client_acked": ("auxfn", "aux_acked", None),
+    "messages": ("bag", None, None),
+    "replicas": ("repset_const", None, None),
+}
+
+_BAG_COMBINATORS = ("SendFunc", "BroadcastFunc", "DiscardFunc")
+
+
+class LowerError(TLAError):
+    pass
+
+
+# ----------------------------------------------------------------------
+# dense values
+# ----------------------------------------------------------------------
+class DV:
+    """A lowered (dense) TLA+ value."""
+
+    def __init__(self, kind, **kw):
+        self.kind = kind
+        self.__dict__.update(kw)
+
+    def __repr__(self):
+        return f"DV({self.kind})"
+
+
+def d_int(v, space=None):
+    return DV("int", v=v, space=space)
+
+
+def d_bool(v):
+    return DV("bool", v=v)
+
+
+def d_static(v):
+    return DV("static", v=v)
+
+
+def d_log(arr, length):
+    return DV("log", arr=arr, length=length)
+
+
+def d_msg(k, mask=None, axis=None):
+    return DV("msg", k=k, mask=mask, axis=axis)
+
+
+class Env:
+    __slots__ = ("vars", "depth")
+
+    def __init__(self, vars=None, depth=0):
+        self.vars = vars or {}
+        self.depth = depth
+
+    def bind(self, name, dv):
+        nv = dict(self.vars)
+        nv[name] = dv
+        return Env(nv, self.depth)
+
+    def bind_many(self, d):
+        nv = dict(self.vars)
+        nv.update(d)
+        return Env(nv, self.depth)
+
+    def deeper(self):
+        return Env(self.vars, self.depth + 1)
+
+
+# ----------------------------------------------------------------------
+class Lowerer:
+    def __init__(self, spec, codec, kern):
+        self.spec = spec
+        self.codec = codec
+        self.kern = kern
+        self.module = spec.module
+        self.consts = spec.ev.constants
+        s = codec.shape
+        self.R, self.V, self.M = s.R, s.V, s.MAX_MSGS
+        self.MAX_OPS = s.MAX_OPS
+        # entry packing: A01-family packs (value_id << bits) | view
+        from ..models.a01 import ENTRY_VIEW_BITS, A01Codec
+        self.entry_bits = ENTRY_VIEW_BITS if isinstance(codec, A01Codec) \
+            else 0
+        # stack of inlined-operator argument ASTs (bag-walker resolves
+        # `messages`-typed parameters through it)
+        self._ast_args = []
+
+    # -- static encodings ----------------------------------------------
+    def enc_static(self, v, space):
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, int):
+            return v
+        if isinstance(v, ModelValue):
+            if space == "status":
+                return self.codec.status_id[v]
+            if space == "mtype":
+                return self.codec.mtype_id[v]
+            if space == "value":
+                return self.codec.value_id[v]
+            if space == "replica":
+                if v is self.consts.get("Nil"):
+                    return 0
+                if v is self.consts.get("AnyDest"):
+                    from ..models.st03 import ANYDEST
+                    return ANYDEST
+            if v in self.codec.value_id:
+                return self.codec.value_id[v]
+            if v in self.codec.status_id:
+                return self.codec.status_id[v]
+            if v in self.codec.mtype_id:
+                return self.codec.mtype_id[v]
+            if v is self.consts.get("Nil"):
+                return 0
+        raise LowerError(f"cannot encode static {v!r} in space {space}")
+
+    def pack_entry(self, rec, env, st):
+        """Log-entry record DV -> packed int."""
+        f = rec.fields
+        op = f.get("operation")
+        vid = self.as_int(op, space="value")
+        if self.entry_bits:
+            view = self.as_int(f.get("view_number"))
+            return (self._j(vid) << self.entry_bits) | self._j(view)
+        return vid
+
+    def unpack_entry(self, code, field):
+        if self.entry_bits:
+            if field == "operation":
+                return d_int(self._j(code) >> self.entry_bits,
+                             space="value")
+            if field == "view_number":
+                return d_int(self._j(code)
+                             & ((1 << self.entry_bits) - 1))
+            if field == "client_id":
+                return d_static(self.consts.get("Nil"))
+        else:
+            if field == "operation":
+                return d_int(code, space="value")
+        raise LowerError(f"entry field {field} not in packing")
+
+    @staticmethod
+    def _j(x):
+        return jnp.asarray(x, I32) if not isinstance(x, int) else x
+
+    # -- coercions ------------------------------------------------------
+    def as_int(self, dv, space=None):
+        if dv.kind == "int":
+            return dv.v
+        if dv.kind == "bool":
+            return jnp.asarray(dv.v, I32) \
+                if not isinstance(dv.v, bool) else int(dv.v)
+        if dv.kind == "static":
+            return self.enc_static(dv.v, space)
+        if dv.kind == "entry":
+            return dv.v
+        raise LowerError(f"not an int: {dv}")
+
+    def as_bool(self, dv):
+        if dv.kind == "bool":
+            return dv.v
+        if dv.kind == "static":
+            if isinstance(dv.v, bool):
+                return dv.v
+        raise LowerError(f"not a bool: {dv}")
+
+    # ==================================================================
+    # expression compilation
+    # ==================================================================
+    def expr(self, e, env, st):
+        tag = e[0]
+        m = getattr(self, f"_e_{tag}", None)
+        if m is None:
+            raise LowerError(f"cannot lower expression tag {tag!r}")
+        return m(e, env, st)
+
+    # -- leaves ---------------------------------------------------------
+    def _e_num(self, e, env, st):
+        return d_static(e[1])
+
+    def _e_str(self, e, env, st):
+        return d_static(e[1])
+
+    def _e_bool(self, e, env, st):
+        return d_static(e[1])
+
+    def _e_at(self, e, env, st):
+        return env.vars["@"]
+
+    def _e_id(self, e, env, st):
+        name = e[1]
+        if name in env.vars:
+            return env.vars[name]
+        vk = VAR_KINDS.get(name)
+        if vk is not None and name in self.module.variables:
+            kind, plane, space = vk
+            if kind == "glob":
+                return d_int(st[plane], space=space)
+            if kind == "repset_const":
+                return d_static(frozenset(range(1, self.R + 1)))
+            if kind == "bag":
+                return DV("bag")
+            if kind == "auxfn":
+                return DV("auxfn")
+            return DV("statevar", var=name, kind2=kind, plane=plane,
+                      space=space)
+        if name in self.consts:
+            return d_static(self.consts[name])
+        d = self.module.defs.get(name)
+        if d is not None:
+            if d.params:
+                return DV("opdef", d=d, env=env)
+            return self.expr(d.body, env, st)
+        raise LowerError(f"unbound identifier {name}")
+
+    def _e_call(self, e, env, st):
+        _, name, args = e
+        if name == "Len":
+            lg = self.expr(args[0], env, st)
+            return d_int(self._loglen(lg))
+        if name == "Append":
+            lg = self._as_log(self.expr(args[0], env, st))
+            ent = self.expr(args[1], env, st)
+            code = self._entry_code(ent, env, st)
+            pos = jnp.clip(self._j(lg.length), 0, self.MAX_OPS - 1)
+            return d_log(jnp.asarray(lg.arr, I32).at[pos].set(code),
+                         self._j(lg.length) + 1)
+        if name == "Cardinality":
+            s = self.expr(args[0], env, st)
+            if s.kind == "repmask":
+                return d_int(s.bits.sum())
+            if s.kind == "static" and isinstance(s.v, frozenset):
+                return d_static(len(s.v))
+            elems = self._set_elements(s)
+            if elems is not None:
+                n = 0
+                for _el, msk in elems:
+                    n = self._j(n) + (jnp.asarray(msk, I32)
+                                      if msk is not None else 1)
+                return d_int(n)
+            raise LowerError("Cardinality of non-enumerable set")
+        if name == "Quantify":
+            return self._quantify(args[0], args[1], env, st)
+        if name in _BAG_COMBINATORS:
+            raise LowerError(
+                f"{name} outside a messages' update is unsupported")
+        # user operator: inline with evaluated arguments
+        d = self.module.defs.get(name)
+        if d is None:
+            raise LowerError(f"unknown operator {name}")
+        vals = [self.expr(a, env, st) for a in args]
+        return self.expr(d.body, env.bind_many(dict(zip(d.params, vals))),
+                         st)
+
+    # -- state-variable application ------------------------------------
+    def _e_apply(self, e, env, st):
+        _, fe, idx = e
+        f = self.expr(fe, env, st)
+        if f.kind == "statevar":
+            i = self._rep_index(self.expr(idx, env, st))
+            if f.kind2 == "rep":
+                return d_int(st[f.plane][i], space=f.space)
+            if f.kind2 == "replog":
+                return d_log(st[f.plane][i], st["op"][i])
+            if f.kind2 == "repfn":
+                return DV("vecrow", arr=st[f.plane][i])
+        if f.kind == "vecrow":
+            j = self._rep_index(self.expr(idx, env, st))
+            return d_int(f.arr[j])
+        if f.kind == "log":
+            i = self.as_int(self.expr(idx, env, st))
+            pos = jnp.clip(self._j(i) - 1, 0, self.MAX_OPS - 1)
+            return DV("entry", v=jnp.asarray(f.arr, I32)[..., pos])
+        if f.kind == "bag":
+            mref = self.expr(idx, env, st)
+            if mref.kind != "msg":
+                raise LowerError("messages[x] needs a bag-bound x")
+            return d_int(st["m_count"][mref.k])
+        if f.kind == "auxfn":
+            vid = self.as_int(self.expr(idx, env, st), space="value")
+            cell = st["aux_acked"][jnp.clip(self._j(vid) - 1, 0,
+                                            self.V - 1)]
+            return d_bool(cell == 2)
+        raise LowerError(f"cannot apply {f}")
+
+    def _e_dot(self, e, env, st):
+        _, be, fld = e
+        b = self.expr(be, env, st)
+        if b.kind == "msg":
+            return self._msg_field(b, fld, st)
+        if b.kind == "record":
+            return b.fields[fld]
+        if b.kind == "entry":
+            return self.unpack_entry(b.v, fld)
+        raise LowerError(f"cannot read field {fld} of {b}")
+
+    def _msg_field(self, mref, fld, st):
+        k = mref.k
+        if fld == "log":
+            if getattr(k, "ndim", 0) != 0 and not isinstance(k, int):
+                raise LowerError("msg.log needs a scalar message ref")
+            return d_log(st["m_log"][k], st["m_hdr"][k, H_OP])
+        if fld == "message":
+            return DV("entry", v=st["m_entry"][k])
+        col, space = MSG_FIELD_COLS[fld]
+        return d_int(st["m_hdr"][..., col][k] if getattr(k, "ndim", 0)
+                     else st["m_hdr"][k, col], space=space)
+
+    # -- structures -----------------------------------------------------
+    def _e_record(self, e, env, st):
+        return DV("record", fields={n: self.expr(v, env, st)
+                                    for n, v in e[1]})
+
+    def _e_tuple(self, e, env, st):
+        if not e[1]:
+            return d_log(jnp.zeros((self.MAX_OPS,), I32), 0)
+        raise LowerError("non-empty tuple literals unsupported")
+
+    def _e_fnctor(self, e, env, st):
+        _, groups, body = e
+        if len(groups) != 1 or len(groups[0][0]) != 1:
+            raise LowerError("multi-group function constructor")
+        (names, dom) = groups[0]
+        delems = self._set_elements(self.expr(dom, env, st))
+        if delems is None:
+            raise LowerError("function constructor over dynamic domain")
+        vals = []
+        for el, msk in delems:
+            if msk is not None:
+                raise LowerError("masked fnctor domain")
+            v = self.expr(body, env.bind(names[0], el), st)
+            vals.append(self._j(self.as_int(v)))
+        return DV("vec", arr=jnp.stack([jnp.asarray(v, I32)
+                                        for v in vals]))
+
+    def _e_domain(self, e, env, st):
+        b = self.expr(e[1], env, st)
+        if b.kind == "bag":
+            return DV("msgdom")
+        if b.kind == "log":
+            return DV("intrange", lo=d_static(1), hi=d_int(b.length))
+        if b.kind == "auxfn":
+            elems = []
+            for mv, vid in self.codec.value_id.items():
+                elems.append((d_static(mv),
+                              st["aux_acked"][vid - 1] > 0))
+            return DV("maskedset", elems=elems)
+        if b.kind == "statevar":
+            return d_static(frozenset(range(1, self.R + 1)))
+        raise LowerError(f"DOMAIN of {b}")
+
+    # -- operators ------------------------------------------------------
+    def _e_not(self, e, env, st):
+        v = self.expr(e[1], env, st)
+        if v.kind == "static":
+            return d_static(not v.v)
+        return d_bool(~self._jb(self.as_bool(v)))
+
+    def _e_neg(self, e, env, st):
+        v = self.expr(e[1], env, st)
+        if v.kind == "static":
+            return d_static(-v.v)
+        return d_int(-self._j(self.as_int(v)))
+
+    def _e_and(self, e, env, st):
+        out = True
+        for x in e[1]:
+            v = self.expr(x, env, st)
+            if v.kind == "static":
+                if v.v is False:
+                    return d_static(False)
+                continue
+            b = self.as_bool(v)
+            out = b if out is True else (self._jb(out) & self._jb(b))
+        return d_static(True) if out is True else d_bool(out)
+
+    def _e_or(self, e, env, st):
+        out = False
+        for x in e[1]:
+            v = self.expr(x, env, st)
+            if v.kind == "static":
+                if v.v is True:
+                    return d_static(True)
+                continue
+            b = self.as_bool(v)
+            out = b if out is False else (self._jb(out) | self._jb(b))
+        return d_static(False) if out is False else d_bool(out)
+
+    def _e_if(self, e, env, st):
+        _, ce, te, ee = e
+        c = self.expr(ce, env, st)
+        if c.kind == "static":
+            return self.expr(te if c.v else ee, env, st)
+        cb = self._jb(self.as_bool(c))
+        tv = self.expr(te, env, st)
+        ev = self.expr(ee, env, st)
+        return self._select(cb, tv, ev)
+
+    def _e_case(self, e, env, st):
+        _, arms, other = e
+        out = None if other is None else self.expr(other, env, st)
+        for ge, ve in reversed(arms):
+            g = self.expr(ge, env, st)
+            v = self.expr(ve, env, st)
+            if g.kind == "static":
+                out = v if g.v else out
+            else:
+                if out is None:
+                    out = v
+                else:
+                    out = self._select(self._jb(self.as_bool(g)), v, out)
+        return out
+
+    def _select(self, cb, a, b):
+        if a.kind == "log" or b.kind == "log":
+            a, b = self._as_log(a), self._as_log(b)
+            return d_log(jnp.where(cb, a.arr, b.arr),
+                         jnp.where(cb, self._j(a.length),
+                                   self._j(b.length)))
+        if a.kind == "bool" or b.kind == "bool":
+            return d_bool(jnp.where(cb, self._jb(self.as_bool(a)),
+                                    self._jb(self.as_bool(b))))
+        sp = getattr(a, "space", None) or getattr(b, "space", None)
+        return d_int(jnp.where(cb, self._j(self.as_int(a, sp)),
+                               self._j(self.as_int(b, sp))), space=sp)
+
+    def _e_let(self, e, env, st):
+        _, defs, body = e
+        env = self._bind_let(defs, env, st)
+        return self.expr(body, env, st)
+
+    def _bind_let(self, defs, env, st):
+        for d in defs:
+            if d.params:
+                env = env.bind(d.name, DV("opdef", d=d, env=env))
+            else:
+                env = env.bind(d.name, self.expr(d.body, env, st))
+        return env
+
+    def _e_lambda(self, e, env, st):
+        return DV("opdef", d=Def(name="<lambda>", params=e[1], body=e[2]),
+                  env=env)
+
+    # -- binops ---------------------------------------------------------
+    def _e_binop(self, e, env, st):
+        _, op, le, re_ = e
+        if op in ("in", "notin"):
+            r = self.expr(re_, env, st)
+            v = self._membership(le, r, env, st)
+            if op == "notin":
+                return d_static(not v.v) if v.kind == "static" \
+                    else d_bool(~self._jb(v.v))
+            return v
+        a = self.expr(le, env, st)
+        b = self.expr(re_, env, st)
+        if op == "eq":
+            return self._eq(a, b)
+        if op == "ne":
+            v = self._eq(a, b)
+            return d_static(not v.v) if v.kind == "static" \
+                else d_bool(~self._jb(v.v))
+        if op == "range":
+            return DV("intrange", lo=a, hi=b)
+        if op in ("lt", "gt", "le", "ge", "plus", "minus", "mod",
+                  "div", "times"):
+            sp = getattr(a, "space", None) or getattr(b, "space", None)
+            if a.kind == "static" and b.kind == "static":
+                x, y = a.v, b.v
+                return d_static({
+                    "lt": x < y, "gt": x > y, "le": x <= y,
+                    "ge": x >= y, "plus": x + y, "minus": x - y,
+                    "mod": x % y, "div": x // y, "times": x * y}[op])
+            x = self._j(self.as_int(a, sp))
+            y = self._j(self.as_int(b, sp))
+            if op in ("lt", "gt", "le", "ge"):
+                return d_bool({"lt": x < y, "gt": x > y,
+                               "le": x <= y, "ge": x >= y}[op])
+            return d_int({"plus": x + y, "minus": x - y, "mod": x % y,
+                          "div": x // y, "times": x * y}[op],
+                         space=sp)
+        if op == "merge":
+            return DV("mergev", left=a, right=b, le=le, re=re_)
+        if op == "mapsto":
+            return DV("pointfn", key=a, val=b)
+        if op == "setdiff":
+            if a.kind == "static" and b.kind == "static":
+                return d_static(a.v - b.v)
+            if a.kind == "static" and b.kind == "maskedset":
+                raise LowerError("setdiff with dynamic rhs")
+            raise LowerError("setdiff unsupported here")
+        raise LowerError(f"binop {op} unsupported")
+
+    def _membership(self, le, rset, env, st):
+        if rset.kind == "msgdom":
+            el = self.expr(le, env, st)
+            if el.kind == "msg":
+                return d_bool(st["m_present"][el.k] == 1)
+            if el.kind == "record":
+                row = self.record_to_row(el, env, st)
+                return d_bool(self.kern._row_eq(st, row).any())
+            raise LowerError("x \\in DOMAIN messages for non-message x")
+        el = self.expr(le, env, st)
+        if rset.kind == "intrange":
+            x = self._j(self.as_int(el))
+            lo = self.as_int(rset.lo)
+            hi = self.as_int(rset.hi)
+            return d_bool((x >= self._j(lo)) & (x <= self._j(hi)))
+        if rset.kind == "repmask":
+            i = self._rep_index(el)
+            return d_bool(rset.bits[i] == 1)
+        if rset.kind == "maskedset":
+            out = False
+            for sel, msk in rset.elems:
+                hit = self._eq(el, sel)
+                hitb = hit.v if hit.kind != "static" else hit.v
+                term = self._jb(hitb) & self._jb(msk) \
+                    if hit.kind != "static" else \
+                    (self._jb(msk) if hit.v else False)
+                if term is False:
+                    continue
+                out = term if out is False \
+                    else (self._jb(out) | self._jb(term))
+            return d_static(False) if out is False else d_bool(out)
+        if rset.kind == "static" and isinstance(rset.v, frozenset):
+            if el.kind == "static":
+                return d_static(any(tla_eq(el.v, x) for x in rset.v))
+            out = False
+            for x in rset.v:
+                hit = self._eq(el, d_static(x))
+                if hit.kind == "static":
+                    if hit.v:
+                        return d_static(True)
+                    continue
+                out = hit.v if out is False \
+                    else (self._jb(out) | self._jb(hit.v))
+            return d_static(False) if out is False else d_bool(out)
+        raise LowerError(f"membership in {rset}")
+
+    def _eq(self, a, b):
+        if a.kind == "static" and b.kind == "static":
+            return d_static(tla_eq(a.v, b.v))
+        if a.kind == "log" or b.kind == "log":
+            if b.kind == "log" and a.kind != "log":
+                a, b = b, a
+            if b.kind == "static" and b.v == ():
+                return d_bool(self._j(a.length) == 0)
+            b = self._as_log(b)
+            return d_bool((jnp.asarray(a.arr, I32)
+                           == jnp.asarray(b.arr, I32)).all()
+                          & (self._j(a.length) == self._j(b.length)))
+        # int plane (0/1-coded) vs static boolean: compare codes
+        if b.kind == "int" and a.kind == "static" \
+                and isinstance(a.v, bool):
+            a, b = b, a
+        if a.kind == "int" and b.kind == "static" \
+                and isinstance(b.v, bool):
+            return d_bool(self._j(a.v) == int(b.v))
+        if a.kind == "bool" or b.kind == "bool" or (
+                a.kind == "static" and isinstance(a.v, bool)) or (
+                b.kind == "static" and isinstance(b.v, bool)):
+            return d_bool(self._jb(self.as_bool(a) if a.kind != "static"
+                                   else a.v)
+                          == self._jb(self.as_bool(b)
+                                      if b.kind != "static" else b.v))
+        sp = getattr(a, "space", None) or getattr(b, "space", None)
+        return d_bool(self._j(self.as_int(a, sp))
+                      == self._j(self.as_int(b, sp)))
+
+    # -- quantifiers ----------------------------------------------------
+    def _e_exists(self, e, env, st):
+        return self._quant(e[1], e[2], env, st, mode="exists")
+
+    def _e_forall(self, e, env, st):
+        return self._quant(e[1], e[2], env, st, mode="forall")
+
+    def _quant(self, groups, body, env, st, mode):
+        flat = [(n, dom) for names, dom in groups for n in names]
+        return self._quant_rec(flat, body, env, st, mode)
+
+    def _quant_rec(self, flat, body, env, st, mode):
+        if not flat:
+            v = self.expr(body, env, st)
+            if v.kind == "static":
+                return v
+            return d_bool(self._jb(self.as_bool(v)))
+        (name, dom), rest = flat[0], flat[1:]
+        dv = self.expr(dom, env, st)
+        if dv.kind == "msgdom":
+            d = env.depth
+            idx = jnp.arange(self.M, dtype=I32).reshape(
+                (self.M,) + (1,) * d)
+            mask = st["m_present"][idx] == 1
+            mref = d_msg(idx, mask=mask, axis=-(d + 1))
+            inner = self._quant_rec(rest, body, env.deeper()
+                                    .bind(name, mref), st, mode)
+            bi = self._broad(inner)
+            if mode == "exists":
+                return d_bool((mask & bi).any(axis=-(d + 1)))
+            return d_bool((~mask | bi).all(axis=-(d + 1)))
+        if dv.kind == "intrange" and not (
+                dv.lo.kind == "static" and dv.hi.kind == "static"):
+            d = env.depth
+            lo = self.as_int(dv.lo)
+            if not isinstance(lo, int):
+                raise LowerError("dynamic range lower bound")
+            idx = jnp.arange(lo, lo + self.MAX_OPS, dtype=I32).reshape(
+                (self.MAX_OPS,) + (1,) * d)
+            mask = idx <= self._j(self.as_int(dv.hi))
+            inner = self._quant_rec(
+                rest, body,
+                env.deeper().bind(name, d_int(idx)), st, mode)
+            bi = self._broad(inner)
+            if mode == "exists":
+                return d_bool((mask & bi).any(axis=-(d + 1)))
+            return d_bool((~mask | bi).all(axis=-(d + 1)))
+        elems = self._set_elements(dv)
+        if elems is None:
+            raise LowerError(f"cannot enumerate domain {dv}")
+        out = None
+        for el, msk in elems:
+            inner = self._quant_rec(rest, body, env.bind(name, el), st,
+                                    mode)
+            b = inner.v if inner.kind != "static" else inner.v
+            if msk is not None:
+                b = (self._jb(msk) & self._jb(b)) if mode == "exists" \
+                    else (~self._jb(msk) | self._jb(b))
+            if isinstance(b, bool):
+                if mode == "exists" and b:
+                    return d_static(True)
+                if mode == "forall" and not b:
+                    return d_static(False)
+                continue
+            out = b if out is None else (
+                (self._jb(out) | self._jb(b)) if mode == "exists"
+                else (self._jb(out) & self._jb(b)))
+        if out is None:
+            return d_static(mode == "forall")
+        return d_bool(out)
+
+    def _broad(self, dv):
+        return self._jb(self.as_bool(dv)) if dv.kind != "static" \
+            else jnp.asarray(dv.v)
+
+    def _quantify(self, set_e, lam_e, env, st):
+        """Quantify(S, LAMBDA x : P) -> count (FiniteSetsExt)."""
+        lam = self.expr(lam_e, env, st)
+        if lam.kind != "opdef":
+            raise LowerError("Quantify needs a LAMBDA")
+        pname = lam.d.params[0]
+        sdv = self.expr(set_e, env, st)
+        if sdv.kind == "msgdom":
+            d = env.depth
+            idx = jnp.arange(self.M, dtype=I32).reshape(
+                (self.M,) + (1,) * d)
+            mask = st["m_present"][idx] == 1
+            mref = d_msg(idx, mask=mask, axis=-(d + 1))
+            body = self.expr(lam.d.body,
+                             lam.env.deeper().bind(pname, mref), st)
+            bi = self._broad(body)
+            return d_int((mask & bi).sum(axis=-(d + 1), dtype=I32))
+        elems = self._set_elements(sdv)
+        if elems is None:
+            raise LowerError("Quantify over non-enumerable set")
+        n = jnp.asarray(0, I32)
+        for el, msk in elems:
+            b = self.expr(lam.d.body, lam.env.bind(pname, el), st)
+            bi = self._jb(self.as_bool(b)) if b.kind != "static" \
+                else b.v
+            if msk is not None:
+                bi = self._jb(bi) & self._jb(msk)
+            n = n + jnp.asarray(bi, I32)
+        return d_int(n)
+
+    def _e_choose(self, e, env, st):
+        _, var, sexpr, body = e
+        sdv = self.expr(sexpr, env, st)
+        if sdv.kind != "msgdom":
+            raise LowerError("CHOOSE supported over DOMAIN messages only")
+        d = env.depth
+        idx = jnp.arange(self.M, dtype=I32).reshape((self.M,) + (1,) * d)
+        if d != 0:
+            raise LowerError("nested CHOOSE over messages")
+        mask = st["m_present"][idx] == 1
+        mref = d_msg(idx, mask=mask, axis=-(d + 1))
+        b = self.expr(body, env.deeper().bind(var, mref), st)
+        cand = mask & self._broad(b)
+        # deterministic tie-break: min value_key over the record columns
+        # in alphabetical field order (core/values.py FnVal ordering)
+        mtype = self._choose_msg_type(body)
+        cols = []
+        for fld in MSG_TYPE_FIELDS[mtype]:
+            if fld == "log":
+                cols.append(st["m_log"])
+            elif fld == "message":
+                cols.append(st["m_entry"][:, None])
+            else:
+                col, _sp = MSG_FIELD_COLS[fld]
+                cols.append(st["m_hdr"][:, col][:, None])
+        keys = jnp.concatenate([jnp.asarray(c, I32) for c in cols],
+                               axis=1)
+        for c in range(keys.shape[1]):
+            col = jnp.where(cand, keys[:, c], INF)
+            cand = cand & (col == col.min())
+        return d_msg(jnp.argmax(cand).astype(I32))
+
+    def _choose_msg_type(self, body):
+        """Find the `x.type = SomeMsg` constraint that fixes the CHOOSE
+        candidates' record shape (all corpus CHOOSEs have one, possibly
+        through an inlined operator like ValidDvc)."""
+        found = []
+
+        def walk(e, depth=0):
+            if depth > 6 or not isinstance(e, tuple):
+                return
+            if e[0] == "binop" and e[1] == "eq":
+                for a, b in ((e[2], e[3]), (e[3], e[2])):
+                    if (isinstance(a, tuple) and a[0] == "dot"
+                            and a[2] == "type"
+                            and isinstance(b, tuple) and b[0] == "id"):
+                        found.append(b[1])
+            if e[0] in ("call", "id"):
+                dd = self.module.defs.get(e[1])
+                if dd is not None:
+                    walk(dd.body, depth + 1)
+            for x in e:
+                if isinstance(x, tuple):
+                    walk(x, depth)
+                elif isinstance(x, list):
+                    for y in x:
+                        if isinstance(y, tuple):
+                            walk(y, depth)
+        walk(body)
+        for name in found:
+            mv = self.consts.get(name)
+            if mv is not None:
+                for const_name in MSG_TYPE_FIELDS:
+                    if self.consts.get(const_name) is mv:
+                        return const_name
+                if name in MSG_TYPE_FIELDS:
+                    return name
+        if found:
+            return found[0]
+        raise LowerError("CHOOSE over messages without a type constraint")
+
+    # -- helpers --------------------------------------------------------
+    def _set_elements(self, dv):
+        """Static enumeration of a set DV: [(elem_dv, mask_or_None)]."""
+        if dv.kind == "static" and isinstance(dv.v, frozenset):
+            from ..core.values import value_key
+            return [(d_static(x), None)
+                    for x in sorted(dv.v, key=value_key)]
+        if dv.kind == "maskedset":
+            return list(dv.elems)
+        if dv.kind == "intrange":
+            if dv.lo.kind == "static" and dv.hi.kind == "static":
+                return [(d_static(i), None)
+                        for i in range(dv.lo.v, dv.hi.v + 1)]
+            lo = self.as_int(dv.lo)
+            if isinstance(lo, int):
+                hi = self._j(self.as_int(dv.hi))
+                return [(d_static(i), hi >= i)
+                        for i in range(lo, lo + self.MAX_OPS)]
+            return None
+        if dv.kind == "repmask":
+            return [(d_static(r), dv.bits[r - 1] == 1)
+                    for r in range(1, self.R + 1)]
+        return None
+
+    def _rep_index(self, dv):
+        """Replica-valued DV -> clipped 0-based row index."""
+        r = self.as_int(dv, space="replica")
+        if isinstance(r, int):
+            return r - 1
+        return jnp.clip(self._j(r) - 1, 0, self.R - 1)
+
+    def _as_log(self, dv):
+        if dv.kind == "log":
+            return dv
+        if dv.kind == "static" and dv.v == ():
+            return d_log(jnp.zeros((self.MAX_OPS,), I32), 0)
+        raise LowerError(f"not a log: {dv}")
+
+    def _loglen(self, dv):
+        return self._as_log(dv).length
+
+    def _entry_code(self, dv, env, st):
+        if dv.kind == "record":
+            return self._j(self.pack_entry(dv, env, st))
+        if dv.kind == "entry":
+            return self._j(dv.v)
+        raise LowerError(f"not a log entry: {dv}")
+
+    @staticmethod
+    def _jb(x):
+        return jnp.asarray(x, bool) if not isinstance(x, bool) else x
+
+    # ==================================================================
+    # action compilation: binders -> lanes, conjuncts -> guards/updates
+    # ==================================================================
+    def _dims(self, air):
+        sizes = {D_REPLICAS: self.R, D_VALUES: self.V, D_MSGS: self.M,
+                 D_SUBSETS: 1 << self.R}
+        return [sizes[b.domain] for b in air.binders]
+
+    def lane_count(self, air):
+        n = 1
+        for d in self._dims(air):
+            n *= d
+        return n
+
+    def _bind_lanes(self, air, st, lane, guards):
+        """Mixed-radix lane decode (first binder most significant)."""
+        dims = self._dims(air)
+        env = Env()
+        rem = jnp.asarray(lane, I32)
+        for bi, b in enumerate(air.binders):
+            rest = 1
+            for d in dims[bi + 1:]:
+                rest *= d
+            comp = rem // rest
+            rem = rem % rest
+            if b.domain == D_REPLICAS:
+                env = env.bind(b.name, d_int(comp + 1, space="replica"))
+            elif b.domain == D_VALUES:
+                env = env.bind(b.name, d_int(comp + 1, space="value"))
+            elif b.domain == D_MSGS:
+                env = env.bind(b.name, d_msg(comp))
+                guards.append(st["m_present"][comp] == 1)
+            elif b.domain == D_SUBSETS:
+                bits = (comp >> jnp.arange(self.R, dtype=I32)) & 1
+                env = env.bind(b.name, DV("repmask", bits=bits))
+        return env
+
+    def compile_action(self, air):
+        def act(st, lane):
+            guards = []
+            env = self._bind_lanes(air, st, lane, guards)
+            s2 = self._walk(air.body, env, st, dict(st), guards,
+                            build=True)
+            return s2, self._and_all(guards)
+
+        def guard(st, lane):
+            guards = []
+            env = self._bind_lanes(air, st, lane, guards)
+            self._walk(air.body, env, st, None, guards, build=False)
+            return self._and_all(guards)
+
+        rep_idx_ast = self._rep_index_ast(air)
+
+        def lane_rep(st, lane):
+            if rep_idx_ast is None:
+                return jnp.zeros((), I32)
+            env = self._bind_lanes(air, st, lane, [])
+            i = self._rep_index(self.expr(rep_idx_ast, env, st))
+            return jnp.asarray(i, I32)
+
+        return guard, act, lane_rep
+
+    def _and_all(self, guards):
+        out = jnp.asarray(True)
+        for g in guards:
+            if isinstance(g, bool):
+                if not g:
+                    return jnp.asarray(False)
+                continue
+            out = out & self._jb(g)
+        return out
+
+    # -- conjunct walker ------------------------------------------------
+    def _walk(self, node, env, st, s2, guards, build):
+        tag = node[0]
+        if tag == "and":
+            for x in node[1]:
+                s2 = self._walk(x, env, st, s2, guards, build)
+            return s2
+        if tag == "let":
+            return self._walk(node[2], self._bind_let(node[1], env, st),
+                              st, s2, guards, build)
+        if tag == "unchanged":
+            return s2
+        if (tag == "binop" and node[1] == "eq"
+                and isinstance(node[2], tuple)
+                and node[2][0] == "prime"):
+            if node[2][1][0] != "id":
+                raise LowerError("primed non-variable")
+            if build:
+                s2 = self._update(node[2][1][1], node[3], env, st, s2)
+            return s2
+        if tag in ("call", "id") and contains_prime(node, self.module):
+            name = node[1]
+            d = self.module.defs.get(name)
+            if name in env.vars and env.vars[name].kind == "opdef":
+                od = env.vars[name]
+                d, callenv = od.d, od.env
+            elif d is not None:
+                callenv = env
+            else:
+                raise LowerError(f"unknown updater {name}")
+            args = node[2] if tag == "call" else []
+            vals = {p: self.expr(a, env, st)
+                    for p, a in zip(d.params, args)}
+            # syntactic args too: the bag walker needs the ASTs of
+            # `messages`-typed parameters
+            asts = dict(zip(d.params, args))
+            return self._walk_inlined(d.body, callenv.bind_many(vals),
+                                      asts, st, s2, guards, build)
+        if tag == "or" and contains_prime(node, self.module):
+            return self._walk_or(node[1], env, st, s2, guards, build)
+        if tag == "if" and contains_prime(node, self.module):
+            c = self._jb(self.as_bool(self.expr(node[1], env, st)))
+            return self._walk_branches(
+                [(c, node[2]), (None, node[3])], env, st, s2, guards,
+                build)
+        # plain guard conjunct
+        v = self.expr(node, env, st)
+        if v.kind == "static":
+            if v.v is not True:
+                guards.append(False)
+        else:
+            guards.append(self.as_bool(v))
+        return s2
+
+    def _walk_inlined(self, body, env, arg_asts, st, s2, guards, build):
+        """Walk an inlined operator body.  `arg_asts` keeps the callers'
+        argument ASTs so `messages'`-update RHS combinator matching can
+        resolve parameters syntactically."""
+        self._ast_args.append(arg_asts)
+        try:
+            return self._walk(body, env, st, s2, guards, build)
+        finally:
+            self._ast_args.pop()
+
+    def _walk_or(self, branches, env, st, s2, guards, build):
+        conds, subs = [], []
+        for br in branches:
+            g = []
+            sb = self._walk(br, env, st,
+                            dict(s2) if build else None, g, build)
+            conds.append(self._and_all(g))
+            subs.append(sb)
+        en = jnp.asarray(False)
+        for c in conds:
+            en = en | c
+        guards.append(en)
+        if not build:
+            return s2
+        # guard-exclusive branches (corpus invariant): select by guard
+        acc = subs[-1]
+        for c, sb in zip(conds[-2::-1], subs[-2::-1]):
+            acc = {k: jnp.where(c, sb[k], acc[k]) for k in acc}
+        return acc
+
+    def _walk_branches(self, cond_branches, env, st, s2, guards, build):
+        """IF/ELSE with updates: cond_branches = [(c, node), (None,
+        else_node)]."""
+        (c, tnode), (_, enode) = cond_branches
+        gt, ge = [], []
+        s2t = self._walk(tnode, env, st, dict(s2) if build else None,
+                         gt, build)
+        s2e = self._walk(enode, env, st, dict(s2) if build else None,
+                         ge, build)
+        guards.append(jnp.where(c, self._and_all(gt),
+                                self._and_all(ge)))
+        if not build:
+            return s2
+        return {k: jnp.where(c, s2t[k], s2e[k]) for k in s2t}
+
+    # -- updates --------------------------------------------------------
+    def _update(self, var, rhs, env, st, s2):
+        vk = VAR_KINDS.get(var)
+        if vk is None:
+            raise LowerError(f"update to unmapped variable {var}")
+        kind, plane, space = vk
+        if kind == "bag":
+            return self._apply_bag(rhs, env, st, s2)
+        if rhs[0] == "except" and rhs[1] == ("id", var):
+            for path, val_e in rhs[2]:
+                s2 = self._apply_except(kind, plane, space, path, val_e,
+                                        env, st, s2)
+            return s2
+        if kind == "glob":
+            s2[plane] = self._j(self.as_int(self.expr(rhs, env, st),
+                                            space))
+            return s2
+        if kind == "rep" and rhs[0] == "fnctor":
+            if plane in getattr(self.kern, "REP_KEYS", ()):
+                raise LowerError(
+                    f"whole-plane update to hashed per-replica plane "
+                    f"{plane} breaks incremental fingerprints")
+            vec = self.expr(rhs, env, st)
+            s2[plane] = vec.arr
+            return s2
+        if kind == "auxfn" and rhs[0] == "binop" and rhs[1] == "merge" \
+                and rhs[2] == ("id", var) \
+                and rhs[3][0] == "binop" and rhs[3][1] == "mapsto":
+            vid = self._j(self.as_int(
+                self.expr(rhs[3][2], env, st), "value"))
+            bval = self.expr(rhs[3][3], env, st)
+            enc = 2 if (bval.kind == "static" and bval.v is True) else 1
+            idx = jnp.clip(vid - 1, 0, self.V - 1)
+            cur = st[plane][idx]
+            # left-biased @@: only absent keys take the new value
+            s2[plane] = st[plane].at[idx].set(
+                jnp.where(cur == 0, enc, cur))
+            return s2
+        raise LowerError(f"unsupported update form for {var}: {rhs[0]}")
+
+    def _apply_except(self, kind, plane, space, path, val_e, env, st,
+                      s2):
+        if path[0][0] != "idx":
+            raise LowerError("EXCEPT field path on state variable")
+        i = self._rep_index(self.expr(path[0][1], env, st)) \
+            if kind in ("rep", "replog", "repfn") else None
+        if kind == "rep":
+            cur = d_int(st[plane][i], space=space)
+            val = self.expr(val_e, env.bind("@", cur), st)
+            s2[plane] = st[plane].at[i].set(
+                self._j(self.as_int(val, space)))
+            return s2
+        if kind == "replog":
+            cur = d_log(st[plane][i], st["op"][i])
+            val = self._as_log(self.expr(val_e, env.bind("@", cur), st))
+            s2[plane] = st[plane].at[i].set(
+                jnp.asarray(val.arr, I32))
+            return s2
+        if kind == "repfn":
+            if len(path) == 2:
+                j = self._rep_index(self.expr(path[1][1], env, st))
+                cur = d_int(st[plane][i, j])
+                val = self.expr(val_e, env.bind("@", cur), st)
+                s2[plane] = st[plane].at[i, j].set(
+                    self._j(self.as_int(val)))
+                return s2
+            val = self.expr(val_e, env, st)
+            if val.kind != "vec":
+                raise LowerError("row update needs a function value")
+            s2[plane] = st[plane].at[i].set(val.arr)
+            return s2
+        if kind == "auxfn":
+            vid = self._j(self.as_int(self.expr(path[0][1], env, st),
+                                      "value"))
+            bval = self.expr(val_e, env, st)
+            enc = 2 if (bval.kind == "static" and bval.v is True) else 1
+            s2[plane] = st[plane].at[
+                jnp.clip(vid - 1, 0, self.V - 1)].set(enc)
+            return s2
+        raise LowerError(f"EXCEPT on {kind}")
+
+    # -- bag combinators ------------------------------------------------
+    def _apply_bag(self, rhs, env, st, s2):
+        """messages' = <combinator tree> -> base-kernel bag primitives.
+        Recurses into the msgs argument first, so DiscardFunc composed
+        under SendFunc/BroadcastFunc applies in evaluation order."""
+        if rhs == ("id", "messages"):
+            return s2
+        if rhs[0] == "id":
+            # a `messages`-typed parameter of an inlined wrapper: chase
+            # the caller's argument AST
+            for frame in reversed(self._ast_args):
+                if rhs[1] in frame:
+                    return self._apply_bag(frame[rhs[1]], env, st, s2)
+            raise LowerError(f"opaque messages value {rhs[1]}")
+        if rhs[0] != "call":
+            raise LowerError(f"unsupported messages' RHS {rhs[0]}")
+        name, args = rhs[1], rhs[2]
+        if name == "SendFunc":
+            m_e, msgs_e = args[0], args[1]
+            cnt = self.expr(args[2], env, st) if len(args) > 2 \
+                else d_static(1)
+            s2 = self._apply_bag(msgs_e, env, st, s2)
+            rec = self.expr(m_e, env, st)
+            row = self.record_to_row(rec, env, st)
+            return self.kern._bag_send(
+                s2, row, new_count=self._j(self.as_int(cnt)))
+        if name == "BroadcastFunc":
+            msg_e, src_e, msgs_e = args[0], args[1], args[2]
+            s2 = self._apply_bag(msgs_e, env, st, s2)
+            rec = self.expr(msg_e, env, st)
+            row = self.record_to_row(rec, env, st)
+            src = self._j(self.as_int(self.expr(src_e, env, st),
+                                      "replica"))
+            return self.kern._broadcast(s2, row, src)
+        if name == "DiscardFunc":
+            d_e, msgs_e = args[0], args[1]
+            s2 = self._apply_bag(msgs_e, env, st, s2)
+            mref = self.expr(d_e, env, st)
+            if mref.kind != "msg":
+                raise LowerError("DiscardFunc of a non-reference")
+            return self.kern._bag_discard(s2, mref.k)
+        # wrapper operator (Send/Discard/... passed through a LET):
+        d = self.module.defs.get(name)
+        if d is not None:
+            raise LowerError(
+                f"messages' RHS calls {name}; expected the SendFunc/"
+                f"BroadcastFunc/DiscardFunc combinators after inlining")
+        raise LowerError(f"unknown bag combinator {name}")
+
+    # -- static lane->replica analysis ----------------------------------
+    def _rep_index_ast(self, air):
+        """The one replica-index expression used by this action's
+        per-replica-plane updates (None when it touches none) — powers
+        kern.lane_replica for incremental fingerprinting."""
+        found = []
+        rep_planes = set(getattr(self.kern, "REP_KEYS", ()))
+
+        def subst(e, binds):
+            if not isinstance(e, tuple):
+                return e
+            if e[0] == "id" and e[1] in binds:
+                return binds[e[1]]
+            return tuple(
+                subst(x, binds) if isinstance(x, tuple)
+                else ([subst(y, binds) if isinstance(y, tuple) else y
+                       for y in x] if isinstance(x, list) else x)
+                for x in e)
+
+        def walk(e, binds, depth=0):
+            if depth > 8 or not isinstance(e, tuple):
+                return
+            if (e[0] == "binop" and e[1] == "eq"
+                    and isinstance(e[2], tuple)
+                    and e[2][0] == "prime" and e[2][1][0] == "id"):
+                var = e[2][1][1]
+                vk = VAR_KINDS.get(var)
+                if vk and vk[0] in ("rep", "replog", "repfn") \
+                        and vk[1] in rep_planes:
+                    rhs = e[3]
+                    if rhs[0] == "except":
+                        path = rhs[2][0][0]
+                        found.append(subst(path[0][1], binds))
+                    else:
+                        raise LowerError(
+                            f"non-EXCEPT update to hashed plane {var}")
+                return
+            if e[0] in ("call", "id"):
+                d = self.module.defs.get(e[1])
+                if d is not None and contains_prime(d.body, self.module):
+                    args = e[2] if e[0] == "call" else []
+                    nb = dict(zip(d.params,
+                                  [subst(a, binds) for a in args]))
+                    walk(d.body, nb, depth + 1)
+                    return
+            for x in e:
+                if isinstance(x, tuple):
+                    walk(x, binds, depth)
+                elif isinstance(x, list):
+                    for y in x:
+                        if isinstance(y, tuple):
+                            walk(y, binds, depth)
+
+        walk(air.body, {})
+        if not found:
+            return None
+        first = found[0]
+        for f in found[1:]:
+            if f != first:
+                raise LowerError(
+                    f"action {air.name} updates replica planes at "
+                    f"differing indices {first} vs {f}")
+        return first
+
+    # ==================================================================
+    # record -> bag row
+    # ==================================================================
+    def record_to_row(self, rec, env, st):
+        f = rec.fields
+        kw = {}
+        t = f["type"]
+        kw["type_"] = self.enc_static(t.v, "mtype") \
+            if t.kind == "static" else self.as_int(t, "mtype")
+        for fld, dv in f.items():
+            if fld == "type":
+                continue
+            if fld == "message":
+                kw["entry"] = self._entry_code(dv, env, st)
+            elif fld == "log":
+                kw["log"] = jnp.asarray(self._as_log(dv).arr, I32)
+            else:
+                col_kw = {"view_number": "view", "op_number": "op",
+                          "commit_number": "commit", "dest": "dest",
+                          "source": "src", "last_normal_vn": "lnv",
+                          "first_op": "first", "x": "x"}[fld]
+                kw[col_kw] = self._j(self.as_int(
+                    dv, space=MSG_FIELD_COLS[fld][1]))
+        return self.kern._row(**kw)
+
+    # ==================================================================
+    # invariants
+    # ==================================================================
+    def compile_pred(self, body):
+        def pred(st):
+            v = self.expr(body, Env(), st)
+            if v.kind == "static":
+                return jnp.asarray(bool(v.v))
+            return self._jb(self.as_bool(v))
+        return pred
+
+
+# ======================================================================
+# compiled kernel factory
+# ======================================================================
+def make_compiled_model(spec, max_msgs=None):
+    """Build (codec, kernel) where every guard/action/invariant fn is
+    COMPILED FROM THE SPEC AST (ir.extract_action -> Lowerer) instead of
+    hand-written.  The dense layout, bag primitives, fingerprint and
+    lane machinery are inherited from the spec family's base kernel
+    class; the hand kernel remains available separately as the
+    differential oracle (tests/test_lower.py)."""
+    from ..models import registry
+
+    codec_cls, base_cls = registry._resolve(spec.module.name)
+    codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
+    perms = registry.value_perm_table(spec, codec)
+
+    class CompiledKernel(base_cls):
+        compiled_from_ast = True
+
+        def __init__(self, codec, spec, perms):
+            self._spec = spec
+            self._irs = [extract_action(a.name, a.expr)
+                         for a in spec.actions]
+            self.action_names = tuple(ir.name for ir in self._irs)
+            # lane counts are needed by the base __init__ (lane
+            # tables); they only depend on binder domains and shape
+            pre = Lowerer(spec, codec, kern=None)
+            self._lane_counts = {ir.name: pre.lane_count(ir)
+                                 for ir in self._irs}
+            super().__init__(codec, perms=perms)
+            self.lowerer = Lowerer(spec, codec, kern=self)
+            self._cguard, self._cact, self._clanerep = {}, {}, {}
+            for ir in self._irs:
+                g, a, lr = self.lowerer.compile_action(ir)
+                self._cguard[ir.name] = g
+                self._cact[ir.name] = a
+                self._clanerep[ir.name] = lr
+
+        def _lane_count(self, name):
+            return self._lane_counts[name]
+
+        def _guard_fns(self):
+            return [self._cguard[n] for n in self.action_names]
+
+        def _action_fns(self):
+            return [self._cact[n] for n in self.action_names]
+
+        def lane_replica(self, name, st, lane):
+            return self._clanerep[name](st, lane)
+
+        def invariant_fn(self, names):
+            preds = []
+            for n in names:
+                d = self._spec.module.defs.get(n)
+                if d is None:
+                    raise LowerError(f"invariant {n} not defined")
+                preds.append(self.lowerer.compile_pred(d.body))
+
+            def check(st):
+                ok = jnp.asarray(True)
+                for p in preds:
+                    ok = ok & p(st)
+                return ok
+            return check
+
+    return codec, CompiledKernel(codec, spec, perms)

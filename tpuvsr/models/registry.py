@@ -72,7 +72,15 @@ def has_device_model(spec) -> bool:
 
 
 def make_model(spec, max_msgs=None):
-    """Build (codec, kernel) for a bound spec."""
+    """Build (codec, kernel) for a bound spec.
+
+    With TPUVSR_COMPILED=1 the kernel's guard/action/invariant fns are
+    compiled from the spec AST (lower/compile.py) instead of using the
+    hand-written kernel — the hand kernel stays the differential
+    oracle (tests/test_lower.py)."""
+    if os.environ.get("TPUVSR_COMPILED") == "1":
+        from ..lower.compile import make_compiled_model
+        return make_compiled_model(spec, max_msgs=max_msgs)
     codec_cls, kern_cls = _resolve(spec.module.name)
     codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
     return codec, kern_cls(codec, perms=value_perm_table(spec, codec))

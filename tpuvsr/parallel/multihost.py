@@ -90,13 +90,29 @@ def make_replicator(mesh):
     return pull
 
 
-def launch(worker_argv, nproc=2, local_devices=4, port=9761,
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(worker_argv, nproc=2, local_devices=4, port=None,
            timeout=1800, extra_env=None):
     """Spawn `nproc` local worker processes forming one multi-process
     JAX job over the CPU/gloo backend (the DCN-tier test harness).
     Each worker runs `worker_argv` with the TPUVSR_MH_* env set; the
     worker is expected to call init_from_env() first thing.  Returns
-    (returncodes, outputs)."""
+    (returncodes, outputs).
+
+    `port=None` picks a free coordinator port (a fixed default could
+    collide with a concurrent multihost job and hang both until
+    timeout); `timeout` is one shared deadline across the whole pack,
+    not per-process (ADVICE r4)."""
+    if port is None:
+        port = _free_port()
+    import time as _time
+    deadline = _time.monotonic() + timeout
     procs = []
     for pid in range(nproc):
         env = dict(os.environ)
@@ -123,7 +139,8 @@ def launch(worker_argv, nproc=2, local_devices=4, port=9761,
     rcs, outs = [], []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=timeout)
+            out, _ = p.communicate(
+                timeout=max(1.0, deadline - _time.monotonic()))
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
