@@ -1,0 +1,96 @@
+"""Liveness verdicts at the SHIPPED analysis-cfg constants
+(VERDICT r4 item 5).
+
+The reference runs ConvergenceToView / OpEventuallyAllOrNothing at
+R=3, |Values|=2, StartViewOnTimerLimit=2
+(analysis/01-view-changes/*.cfg, loaded UNCHANGED here — the
+constants are not shrunk).  Pipeline: paged-BFS enumeration ->
+device-built behavior graph (CSR edges, gid-valued FPSet) ->
+device-compiled property leaves (lower/compile) -> host fair-SCC.
+
+Writes/merges scripts/liveness_shipped.json.
+
+Usage: [TPUVSR_TPU=1] python scripts/liveness_shipped.py [a01|i01]
+           [max_states] [tile] [chunk_tiles]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import ensure_backend, force_cpu
+
+if os.environ.get("TPUVSR_TPU") == "1":
+    backend = ensure_backend(log=lambda m: print(f"[liveness] {m}",
+                                                 flush=True))
+else:
+    force_cpu()
+    backend = "cpu"
+
+from tpuvsr.engine.device_liveness import DeviceGraph   # noqa: E402
+from tpuvsr.engine.liveness import liveness_check       # noqa: E402
+from tpuvsr.engine.spec import load_spec                # noqa: E402
+
+MODS = {
+    "a01": "VR_ASSUME_NEWVIEWCHANGE",
+    "i01": "VR_INC_RESEND",
+}
+
+which = sys.argv[1] if len(sys.argv) > 1 else "a01"
+max_states = int(sys.argv[2]) if len(sys.argv) > 2 else 30_000_000
+tile = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+chunk_tiles = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+
+REF = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+stem = f"{REF}/analysis/01-view-changes/{MODS[which]}"
+spec = load_spec(f"{stem}.tla", f"{stem}.cfg")
+
+OUT = os.path.join(REPO, "scripts", "liveness_shipped.json")
+results = {}
+if os.path.exists(OUT):
+    with open(OUT) as f:
+        results = json.load(f)
+
+entry = {
+    "module": MODS[which],
+    "config": f"{MODS[which]}.cfg UNCHANGED (R=3, |Values|=2, "
+              f"timer=2, SPECIFICATION LivenessSpec)",
+    "backend": backend,
+    "tile": tile,
+    "properties": list(spec.temporal_props),
+}
+t0 = time.time()
+try:
+    g = DeviceGraph(spec, tile_size=tile, chunk_tiles=chunk_tiles,
+                    max_states=max_states,
+                    fpset_capacity=1 << 24, next_capacity=1 << 17,
+                    log=lambda m: print(f"[liveness] {m}", flush=True))
+    entry.update({
+        "states": g.n,
+        "edges": int(g.csr[1].shape[0]),
+        "graph_build_s": round(g.build_elapsed, 1),
+        "bfs_s": round(g.bfs_elapsed, 1),
+    })
+    res = liveness_check(spec, graph=g,
+                         log=lambda m: print(f"[liveness] {m}",
+                                             flush=True))
+    entry.update({
+        "ok": res.ok,
+        "violated_property": res.property_name,
+        "check_s": round(res.elapsed, 1),
+        "error": res.error,
+        "verdict": ("all temporal properties hold" if res.ok
+                    else f"violated: {res.property_name}"),
+    })
+except Exception as e:  # noqa: BLE001
+    entry["error"] = f"{type(e).__name__}: {e}"
+entry["total_s"] = round(time.time() - t0, 1)
+results[which] = entry
+with open(OUT, "w") as f:
+    json.dump(results, f, indent=1)
+print(json.dumps(entry))

@@ -42,11 +42,11 @@ def worker():
 
     spec = load("VSR", None, {"RestartEmptyLimit": "0"})
     mesh = Mesh(np.array(jax.devices()), ("d",))
-    # bucket_cap 512, not 4096: the exchange wire volume is static in
-    # bucket_cap (D x D x cap rows per committed tile) and the gloo
+    # bucket_cap None (occupancy-calibrated): the exchange wire volume
+    # is cap-bound (D x D x cap rows per committed tile) and the gloo
     # loopback moved ~1.4 GB/tile at 4096 — the first full-fixpoint
     # attempt was wire-bound.  Buckets grow on overflow anyway.
-    eng = ShardedBFS(spec, mesh, tile=64, bucket_cap=512,
+    eng = ShardedBFS(spec, mesh, tile=64, bucket_cap=None,
                      next_capacity=1 << 14, fpset_capacity=1 << 16)
     depth = int(os.environ.get("TPUVSR_MH_DEPTH", "0")) or None
     log = (lambda m: print(f"[rank0] {m}", flush=True)) if pid == 0 \

@@ -1,0 +1,85 @@
+"""Pin the SHIPPED VSR.cfg safety fixpoint (VERDICT r4 item 5).
+
+Every exact pin so far used shrunken constants; the reference's shipped
+flagship config — R=3, C=1, |Values|=2, StartViewOnTimerLimit=2,
+RestartEmptyLimit=0, SYMMETRY symmValues ON, INVARIANT
+AcknowledgedWriteNotLost (VSR.cfg:4-8,29-37, loaded UNCHANGED) — has
+never been run to fixpoint.  This script runs it through the paged
+engine in resumable wall-clock windows (checkpoint scripts/shipped_ckpt)
+and records the fixpoint when reached, or an honest bounded pin.
+
+This is also the first at-scale run with symmetry canonicalization ON
+(|Values|=2 -> min over 2 permutations per fingerprint).
+
+Writes scripts/shipped_pin.json.
+
+Usage: [TPUVSR_TPU=1] python scripts/shipped_pin.py [seconds] [tile]
+           [chunk_tiles]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.platform_select import ensure_backend, force_cpu
+
+if os.environ.get("TPUVSR_TPU") == "1":
+    backend = ensure_backend(log=lambda m: print(f"[shipped] {m}",
+                                                 flush=True))
+else:
+    force_cpu()
+    backend = "cpu"
+
+from tpuvsr.engine.paged_bfs import PagedBFS          # noqa: E402
+from tpuvsr.engine.spec import load_spec              # noqa: E402
+
+seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 1500.0
+tile = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+chunk_tiles = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+
+CKPT = os.path.join(REPO, "scripts", "shipped_ckpt")
+OUT = os.path.join(REPO, "scripts", "shipped_pin.json")
+
+REF = os.environ.get(
+    "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
+spec = load_spec(f"{REF}/VSR.tla", f"{REF}/VSR.cfg")
+assert spec.symmetry_perms, "shipped VSR.cfg declares SYMMETRY"
+
+t0 = time.time()
+eng = PagedBFS(spec, tile_size=tile, chunk_tiles=chunk_tiles,
+               next_capacity=1 << 17, fpset_capacity=1 << 24)
+resume = CKPT if os.path.isdir(CKPT) else None
+if resume:
+    print(f"[shipped] resuming from {CKPT}", flush=True)
+res = eng.run(max_seconds=seconds, resume_from=resume,
+              checkpoint_path=CKPT, checkpoint_every=120.0,
+              log=lambda m: print(f"[shipped] {m}", flush=True))
+elapsed = res.elapsed
+out = {
+    "config": "VSR.cfg UNCHANGED (R=3, C=1, |Values|=2, timer=2, "
+              "restarts=0, SYMMETRY ON, AcknowledgedWriteNotLost)",
+    "engine": "paged",
+    "backend": backend,
+    "symmetry_perms": len(spec.symmetry_perms),
+    "window_s": seconds,
+    "tile": tile,
+    "elapsed_s": round(elapsed, 1),
+    "depth_reached": res.diameter,
+    "distinct_states": res.distinct_states,
+    "states_generated": res.states_generated,
+    "distinct_per_s": round(res.distinct_states / max(elapsed, 1e-9),
+                            1),
+    "fixpoint": res.error is None,
+    "level_sizes_tail": eng.level_sizes[-8:],
+    "n_levels": len(eng.level_sizes),
+    "violated": res.violated_invariant,
+    "error": res.error,
+    "ok": res.ok,
+}
+with open(OUT, "w") as f:
+    json.dump(out, f, indent=1)
+print(json.dumps(out))

@@ -68,6 +68,15 @@ for m in MODULES:
                  [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
                   "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST))
 JOBS += [
+    # shipped-constant runs (VERDICT r4 item 5): liveness verdicts at
+    # the UNCHANGED analysis cfgs, and the shipped VSR.cfg safety pin
+    # (resumable via checkpoint)
+    ("liveness-shipped-a01",
+     [sys.executable, "scripts/liveness_shipped.py",
+      "a01", "30000000", "512", "16"], 3300, ENV_TPU),
+    ("shipped-pin",
+     [sys.executable, "scripts/shipped_pin.py", "1500", "512", "32"],
+     2700, ENV_TPU),
     # walkers max_seconds num — 4096 reuses the calibrated group caps;
     # the wide job then exploits the TPU's parallel headroom
     ("sim-scale",
@@ -93,6 +102,12 @@ JOBS += [
       "-q", "--tb=line"], 5400, ENV_TEST),
     ("rr05-deep-2",
      [sys.executable, "scripts/rr05_deep.py", "1500", "512", "32"],
+     2700, ENV_TPU),
+    ("liveness-shipped-i01",
+     [sys.executable, "scripts/liveness_shipped.py",
+      "i01", "30000000", "512", "16"], 3300, ENV_TPU),
+    ("shipped-pin-2",
+     [sys.executable, "scripts/shipped_pin.py", "1500", "512", "32"],
      2700, ENV_TPU),
 ]
 for m in MODULES:

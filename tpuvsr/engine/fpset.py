@@ -178,6 +178,68 @@ def query_core(table, fps, mask):
     return fresh, unresolved.any()
 
 
+def insert_gids(table, vals, fps, gids, mask):
+    """insert_core that also records a 32-bit value (a graph node id)
+    per fingerprint in the parallel ``vals[CAP]`` array — the device
+    side of the liveness graph's fingerprint->gid index
+    (engine/device_liveness.py).  Batches must not contain duplicate
+    fingerprints (graph nodes are distinct by construction).  Returns
+    (table, vals, overflow, fresh_count)."""
+    table, fresh, ovf = insert_core(table, fps, mask)
+    # each fresh lane re-probes its own chain to find the slot it won
+    # and writes its gid there
+    slots = table["slots"]
+    cap = slots.shape[0]
+    capm = jnp.uint32(cap - 1)
+    keyed, h0 = _keyed(fps)
+
+    def cond(carry):
+        t, unresolved, _v = carry
+        return (t < MAX_PROBES) & unresolved.any()
+
+    def body(carry):
+        t, unresolved, vals = carry
+        idx = (h0 + jnp.uint32(t)) & capm
+        cur = slots[idx]
+        mine = unresolved & (cur[:, :4] == keyed).all(axis=1)
+        vidx = jnp.where(mine, idx, jnp.uint32(cap))
+        vals = vals.at[vidx].set(gids, mode="drop")
+        unresolved = unresolved & ~mine
+        return t + 1, unresolved, vals
+
+    _, _, vals = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), mask & fresh, vals))
+    return table, vals, ovf, fresh.sum(dtype=jnp.int32)
+
+
+def lookup_gids(table, vals, fps, mask):
+    """fps -> stored gid (or -1 when absent/unresolved).  Read-only."""
+    slots = table["slots"]
+    cap = slots.shape[0]
+    capm = jnp.uint32(cap - 1)
+    keyed, h0 = _keyed(fps)
+    n = fps.shape[0]
+
+    def cond(carry):
+        t, unresolved, _o = carry
+        return (t < MAX_PROBES) & unresolved.any()
+
+    def body(carry):
+        t, unresolved, out = carry
+        idx = (h0 + jnp.uint32(t)) & capm
+        cur = slots[idx]
+        mine = unresolved & (cur[:, :4] == keyed).all(axis=1)
+        out = jnp.where(mine, vals[idx].astype(jnp.int32), out)
+        empty = cur[:, 0] == 0
+        unresolved = unresolved & ~mine & ~empty
+        return t + 1, unresolved, out
+
+    _, _, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), mask,
+                     jnp.full((n,), -1, jnp.int32)))
+    return out
+
+
 def grow(table, factor=4):
     """Host-side rebuild into a larger table (on probe overflow or high
     load).  Rare; chunked re-insertion of all occupied slots."""

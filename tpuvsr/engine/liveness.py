@@ -234,7 +234,10 @@ def liveness_check(spec: SpecModel, max_states=None,
             states, edges, inits = _build_graph(spec, max_states)
         elif hasattr(graph, "batch_predicate"):
             dev_graph = graph
-            states, edges, inits = graph.states, graph.edges, graph.inits
+            states, inits = graph.states, graph.inits
+            # don't touch .edges when CSR arrays exist — materializing
+            # the list-of-lists view defeats the array representation
+            edges = None if hasattr(graph, "csr") else graph.edges
         else:
             states, edges, inits = graph
     except TLAError as e:
@@ -242,66 +245,117 @@ def liveness_check(spec: SpecModel, max_states=None,
         res.error = str(e)
         res.elapsed = time.time() - t0
         return res
-    res.distinct_states = len(states)
-    if log:
-        log(f"behavior graph: {len(states)} states, "
-            f"{sum(len(e) for e in edges)} edges")
+    import numpy as np
 
-    wf_groups = _fairness_groups(spec)
+    res.distinct_states = len(states)
     n = len(states)
-    # per-state: which WF actions have a real (state-changing) step
-    enabled = [set() for _ in range(n)]
-    for sid in range(n):
-        for aname, tid in edges[sid]:
-            if tid != sid:
-                enabled[sid].add(aname)
+    wf_groups = _fairness_groups(spec)
+
+    # edge access: CSR arrays when the device graph provides them
+    # (shipped-constant graphs are far too large for list-of-lists),
+    # else the interpreter's list form
+    csr = getattr(dev_graph, "csr", None) if dev_graph else None
+    if csr is not None:
+        indptr, aidv, tidv = csr
+        names = list(dev_graph.kern.action_names)
+        srcv = np.repeat(np.arange(n, dtype=np.int64),
+                         np.diff(indptr))
+        n_edges = int(tidv.shape[0])
+
+        def edges_of(u):
+            return [(names[int(aidv[j])], int(tidv[j]))
+                    for j in range(indptr[u], indptr[u + 1])]
+
+        def succ_tids(u):
+            return tidv[indptr[u]:indptr[u + 1]]
+
+        # vectorized per-group "has a real member step" arrays
+        real = tidv != srcv
+        name_to_aid = {nm: i for i, nm in enumerate(names)}
+        genab = []
+        for _gname, members in wf_groups:
+            maids = np.asarray([name_to_aid[m] for m in members
+                                if m in name_to_aid], np.int32)
+            sel = real & np.isin(aidv, maids)
+            g = np.zeros(n, bool)
+            np.logical_or.at(g, srcv[sel], True)
+            genab.append(g)
+
+        def group_enabled(u, gi):
+            return bool(genab[gi][u])
+    else:
+        n_edges = sum(len(e) for e in edges)
+
+        def edges_of(u):
+            return edges[u]
+
+        def succ_tids(u):
+            return np.asarray([t for _a, t in edges[u]], np.int64)
+
+        enabled = [set() for _ in range(n)]
+        for sid in range(n):
+            for aname, tid in edges[sid]:
+                if tid != sid:
+                    enabled[sid].add(aname)
+
+        def group_enabled(u, gi):
+            return bool(enabled[u] & wf_groups[gi][1])
+
+    if log:
+        log(f"behavior graph: {n} states, {n_edges} edges")
 
     def batch_values(expr, env):
-        """[n] device-batched bools, or None if the leaf has no
-        compiled predicate kernel / has quantifier bindings."""
-        if dev_graph is not None and expr[0] == "id" and env.is_empty():
+        """[n] device-batched bools, or None when the leaf cannot be
+        evaluated on device (no kernel and no AST lowerer)."""
+        if dev_graph is None:
+            return None
+        if expr[0] == "id" and env.is_empty():
             vals = dev_graph.batch_predicate(expr[1])
             if vals is not None:
-                return [bool(v) for v in vals]
+                return np.asarray(vals, bool)
+        if hasattr(dev_graph, "batch_expr"):
+            vals = dev_graph.batch_expr(expr, _flatten_env(env))
+            if vals is not None:
+                return np.asarray(vals, bool)
         return None
 
     def pred_values(expr, env):
         vals = batch_values(expr, env)
         if vals is not None:
             return vals
-        return [_eval_pred(spec, expr, env, states[sid])
-                for sid in range(n)]
+        return np.fromiter(
+            (_eval_pred(spec, expr, env, states[sid])
+             for sid in range(n)), bool, n)
 
     for prop_name in spec.temporal_props:
         for kind, p_expr, q_expr, env in _collect_props(spec, prop_name):
             if kind == "gf":
                 # violation automaton: jump to phase 1 on ~P, stay on ~P
-                bad = [not v for v in pred_values(p_expr, env)]
+                bad = ~pred_values(p_expr, env)
                 seed = bad
             else:
                 # P ~> Q: phase-1 condition is ~Q; the jump additionally
                 # requires P at the jump state — P is evaluated only
                 # where ~Q holds unless a device batch is available
-                bad = [not v for v in pred_values(q_expr, env)]
+                bad = ~pred_values(q_expr, env)
                 pv = batch_values(p_expr, env)
                 if pv is not None:
-                    seed = [bad[sid] and pv[sid] for sid in range(n)]
+                    seed = bad & pv
                 else:
-                    seed = [bad[sid]
-                            and _eval_pred(spec, p_expr, env, states[sid])
-                            for sid in range(n)]
+                    seed = np.asarray(
+                        [bool(bad[sid])
+                         and _eval_pred(spec, p_expr, env, states[sid])
+                         for sid in range(n)], bool)
 
             # phase-1 subgraph: states with bad=True, edges bad->bad
             # (+ implicit stutter self-loops).  A fair cycle inside it
             # reachable from a seed state violates the property.
             def p1_succ(u):
-                return [tid for (_a, tid) in edges[u] if bad[tid]]
+                tt = succ_tids(u)
+                return tt[bad[tt]] if csr is not None else \
+                    [t for t in tt if bad[t]]
 
-            sccs = _tarjan_sccs(n, lambda u: p1_succ(u) if bad[u] else [])
-            comp_of = [-1] * n
-            for ci, comp in enumerate(sccs):
-                for u in comp:
-                    comp_of[u] = ci
+            sccs = _tarjan_sccs(n, lambda u: p1_succ(u) if bad[u] else ())
 
             def cycle_fair(comp):
                 """A fair cycle exists within this (all-bad) SCC iff for
@@ -311,12 +365,12 @@ def liveness_check(spec: SpecModel, max_states=None,
                 all the witnesses.  A singleton SCC is the stuttering
                 lasso, fair iff every WF group is disabled there."""
                 comp_set = set(comp)
-                taken = {a for u in comp for (a, t) in edges[u]
+                taken = {a for u in comp for (a, t) in edges_of(u)
                          if t in comp_set and t != u}
-                for _gname, members in wf_groups:
+                for gi, (_gname, members) in enumerate(wf_groups):
                     if taken & members:
                         continue
-                    if all(enabled[u] & members for u in comp):
+                    if all(group_enabled(u, gi) for u in comp):
                         return False    # group always enabled, no
                                         # member ever taken: unfair
                 return True
@@ -329,8 +383,8 @@ def liveness_check(spec: SpecModel, max_states=None,
                     continue
                 if not cycle_fair(comp):
                     continue
-                path = _find_lasso(spec, states, edges, inits, seed, bad,
-                                   set(comp))
+                path = _find_lasso(spec, states, edges_of, inits, seed,
+                                   bad, set(comp))
                 if path is not None:
                     res.ok = False
                     res.property_name = prop_name
@@ -341,7 +395,19 @@ def liveness_check(spec: SpecModel, max_states=None,
     return res
 
 
-def _find_lasso(spec, states, edges, inits, seed, bad, comp):
+def _flatten_env(env):
+    """interp Env chain -> {name: value} with inner bindings winning."""
+    chain = []
+    while env is not None:
+        chain.append(env.mapping)
+        env = env.parent
+    out = {}
+    for m in reversed(chain):
+        out.update(m)
+    return out
+
+
+def _find_lasso(spec, states, edges_of, inits, seed, bad, comp):
     """BFS init -> seed state s, then bad-only path s -> comp; returns
     (trace_entries, cycle_start_index) or None."""
     from collections import deque
@@ -358,11 +424,11 @@ def _find_lasso(spec, states, edges, inits, seed, bad, comp):
         u = dq.popleft()
         if seed[u]:
             # phase B must reach comp from u via bad states
-            pb = _bad_path(edges, bad, u, comp)
+            pb = _bad_path(edges_of, bad, u, comp)
             if pb is not None:
                 target = (u, pb)
                 break
-        for aname, t in edges[u]:
+        for aname, t in edges_of(u):
             if t not in prev:
                 prev[t] = (u, aname)
                 dq.append(t)
@@ -389,7 +455,7 @@ def _find_lasso(spec, states, edges, inits, seed, bad, comp):
     return entries, max(0, cycle_start)
 
 
-def _bad_path(edges, bad, start, comp):
+def _bad_path(edges_of, bad, start, comp):
     """BFS through bad-states from start into comp; [(sid, action)]."""
     from collections import deque
     if start in comp:
@@ -398,7 +464,7 @@ def _bad_path(edges, bad, start, comp):
     dq = deque([start])
     while dq:
         u = dq.popleft()
-        for aname, t in edges[u]:
+        for aname, t in edges_of(u):
             if bad[t] and t not in prev:
                 prev[t] = (u, aname)
                 if t in comp:

@@ -142,8 +142,11 @@ def d_static(v):
     return DV("static", v=v)
 
 
-def d_log(arr, length):
-    return DV("log", arr=arr, length=length)
+def d_log(arr, length, first=1):
+    """A log-valued function [first..first+length-1 -> entry]: `arr`
+    is the packed-entry row stored 0-based from `first` (the codec's
+    m_log/NewState convention, models/st03.py)."""
+    return DV("log", arr=arr, length=length, first=first)
 
 
 def d_msg(k, mask=None, axis=None):
@@ -325,6 +328,8 @@ class Lowerer:
             return d_int(self._loglen(lg))
         if name == "Append":
             lg = self._as_log(self.expr(args[0], env, st))
+            if not (isinstance(lg.first, int) and lg.first == 1):
+                raise LowerError("Append to a log slice (first != 1)")
             ent = self.expr(args[1], env, st)
             code = self._entry_code(ent, env, st)
             pos = jnp.clip(self._j(lg.length), 0, self.MAX_OPS - 1)
@@ -374,7 +379,8 @@ class Lowerer:
             return d_int(f.arr[j])
         if f.kind == "log":
             i = self.as_int(self.expr(idx, env, st))
-            pos = jnp.clip(self._j(i) - 1, 0, self.MAX_OPS - 1)
+            pos = jnp.clip(self._j(i) - self._j(f.first), 0,
+                           self.MAX_OPS - 1)
             return DV("entry", v=jnp.asarray(f.arr, I32)[..., pos])
         if f.kind == "bag":
             mref = self.expr(idx, env, st)
@@ -404,7 +410,12 @@ class Lowerer:
         if fld == "log":
             if getattr(k, "ndim", 0) != 0 and not isinstance(k, int):
                 raise LowerError("msg.log needs a scalar message ref")
-            return d_log(st["m_log"][k], st["m_hdr"][k, H_OP])
+            # uniform across kinds: DVC/SV carry no first_op (H_FIRST
+            # stays 0 -> first=1, length=op_number); NewState stores
+            # first_op and its m_log row 0-based from it (st03.py)
+            first = jnp.maximum(st["m_hdr"][k, H_FIRST], 1)
+            length = st["m_hdr"][k, H_OP] - first + 1
+            return d_log(st["m_log"][k], length, first=first)
         if fld == "message":
             return DV("entry", v=st["m_entry"][k])
         col, space = MSG_FIELD_COLS[fld]
@@ -426,7 +437,23 @@ class Lowerer:
         if len(groups) != 1 or len(groups[0][0]) != 1:
             raise LowerError("multi-group function constructor")
         (names, dom) = groups[0]
-        delems = self._set_elements(self.expr(dom, env, st))
+        ddv = self.expr(dom, env, st)
+        if ddv.kind == "intrange":
+            # integer-domain constructor = a LOG value (the corpus's
+            # log-slice idiom, e.g. ReceiveGetState's
+            # [on \in m.op_number+1..rep_op_number[r] |-> ...],
+            # ST03:472-474): vectorize the body over positions, store
+            # 0-based from the (possibly traced) lower bound
+            lo = self._j(self.as_int(ddv.lo))
+            hi = self._j(self.as_int(ddv.hi))
+            pos = jnp.arange(self.MAX_OPS, dtype=I32)
+            on = d_int(lo + pos)
+            val = self.expr(body, env.bind(names[0], on), st)
+            codes = self._j(self.as_int(val))
+            n = hi - lo + 1
+            arr = jnp.where(pos < n, codes, 0)
+            return d_log(arr, jnp.maximum(n, 0), first=lo)
+        delems = self._set_elements(ddv)
         if delems is None:
             raise LowerError("function constructor over dynamic domain")
         vals = []
@@ -438,12 +465,29 @@ class Lowerer:
         return DV("vec", arr=jnp.stack([jnp.asarray(v, I32)
                                         for v in vals]))
 
+    def _e_powerset(self, e, env, st):
+        """SUBSET S for a static S (the corpus uses it only over
+        `replicas`, A01:649/747) -> static set of frozensets."""
+        from itertools import combinations
+        from ..core.values import value_key
+        s = self.expr(e[1], env, st)
+        if s.kind == "static" and isinstance(s.v, frozenset):
+            elems = sorted(s.v, key=value_key)
+            subs = [frozenset(c) for r in range(len(elems) + 1)
+                    for c in combinations(elems, r)]
+            return d_static(frozenset(subs))
+        raise LowerError("SUBSET of a dynamic set")
+
     def _e_domain(self, e, env, st):
         b = self.expr(e[1], env, st)
         if b.kind == "bag":
             return DV("msgdom")
         if b.kind == "log":
-            return DV("intrange", lo=d_static(1), hi=d_int(b.length))
+            if isinstance(b.first, int):
+                return DV("intrange", lo=d_static(b.first),
+                          hi=d_int(self._j(b.length) + b.first - 1))
+            return DV("intrange", lo=d_int(b.first),
+                      hi=d_int(self._j(b.length) + self._j(b.first) - 1))
         if b.kind == "auxfn":
             elems = []
             for mv, vid in self.codec.value_id.items():
@@ -521,7 +565,9 @@ class Lowerer:
             a, b = self._as_log(a), self._as_log(b)
             return d_log(jnp.where(cb, a.arr, b.arr),
                          jnp.where(cb, self._j(a.length),
-                                   self._j(b.length)))
+                                   self._j(b.length)),
+                         first=jnp.where(cb, self._j(a.first),
+                                         self._j(b.first)))
         if a.kind == "bool" or b.kind == "bool":
             return d_bool(jnp.where(cb, self._jb(self.as_bool(a)),
                                     self._jb(self.as_bool(b))))
@@ -650,9 +696,13 @@ class Lowerer:
             if b.kind == "static" and b.v == ():
                 return d_bool(self._j(a.length) == 0)
             b = self._as_log(b)
+            # both arrays are stored 0-based from their `first`, so
+            # equal domains = equal (first, length) and positional
+            # array equality
             return d_bool((jnp.asarray(a.arr, I32)
                            == jnp.asarray(b.arr, I32)).all()
-                          & (self._j(a.length) == self._j(b.length)))
+                          & (self._j(a.length) == self._j(b.length))
+                          & (self._j(a.first) == self._j(b.first)))
         # int plane (0/1-coded) vs static boolean: compare codes
         if b.kind == "int" and a.kind == "static" \
                 and isinstance(a.v, bool):
@@ -843,9 +893,9 @@ class Lowerer:
                         return const_name
                 if name in MSG_TYPE_FIELDS:
                     return name
-        if found:
-            return found[0]
-        raise LowerError("CHOOSE over messages without a type constraint")
+        raise LowerError(
+            "CHOOSE over messages without a resolvable type constraint"
+            + (f" (found {found})" if found else ""))
 
     # -- helpers --------------------------------------------------------
     def _set_elements(self, dv):

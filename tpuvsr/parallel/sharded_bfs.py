@@ -21,12 +21,20 @@ fingerprint and 12-byte trace meta) to their owner in ONE all_to_all —
 chosen over a fps-only + verdict-round-trip design because owner-side
 state residence is what keeps the frontier hash-balanced and the next
 level's expansion collective-free; the measured cost is reported per
-run as ``CheckResult.exchange`` (useful vs wire bytes — wire volume is
-static: full ``D x bucket_cap`` buckets move every tile regardless of
-occupancy).  A fps-first exchange that ships only accepted states
-would cut useful bytes by the duplicate fraction at the price of a
-second collective + owner-side re-materialization; revisit if ICI (not
-HBM) ever profiles as the bottleneck.
+run as ``CheckResult.exchange`` (useful vs wire bytes — the wire moves
+full ``D x bucket_cap`` buckets per tile regardless of occupancy).
+
+Because wire volume is cap-bound, the bucket capacity is OCCUPANCY-
+CALIBRATED by default (``bucket_cap=None``): start at a small cap and
+let the existing overflow-pause-grow protocol converge it to the
+run's real high-water bucket occupancy — r4 shipped 24x more bytes
+than it used purely from a worst-case-sized static cap
+(scripts/multihost.json; VERDICT r4 weak item 8).  Pass an explicit
+``bucket_cap`` to pin it (pre-calibrated runs skip the growth
+recompiles).  A fps-first exchange that ships only accepted states
+would additionally cut the duplicate fraction at the price of a second
+collective + owner-side re-materialization; revisit if ICI (not HBM)
+ever profiles as the bottleneck.
 """
 
 from __future__ import annotations
@@ -320,14 +328,18 @@ class ShardedBFS:
     migrate to their owner in the in-level all_to_all."""
 
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
-                 tile=32, bucket_cap=512, next_capacity=1 << 12,
+                 tile=32, bucket_cap=None, next_capacity=1 << 12,
                  fpset_capacity=1 << 14, check_deadlock=False):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
-        self.bucket_cap = bucket_cap
+        # bucket_cap=None: occupancy-calibrated — start minimal and let
+        # R_BUCKET_GROW converge to the run's high-water mark (wire
+        # volume is cap-bound; see module docstring)
+        self.bucket_cap = bucket_cap if bucket_cap is not None \
+            else max(64, tile)
         self.N = next_capacity          # per-device frontier capacity
         self.fp_cap = fpset_capacity    # per-device FPSet slots
         self.inv_names = list(spec.cfg.invariants)
