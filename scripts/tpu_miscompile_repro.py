@@ -261,13 +261,21 @@ def run_subprocess(stage, out_suffix, extra_env):
     env.update(extra_env)
     env["TPUVSR_REPRO_OUT"] = sub_out
     env["TPUVSR_REPRO_BUDGET"] = str(max(60, int(left()) - 30))
-    r = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), stage],
-        env=env, cwd=REPO, timeout=max(120, left()))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), stage],
+            env=env, cwd=REPO, timeout=max(120, left()))
+        rc = r.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
     if os.path.exists(sub_out):
         with open(sub_out) as f:
-            return json.load(f).get(stage)
-    return {"error": f"subprocess rc={r.returncode}, no output"}
+            rec = json.load(f).get(stage)
+        if rec is not None:
+            return rec
+    # an error dict (never None): _errored() then re-attempts the
+    # stage on the next queue run instead of suppressing it forever
+    return {"error": f"subprocess rc={rc}, no stage output"}
 
 
 def _errored(rec):
@@ -301,9 +309,12 @@ def main():
         save()
 
     # conditional follow-ups (skipped when already recorded)
+    def _want(key):
+        return key not in RESULTS or _errored(RESULTS[key])
+
     ins = RESULTS.get("insert")
     insert_bad = isinstance(ins, list) and any(not r["ok"] for r in ins)
-    if insert_bad and "insert_barrier" not in RESULTS and left() > 300:
+    if insert_bad and _want("insert_barrier") and left() > 300:
         log("=== stage insert_barrier (insert failed; testing the "
             "claim-barrier hypothesis)")
         RESULTS["insert_barrier"] = run_subprocess(
@@ -312,7 +323,7 @@ def main():
 
     lv = RESULTS.get("levels")
     levels_bad = isinstance(lv, dict) and not lv.get("ok", True)
-    if levels_bad and "levels_full" not in RESULTS and left() > 900:
+    if levels_bad and _want("levels_full") and left() > 900:
         log("=== stage levels_full (incremental diverged; "
             "discriminating the fingerprint path)")
         try:
@@ -320,7 +331,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULTS["levels_full"] = {"error": f"{type(e).__name__}: {e}"}
         save()
-    if levels_bad and "levels_barrier" not in RESULTS and left() > 900:
+    if levels_bad and _want("levels_barrier") and left() > 900:
         log("=== stage levels_barrier (end-to-end with the claim "
             "barrier)")
         RESULTS["levels_barrier"] = run_subprocess(

@@ -91,10 +91,19 @@ def test_compiled_invariants_match_interpreter(a01):
 
 
 def test_lane_replica_analysis(a01):
+    """The static lane->replica analysis that powers incremental
+    fingerprinting: per-replica-plane-updating actions resolve to a
+    single index expression; NoProgressChange (whole no_prog plane,
+    which is NOT a hashed per-replica plane) resolves to none.  (Its
+    numeric correctness is covered end-to-end by
+    test_compiled_incremental_fingerprints.)"""
     _spec, _cc, kern_c, _ch, _kh, _states = a01
-    # receives resolve to the bound replica; NoProgressChange touches
-    # no hashed per-replica plane
-    assert kern_c._clanerep["NoProgressChange"] is not None
+    by_name = {ir.name: ir for ir in kern_c._irs}
+    low = kern_c.lowerer
+    assert low._rep_index_ast(by_name["NoProgressChange"]) is None
+    for name in ("TimerSendSVC", "SendDVC", "ReceiveSV",
+                 "ReceivePrepareOkMsg", "ExecuteOp"):
+        assert low._rep_index_ast(by_name[name]) is not None, name
 
 
 def st03_spec(values=1, timer=1, np_limit=0):
@@ -191,6 +200,50 @@ def test_st03_compiled_state_transfer_subtree():
         frontier = nxt
     assert exercised == {"SendGetState", "ReceiveGetState",
                          "ReceiveNewState"}
+
+
+def i01_spec(np_limit=0):
+    from tpuvsr.core.values import ModelValue
+    from tpuvsr.engine.spec import SpecModel
+    from tpuvsr.frontend.cfg import parse_cfg_file
+    from tpuvsr.frontend.parser import parse_module_file
+    stem = f"{REF01}/VR_INC_RESEND"
+    mod = parse_module_file(f"{stem}.tla")
+    cfg = parse_cfg_file(f"{stem}.cfg")
+    cfg.constants["Values"] = frozenset({ModelValue("v1")})
+    cfg.constants["StartViewOnTimerLimit"] = 1
+    cfg.constants["NoProgressChangeLimit"] = np_limit
+    cfg.symmetry = None
+    return SpecModel(mod, cfg)
+
+
+def test_i01_compiled_matches_interpreter():
+    """I01 exercises the DVC-tracker lowering: record-set state
+    (setfilter + union updates, Quantify/CHOOSE over tracker rows,
+    I01:245-250, 614-651)."""
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = i01_spec(np_limit=1)
+    codec, kern = make_compiled_model(spec)
+    states = explore_states(spec, 40)
+    for n, st in enumerate(states):
+        want = interp_succs(spec, st)
+        got = kernel_succs(kern, codec, st)
+        assert set(want) == set(got), n
+        for name in want:
+            assert want[name] == got[name], (n, name)
+
+
+@pytest.mark.slow
+def test_i01_compiled_fixpoint_pinned_52635():
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = i01_spec(np_limit=0)
+    eng = DeviceBFS(spec, tile_size=256, fpset_capacity=1 << 20,
+                    next_capacity=1 << 15,
+                    model_factory=make_compiled_model)
+    res = eng.run()
+    assert res.error is None
+    assert res.distinct_states == 52635      # scripts/fixpoints.json
 
 
 @pytest.mark.slow

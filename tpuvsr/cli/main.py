@@ -74,6 +74,12 @@ def build_parser():
                    help="device engine: run the whole fixpoint in O(1)"
                         " dispatches (no per-level host syncs; remote-"
                         "TPU mode; excludes -checkpoint/-recover)")
+    p.add_argument("-lower", action="store_true",
+                   help="compile the device kernel's guards/actions/"
+                        "invariants from the spec AST (tpuvsr/lower) "
+                        "instead of the hand-written kernel; falls "
+                        "back to the hand kernel for modules beyond "
+                        "the lowerer's surface")
     return p
 
 
@@ -103,6 +109,8 @@ def _pick_engine(requested, fpset, spec):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.lower:
+        os.environ["TPUVSR_COMPILED"] = "1"
     from ..engine.spec import load_spec
     from ..engine.trace import format_trace
     from ..platform_select import ensure_backend

@@ -42,6 +42,9 @@ Design decisions (and their honesty boundaries):
 
 from __future__ import annotations
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 from ..core.values import ModelValue, TLAError, tla_eq
@@ -97,6 +100,7 @@ VAR_KINDS = {
     "rep_op_number": ("rep", "op", None),
     "rep_commit_number": ("rep", "commit", None),
     "rep_last_normal_view": ("rep", "lnv", None),
+    "rep_sent_svc": ("rep", "sent_svc", "bool"),
     "rep_sent_dvc": ("rep", "sent_dvc", "bool"),
     "rep_sent_sv": ("rep", "sent_sv", "bool"),
     "no_progress": ("rep", "no_prog", "bool"),
@@ -107,6 +111,18 @@ VAR_KINDS = {
     "aux_client_acked": ("auxfn", "aux_acked", None),
     "messages": ("bag", None, None),
     "replicas": ("repset_const", None, None),
+    # I01's per-replica DVC tracker: a SET of DVC records stored in
+    # [R, R] source-indexed slot planes (models/i01.py)
+    "rep_recv_dvc": ("tracker", "dvc", None),
+}
+
+# tracker element field -> plane (j-indexed inside the replica row);
+# `source`/`dest`/`type` are implicit (slot index / row / constant)
+TRACKER_FIELD_PLANES = {
+    "view_number": "dvc_view",
+    "last_normal_vn": "dvc_lnv",
+    "op_number": "dvc_op",
+    "commit_number": "dvc_commit",
 }
 
 _BAG_COMBINATORS = ("SendFunc", "BroadcastFunc", "DiscardFunc")
@@ -374,6 +390,8 @@ class Lowerer:
                 return d_log(st[f.plane][i], st["op"][i])
             if f.kind2 == "repfn":
                 return DV("vecrow", arr=st[f.plane][i])
+            if f.kind2 == "tracker":
+                return DV("trackrow", i=i)
         if f.kind == "vecrow":
             j = self._rep_index(self.expr(idx, env, st))
             return d_int(f.arr[j])
@@ -403,7 +421,26 @@ class Lowerer:
             return b.fields[fld]
         if b.kind == "entry":
             return self.unpack_entry(b.v, fld)
+        if b.kind == "tdvc":
+            return self._tracker_field(b, fld, st)
         raise LowerError(f"cannot read field {fld} of {b}")
+
+    def _tracker_field(self, ref, fld, st):
+        i, j = ref.i, ref.j
+        if fld == "source":
+            return d_int(self._j(j) + 1, space="replica")
+        if fld == "dest":
+            return d_int(self._j(i) + 1, space="replica")
+        if fld == "type":
+            return d_static(self.consts["DoViewChangeMsg"])
+        if fld == "log":
+            if getattr(j, "ndim", 0) != 0 and not isinstance(j, int):
+                raise LowerError("tracker .log needs a scalar element")
+            return d_log(st["dvc_log"][i, j], st["dvc_op"][i, j])
+        p = TRACKER_FIELD_PLANES.get(fld)
+        if p is None:
+            raise LowerError(f"tracker element has no field {fld}")
+        return d_int(st[p][i][j])
 
     def _msg_field(self, mref, fld, st):
         k = mref.k
@@ -477,6 +514,21 @@ class Lowerer:
                     for c in combinations(elems, r)]
             return d_static(frozenset(subs))
         raise LowerError("SUBSET of a dynamic set")
+
+    def _e_setenum(self, e, env, st):
+        return DV("dvset", elems=[self.expr(x, env, st) for x in e[1]])
+
+    def _e_setfilter(self, e, env, st):
+        _, var, sexpr, pred = e
+        sdv = self.expr(sexpr, env, st)
+        if sdv.kind != "trackrow":
+            raise LowerError("set filter over unsupported domain")
+        idx = jnp.arange(self.R, dtype=I32)
+        mask = st["dvc"][sdv.i][idx] == 1
+        ref = DV("tdvc", i=sdv.i, j=idx, axis=-1)
+        b = self.expr(pred, env.deeper().bind(var, ref), st)
+        return DV("trackset", i=sdv.i, keep=mask & self._broad(b),
+                  adds=[])
 
     def _e_domain(self, e, env, st):
         b = self.expr(e[1], env, st)
@@ -629,6 +681,16 @@ class Lowerer:
             return d_int({"plus": x + y, "minus": x - y, "mod": x % y,
                           "div": x // y, "times": x * y}[op],
                          space=sp)
+        if op == "union":
+            if a.kind == "trackset" and b.kind == "dvset":
+                return DV("trackset", i=a.i, keep=a.keep,
+                          adds=a.adds + b.elems)
+            if a.kind == "dvset" and b.kind == "trackset":
+                return DV("trackset", i=b.i, keep=b.keep,
+                          adds=b.adds + a.elems)
+            if a.kind == "static" and b.kind == "static":
+                return d_static(a.v | b.v)
+            raise LowerError("union of unsupported set kinds")
         if op == "merge":
             return DV("mergev", left=a, right=b, le=le, re=re_)
         if op == "mapsto":
@@ -732,6 +794,23 @@ class Lowerer:
         flat = [(n, dom) for names, dom in groups for n in names]
         return self._quant_rec(flat, body, env, st, mode)
 
+    def _vec_domain(self, dv, st, depth):
+        """Vectorizable quantifier domain -> (idx, mask, ref_dv) with
+        the element axis at -(depth+1), or None.  Covers the message
+        bag and I01's per-replica DVC tracker rows."""
+        if dv.kind == "msgdom":
+            idx = jnp.arange(self.M, dtype=I32).reshape(
+                (self.M,) + (1,) * depth)
+            mask = st["m_present"][idx] == 1
+            return idx, mask, d_msg(idx, mask=mask, axis=-(depth + 1))
+        if dv.kind == "trackrow":
+            idx = jnp.arange(self.R, dtype=I32).reshape(
+                (self.R,) + (1,) * depth)
+            mask = st["dvc"][dv.i][idx] == 1
+            return idx, mask, DV("tdvc", i=dv.i, j=idx,
+                                 axis=-(depth + 1))
+        return None
+
     def _quant_rec(self, flat, body, env, st, mode):
         if not flat:
             v = self.expr(body, env, st)
@@ -740,14 +819,12 @@ class Lowerer:
             return d_bool(self._jb(self.as_bool(v)))
         (name, dom), rest = flat[0], flat[1:]
         dv = self.expr(dom, env, st)
-        if dv.kind == "msgdom":
+        vd = self._vec_domain(dv, st, env.depth)
+        if vd is not None:
             d = env.depth
-            idx = jnp.arange(self.M, dtype=I32).reshape(
-                (self.M,) + (1,) * d)
-            mask = st["m_present"][idx] == 1
-            mref = d_msg(idx, mask=mask, axis=-(d + 1))
+            _idx, mask, ref = vd
             inner = self._quant_rec(rest, body, env.deeper()
-                                    .bind(name, mref), st, mode)
+                                    .bind(name, ref), st, mode)
             bi = self._broad(inner)
             if mode == "exists":
                 return d_bool((mask & bi).any(axis=-(d + 1)))
@@ -803,14 +880,12 @@ class Lowerer:
             raise LowerError("Quantify needs a LAMBDA")
         pname = lam.d.params[0]
         sdv = self.expr(set_e, env, st)
-        if sdv.kind == "msgdom":
+        vd = self._vec_domain(sdv, st, env.depth)
+        if vd is not None:
             d = env.depth
-            idx = jnp.arange(self.M, dtype=I32).reshape(
-                (self.M,) + (1,) * d)
-            mask = st["m_present"][idx] == 1
-            mref = d_msg(idx, mask=mask, axis=-(d + 1))
+            _idx, mask, ref = vd
             body = self.expr(lam.d.body,
-                             lam.env.deeper().bind(pname, mref), st)
+                             lam.env.deeper().bind(pname, ref), st)
             bi = self._broad(body)
             return d_int((mask & bi).sum(axis=-(d + 1), dtype=I32))
         elems = self._set_elements(sdv)
@@ -829,12 +904,15 @@ class Lowerer:
     def _e_choose(self, e, env, st):
         _, var, sexpr, body = e
         sdv = self.expr(sexpr, env, st)
+        if env.depth != 0:
+            raise LowerError("nested CHOOSE")
+        if sdv.kind == "trackrow":
+            return self._choose_tracker(sdv, var, body, env, st)
         if sdv.kind != "msgdom":
-            raise LowerError("CHOOSE supported over DOMAIN messages only")
+            raise LowerError(
+                "CHOOSE supported over DOMAIN messages / DVC trackers")
         d = env.depth
         idx = jnp.arange(self.M, dtype=I32).reshape((self.M,) + (1,) * d)
-        if d != 0:
-            raise LowerError("nested CHOOSE over messages")
         mask = st["m_present"][idx] == 1
         mref = d_msg(idx, mask=mask, axis=-(d + 1))
         b = self.expr(body, env.deeper().bind(var, mref), st)
@@ -857,6 +935,30 @@ class Lowerer:
             col = jnp.where(cand, keys[:, c], INF)
             cand = cand & (col == col.min())
         return d_msg(jnp.argmax(cand).astype(I32))
+
+    def _choose_tracker(self, trow, var, body, env, st):
+        """Deterministic CHOOSE over a DVC tracker row: min value_key
+        among candidates, over the record columns in alphabetical field
+        order (commit_number, dest=const, last_normal_vn, log,
+        op_number, source, type=const, view_number)."""
+        i = trow.i
+        idx = jnp.arange(self.R, dtype=I32)
+        mask = st["dvc"][i][idx] == 1
+        ref = DV("tdvc", i=i, j=idx, axis=-1)
+        b = self.expr(body, env.deeper().bind(var, ref), st)
+        cand = mask & self._broad(b)
+        cols = [st["dvc_commit"][i][:, None],
+                st["dvc_lnv"][i][:, None],
+                st["dvc_log"][i],
+                st["dvc_op"][i][:, None],
+                (idx + 1)[:, None],          # source
+                st["dvc_view"][i][:, None]]
+        keys = jnp.concatenate([jnp.asarray(c, I32) for c in cols],
+                               axis=1)
+        for c in range(keys.shape[1]):
+            col = jnp.where(cand, keys[:, c], INF)
+            cand = cand & (col == col.min())
+        return DV("tdvc", i=i, j=jnp.argmax(cand).astype(I32))
 
     def _choose_msg_type(self, body):
         """Find the `x.type = SomeMsg` constraint that fixes the CHOOSE
@@ -1150,8 +1252,7 @@ class Lowerer:
                 and rhs[3][0] == "binop" and rhs[3][1] == "mapsto":
             vid = self._j(self.as_int(
                 self.expr(rhs[3][2], env, st), "value"))
-            bval = self.expr(rhs[3][3], env, st)
-            enc = 2 if (bval.kind == "static" and bval.v is True) else 1
+            enc = self._aux_bool_enc(self.expr(rhs[3][3], env, st))
             idx = jnp.clip(vid - 1, 0, self.V - 1)
             cur = st[plane][idx]
             # left-biased @@: only absent keys take the new value
@@ -1165,7 +1266,7 @@ class Lowerer:
         if path[0][0] != "idx":
             raise LowerError("EXCEPT field path on state variable")
         i = self._rep_index(self.expr(path[0][1], env, st)) \
-            if kind in ("rep", "replog", "repfn") else None
+            if kind in ("rep", "replog", "repfn", "tracker") else None
         if kind == "rep":
             cur = d_int(st[plane][i], space=space)
             val = self.expr(val_e, env.bind("@", cur), st)
@@ -1194,12 +1295,78 @@ class Lowerer:
         if kind == "auxfn":
             vid = self._j(self.as_int(self.expr(path[0][1], env, st),
                                       "value"))
-            bval = self.expr(val_e, env, st)
-            enc = 2 if (bval.kind == "static" and bval.v is True) else 1
+            enc = self._aux_bool_enc(self.expr(val_e, env, st))
             s2[plane] = st[plane].at[
                 jnp.clip(vid - 1, 0, self.V - 1)].set(enc)
             return s2
+        if kind == "tracker":
+            cur = DV("trackrow", i=i)
+            val = self.expr(val_e, env.bind("@", cur), st)
+            return self._tracker_assign(i, val, st, s2)
         raise LowerError(f"EXCEPT on {kind}")
+
+    @staticmethod
+    def _aux_bool_enc(bval):
+        """aux_client_acked cell encoding (absent=0/FALSE=1/TRUE=2).
+        Only literal booleans appear in the corpus; anything traced
+        must raise (fail-loud contract), not silently encode FALSE."""
+        if bval.kind == "static" and isinstance(bval.v, bool):
+            return 2 if bval.v else 1
+        raise LowerError(
+            "aux_client_acked updates support literal TRUE/FALSE only")
+
+    TRACKER_PLANES = ("dvc", "dvc_view", "dvc_lnv", "dvc_op",
+                      "dvc_commit", "dvc_log")
+
+    def _tracker_assign(self, i, val, st, s2):
+        """rep_recv_dvc[r] := {} / filtered-set ∪ {elements}.  Dropped
+        slots are ZEROED in every plane (non-present slots must be
+        all-zero or the per-replica row hash loses canonicity)."""
+        if val.kind == "dvset" and not val.elems:
+            keep = jnp.zeros((self.R,), bool)
+            adds = []
+        elif val.kind == "trackset":
+            keep, adds = val.keep, val.adds
+        else:
+            raise LowerError(f"unsupported tracker value {val}")
+        rows = {}
+        for p in self.TRACKER_PLANES:
+            row = st[p][i]
+            km = keep if row.ndim == 1 else keep[:, None]
+            rows[p] = jnp.where(km, row, 0)
+        for el in adds:
+            f = self._tracker_insert_fields(el, st)
+            j = jnp.clip(f["j"], 0, self.R - 1)
+            rows["dvc"] = rows["dvc"].at[j].set(1)
+            rows["dvc_view"] = rows["dvc_view"].at[j].set(f["view"])
+            rows["dvc_lnv"] = rows["dvc_lnv"].at[j].set(f["lnv"])
+            rows["dvc_op"] = rows["dvc_op"].at[j].set(f["op"])
+            rows["dvc_commit"] = rows["dvc_commit"].at[j].set(
+                f["commit"])
+            rows["dvc_log"] = rows["dvc_log"].at[j].set(f["log"])
+        for p in self.TRACKER_PLANES:
+            s2[p] = st[p].at[i].set(rows[p])
+        return s2
+
+    def _tracker_insert_fields(self, el, st):
+        if el.kind == "msg":
+            k = el.k
+            hdr = st["m_hdr"][k]
+            return {"j": hdr[H_SRC] - 1, "view": hdr[H_VIEW],
+                    "lnv": hdr[H_LNV], "op": hdr[H_OP],
+                    "commit": hdr[H_COMMIT],
+                    "log": jnp.asarray(st["m_log"][k], I32)}
+        if el.kind == "record":
+            f = el.fields
+            lg = self._as_log(f["log"])
+            return {
+                "j": self._j(self.as_int(f["source"], "replica")) - 1,
+                "view": self._j(self.as_int(f["view_number"])),
+                "lnv": self._j(self.as_int(f["last_normal_vn"])),
+                "op": self._j(self.as_int(f["op_number"])),
+                "commit": self._j(self.as_int(f["commit_number"])),
+                "log": jnp.asarray(lg.arr, I32)}
+        raise LowerError(f"cannot insert {el} into a DVC tracker")
 
     # -- bag combinators ------------------------------------------------
     def _apply_bag(self, rhs, env, st, s2):
@@ -1277,7 +1444,8 @@ class Lowerer:
                     and e[2][0] == "prime" and e[2][1][0] == "id"):
                 var = e[2][1][1]
                 vk = VAR_KINDS.get(var)
-                if vk and vk[0] in ("rep", "replog", "repfn") \
+                if vk and vk[0] in ("rep", "replog", "repfn",
+                                    "tracker") \
                         and vk[1] in rep_planes:
                     rhs = e[3]
                     if rhs[0] == "except":
@@ -1388,6 +1556,23 @@ def make_compiled_model(spec, max_msgs=None):
                 self._cguard[ir.name] = g
                 self._cact[ir.name] = a
                 self._clanerep[ir.name] = lr
+            # fail FAST on unsupported constructs: abstractly trace
+            # every action now (cheap — no compilation), so a module
+            # beyond the lowerer's surface raises LowerError at build
+            # time instead of at first kernel dispatch
+            zero = {k: jax.ShapeDtypeStruct(np.shape(v), jnp.int32)
+                    for k, v in codec.zero_state().items()}
+            lane = jax.ShapeDtypeStruct((), jnp.int32)
+            for ir in self._irs:
+                try:
+                    jax.eval_shape(self._cact[ir.name], zero, lane)
+                    jax.eval_shape(self._cguard[ir.name], zero, lane)
+                except LowerError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    raise LowerError(
+                        f"action {ir.name} failed abstract tracing: "
+                        f"{type(e).__name__}: {e}") from e
 
         def _lane_count(self, name):
             return self._lane_counts[name]

@@ -79,8 +79,17 @@ def make_model(spec, max_msgs=None):
     hand-written kernel — the hand kernel stays the differential
     oracle (tests/test_lower.py)."""
     if os.environ.get("TPUVSR_COMPILED") == "1":
+        from ..core.values import TLAError
         from ..lower.compile import make_compiled_model
-        return make_compiled_model(spec, max_msgs=max_msgs)
+        try:
+            return make_compiled_model(spec, max_msgs=max_msgs)
+        except TLAError as e:
+            # modules beyond the lowerer's current layout surface
+            # (I01/AS04/recovery-era vars) degrade to the hand kernel
+            import sys
+            print(f"[tpuvsr] TPUVSR_COMPILED=1: {spec.module.name} "
+                  f"not yet lowerable ({e}); using the hand kernel",
+                  file=sys.stderr)
     codec_cls, kern_cls = _resolve(spec.module.name)
     codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
     return codec, kern_cls(codec, perms=value_perm_table(spec, codec))
