@@ -233,6 +233,58 @@ def test_i01_compiled_matches_interpreter():
             assert want[name] == got[name], (n, name)
 
 
+def as04_spec(values=1, timer=1, np_limit=0):
+    from tpuvsr.core.values import ModelValue
+    from tpuvsr.engine.spec import SpecModel
+    from tpuvsr.frontend.cfg import parse_cfg_file
+    from tpuvsr.frontend.parser import parse_module_file
+    stem = (f"{REFERENCE}/analysis/04-application-state/"
+            f"VR_APP_STATE")
+    mod = parse_module_file(f"{stem}.tla")
+    cfg = parse_cfg_file(f"{stem}.cfg")
+    cfg.constants["Values"] = frozenset(
+        ModelValue(f"v{i + 1}") for i in range(values))
+    cfg.constants["StartViewOnTimerLimit"] = timer
+    cfg.constants["NoProgressChangeLimit"] = np_limit
+    cfg.symmetry = None
+    return SpecModel(mod, cfg)
+
+
+def test_as04_compiled_matches_interpreter():
+    """AS04 exercises the RECURSIVE-operator unroll (AppendOps,
+    AS04:270-275), the app-state log plane (length = commit_number),
+    and the implied-view DVC tracker."""
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = as04_spec(values=2)
+    codec, kern = make_compiled_model(spec)
+    states = explore_states(spec, 40)
+    # include app-state-rich states so the unrolled executor is hit
+    states = states + sorted(
+        explore_states(spec, 800),
+        key=lambda st: sum(len(a) for _r, a in
+                           st["rep_app_state"].items),
+        reverse=True)[:15]
+    for n, st in enumerate(states):
+        want = interp_succs(spec, st)
+        got = kernel_succs(kern, codec, st)
+        assert set(want) == set(got), n
+        for name in want:
+            assert want[name] == got[name], (n, name)
+
+
+@pytest.mark.slow
+def test_as04_compiled_fixpoint_pinned_42738():
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = as04_spec()
+    eng = DeviceBFS(spec, tile_size=256, fpset_capacity=1 << 20,
+                    next_capacity=1 << 15,
+                    model_factory=make_compiled_model)
+    res = eng.run()
+    assert res.error is None
+    assert res.distinct_states == 42738      # scripts/fixpoints.json
+
+
 @pytest.mark.slow
 def test_i01_compiled_fixpoint_pinned_52635():
     from tpuvsr.engine.device_bfs import DeviceBFS

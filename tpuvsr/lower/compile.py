@@ -104,7 +104,11 @@ VAR_KINDS = {
     "rep_sent_dvc": ("rep", "sent_dvc", "bool"),
     "rep_sent_sv": ("rep", "sent_sv", "bool"),
     "no_progress": ("rep", "no_prog", "bool"),
-    "rep_log": ("replog", "log", None),
+    # replog entries carry their LENGTH PLANE in the third slot:
+    # Len(rep_log) == rep_op_number and Len(rep_app_state) ==
+    # rep_commit_number are layout invariants (models/st03.py, as04.py)
+    "rep_log": ("replog", "log", "op"),
+    "rep_app_state": ("replog", "app", "commit"),
     "rep_peer_op_number": ("repfn", "peer_op", None),
     "no_progress_ctr": ("glob", "np_ctr", None),
     "aux_svc": ("glob", "aux_svc", None),
@@ -208,6 +212,12 @@ class Lowerer:
         # stack of inlined-operator argument ASTs (bag-walker resolves
         # `messages`-typed parameters through it)
         self._ast_args = []
+        # which dense planes this codec family carries (AS04's DVC
+        # tracker elides the view column — implied = View(dest))
+        self.planes = set(codec.zero_state().keys())
+        # bounded-recursion unroll state (RECURSIVE operators)
+        self._rec_depth = {}
+        self._rec_cut = set()
 
     # -- static encodings ----------------------------------------------
     def enc_static(self, v, space):
@@ -370,13 +380,87 @@ class Lowerer:
         if name in _BAG_COMBINATORS:
             raise LowerError(
                 f"{name} outside a messages' update is unsupported")
-        # user operator: inline with evaluated arguments
+        # user operator: inline with evaluated arguments.  LET-defined
+        # operators resolve through env.vars and must inline in their
+        # CAPTURED env (lexical scoping), not the caller's
         d = self.module.defs.get(name)
+        defenv = env
+        if d is None and name in env.vars \
+                and env.vars[name].kind == "opdef":
+            od = env.vars[name]
+            d, defenv = od.d, od.env
         if d is None:
             raise LowerError(f"unknown operator {name}")
         vals = [self.expr(a, env, st) for a in args]
-        return self.expr(d.body, env.bind_many(dict(zip(d.params, vals))),
-                         st)
+        inner = defenv.bind_many(dict(zip(d.params, vals)))
+        if getattr(d, "recursive", False):
+            # bounded unroll with a cutoff that forces the base IF-arm
+            # (see _e_if).  The prune is only sound for counter-stepped
+            # recursion whose span the layout bounds (log positions are
+            # clipped to MAX_OPS) — verify the SHAPE at least: some
+            # parameter must step by +-1 in the recursive call and be
+            # referenced by a stopping condition, else fail loud
+            # instead of silently truncating an unbounded recursion.
+            self._check_counter_recursion(name, d)
+            depth = self._rec_depth.get(name, 0)
+            if depth > self.MAX_OPS + 2:
+                raise LowerError(
+                    f"recursion in {name} exceeded the unroll bound")
+            self._rec_depth[name] = depth + 1
+            if depth == self.MAX_OPS + 1:
+                self._rec_cut.add(name)
+            try:
+                return self.expr(d.body, inner, st)
+            finally:
+                self._rec_depth[name] = depth
+                self._rec_cut.discard(name)
+        return self.expr(d.body, inner, st)
+
+    def _check_counter_recursion(self, name, d):
+        """Structural soundness check for the bounded unroll: the
+        recursive self-call must step some parameter by +-1 (the
+        counter), giving the IF cutoff a data-bounded span.  Memoized
+        per operator."""
+        ok = getattr(self, "_rec_shape_ok", None)
+        if ok is None:
+            ok = self._rec_shape_ok = {}
+        if name in ok:
+            if not ok[name]:
+                raise LowerError(
+                    f"RECURSIVE {name} is not counter-stepped "
+                    f"recursion; bounded unroll would be unsound")
+            return
+
+        calls = []
+
+        def find(e):
+            if not isinstance(e, tuple):
+                return
+            if e[0] == "call" and e[1] == name:
+                calls.append(e[2])
+            for x in e:
+                if isinstance(x, tuple):
+                    find(x)
+                elif isinstance(x, list):
+                    for y in x:
+                        if isinstance(y, tuple):
+                            find(y)
+        find(d.body)
+
+        def stepped(arg, param):
+            return (isinstance(arg, tuple) and arg[0] == "binop"
+                    and arg[1] in ("plus", "minus")
+                    and arg[2] == ("id", param)
+                    and arg[3] == ("num", 1))
+
+        good = bool(calls) and all(
+            any(stepped(a, p) for a, p in zip(cargs, d.params))
+            for cargs in calls)
+        ok[name] = good
+        if not good:
+            raise LowerError(
+                f"RECURSIVE {name} is not counter-stepped recursion; "
+                f"bounded unroll would be unsound")
 
     # -- state-variable application ------------------------------------
     def _e_apply(self, e, env, st):
@@ -387,7 +471,7 @@ class Lowerer:
             if f.kind2 == "rep":
                 return d_int(st[f.plane][i], space=f.space)
             if f.kind2 == "replog":
-                return d_log(st[f.plane][i], st["op"][i])
+                return d_log(st[f.plane][i], st[f.space][i])
             if f.kind2 == "repfn":
                 return DV("vecrow", arr=st[f.plane][i])
             if f.kind2 == "tracker":
@@ -433,6 +517,9 @@ class Lowerer:
             return d_int(self._j(i) + 1, space="replica")
         if fld == "type":
             return d_static(self.consts["DoViewChangeMsg"])
+        if fld == "view_number" and "dvc_view" not in self.planes:
+            # AS04-style tracker: view is implied = View(dest)
+            return d_int(st["view"][i])
         if fld == "log":
             if getattr(j, "ndim", 0) != 0 and not isinstance(j, int):
                 raise LowerError("tracker .log needs a scalar element")
@@ -589,6 +676,14 @@ class Lowerer:
 
     def _e_if(self, e, env, st):
         _, ce, te, ee = e
+        if self._rec_cut:
+            # recursion-cutoff level: the arm containing the recursive
+            # call is unreachable (the unroll bound exceeds the data
+            # bound) — compile only the base arm
+            t_rec = any(self._refs_name(te, n) for n in self._rec_cut)
+            e_rec = any(self._refs_name(ee, n) for n in self._rec_cut)
+            if t_rec != e_rec:
+                return self.expr(ee if t_rec else te, env, st)
         c = self.expr(ce, env, st)
         if c.kind == "static":
             return self.expr(te if c.v else ee, env, st)
@@ -596,6 +691,22 @@ class Lowerer:
         tv = self.expr(te, env, st)
         ev = self.expr(ee, env, st)
         return self._select(cb, tv, ev)
+
+    @staticmethod
+    def _refs_name(e, name):
+        if not isinstance(e, tuple):
+            return False
+        if e[0] in ("call", "id") and len(e) > 1 and e[1] == name:
+            return True
+        for x in e:
+            if isinstance(x, tuple) and Lowerer._refs_name(x, name):
+                return True
+            if isinstance(x, list):
+                for y in x:
+                    if isinstance(y, tuple) and \
+                            Lowerer._refs_name(y, name):
+                        return True
+        return False
 
     def _e_case(self, e, env, st):
         _, arms, other = e
@@ -682,6 +793,12 @@ class Lowerer:
                           "div": x // y, "times": x * y}[op],
                          space=sp)
         if op == "union":
+            if a.kind == "trackrow":       # `@ \union {m}` (AS04:685)
+                a = DV("trackset", i=a.i,
+                       keep=st["dvc"][a.i] == 1, adds=[])
+            if b.kind == "trackrow":
+                b = DV("trackset", i=b.i,
+                       keep=st["dvc"][b.i] == 1, adds=[])
             if a.kind == "trackset" and b.kind == "dvset":
                 return DV("trackset", i=a.i, keep=a.keep,
                           adds=a.adds + b.elems)
@@ -951,8 +1068,11 @@ class Lowerer:
                 st["dvc_lnv"][i][:, None],
                 st["dvc_log"][i],
                 st["dvc_op"][i][:, None],
-                (idx + 1)[:, None],          # source
-                st["dvc_view"][i][:, None]]
+                (idx + 1)[:, None]]          # source
+        if "dvc_view" in self.planes:
+            cols.append(st["dvc_view"][i][:, None])
+        # (an implied view column is equal across all candidates and
+        # cannot affect the tie-break)
         keys = jnp.concatenate([jnp.asarray(c, I32) for c in cols],
                                axis=1)
         for c in range(keys.shape[1]):
@@ -1274,7 +1394,7 @@ class Lowerer:
                 self._j(self.as_int(val, space)))
             return s2
         if kind == "replog":
-            cur = d_log(st[plane][i], st["op"][i])
+            cur = d_log(st[plane][i], st[space][i])
             val = self._as_log(self.expr(val_e, env.bind("@", cur), st))
             s2[plane] = st[plane].at[i].set(
                 jnp.asarray(val.arr, I32))
@@ -1315,22 +1435,31 @@ class Lowerer:
         raise LowerError(
             "aux_client_acked updates support literal TRUE/FALSE only")
 
-    TRACKER_PLANES = ("dvc", "dvc_view", "dvc_lnv", "dvc_op",
-                      "dvc_commit", "dvc_log")
+    ALL_TRACKER_PLANES = ("dvc", "dvc_view", "dvc_lnv", "dvc_op",
+                          "dvc_commit", "dvc_log")
+
+    def tracker_planes(self):
+        return tuple(p for p in self.ALL_TRACKER_PLANES
+                     if p in self.planes)
 
     def _tracker_assign(self, i, val, st, s2):
-        """rep_recv_dvc[r] := {} / filtered-set ∪ {elements}.  Dropped
-        slots are ZEROED in every plane (non-present slots must be
-        all-zero or the per-replica row hash loses canonicity)."""
-        if val.kind == "dvset" and not val.elems:
+        """rep_recv_dvc[r] := {} / {elements} / filtered-set ∪
+        {elements}.  Dropped slots are ZEROED in every plane
+        (non-present slots must be all-zero or the per-replica row hash
+        loses canonicity)."""
+        if val.kind == "dvset":
             keep = jnp.zeros((self.R,), bool)
-            adds = []
+            adds = list(val.elems)
         elif val.kind == "trackset":
             keep, adds = val.keep, val.adds
         else:
             raise LowerError(f"unsupported tracker value {val}")
+        planes = self.tracker_planes()
+        plane_field = {"dvc_view": "view", "dvc_lnv": "lnv",
+                       "dvc_op": "op", "dvc_commit": "commit",
+                       "dvc_log": "log"}
         rows = {}
-        for p in self.TRACKER_PLANES:
+        for p in planes:
             row = st[p][i]
             km = keep if row.ndim == 1 else keep[:, None]
             rows[p] = jnp.where(km, row, 0)
@@ -1338,13 +1467,9 @@ class Lowerer:
             f = self._tracker_insert_fields(el, st)
             j = jnp.clip(f["j"], 0, self.R - 1)
             rows["dvc"] = rows["dvc"].at[j].set(1)
-            rows["dvc_view"] = rows["dvc_view"].at[j].set(f["view"])
-            rows["dvc_lnv"] = rows["dvc_lnv"].at[j].set(f["lnv"])
-            rows["dvc_op"] = rows["dvc_op"].at[j].set(f["op"])
-            rows["dvc_commit"] = rows["dvc_commit"].at[j].set(
-                f["commit"])
-            rows["dvc_log"] = rows["dvc_log"].at[j].set(f["log"])
-        for p in self.TRACKER_PLANES:
+            for p in planes[1:]:
+                rows[p] = rows[p].at[j].set(f[plane_field[p]])
+        for p in planes:
             s2[p] = st[p].at[i].set(rows[p])
         return s2
 
