@@ -10,6 +10,9 @@ parsed VR_ASSUME_NEWVIEWCHANGE.tla) is held to three oracles:
      tier) through the unmodified DeviceBFS engine.
 """
 
+import os
+import sys
+
 import pytest
 
 from tests.conftest import (REFERENCE, assert_guards_match_actions,
@@ -270,6 +273,112 @@ def test_as04_compiled_matches_interpreter():
         assert set(want) == set(got), n
         for name in want:
             assert want[name] == got[name], (n, name)
+
+
+def _recovery_spec(stem):
+    scripts = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    _argv, sys.argv = sys.argv, sys.argv[:1]
+    from pin_fixpoints import RECOVERY_CFG, load
+    sys.argv = _argv
+    return load(stem, RECOVERY_CFG, None)
+
+
+def rr05_spec():
+    return _recovery_spec("05-replica-recovery/VR_REPLICA_RECOVERY")
+
+
+def test_rr05_compiled_matches_interpreter():
+    """RR05 exercises crash-recovery lowering: the Nil-able response
+    tracker (rec_has_log sentinels), tracker-slot lane binders
+    (CompleteRecovery's `\\E m \\in rep_rec_recv[r]` with updates),
+    UniqueNumber's bag CHOOSE, and IF-arm Nil sentinels."""
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = rr05_spec()
+    codec, kern = make_compiled_model(spec)
+    states = explore_states(spec, 40)
+    rec_mv = spec.ev.constants["Recovering"]
+    states = states + sorted(
+        explore_states(spec, 1200),
+        key=lambda st: sum(len(x) for _r, x in
+                           st["rep_rec_recv"].items) * 10
+        + sum(3 for _r, v in st["rep_status"].items if v is rec_mv),
+        reverse=True)[:20]
+    for n, st in enumerate(states):
+        want = interp_succs(spec, st)
+        got = kernel_succs(kern, codec, st)
+        assert set(want) == set(got), n
+        for name in want:
+            assert want[name] == got[name], (n, name)
+
+
+def al05_spec():
+    return _recovery_spec(
+        "05-replica-recovery/VR_REPLICA_RECOVERY_ASYNC_LOG")
+
+
+def test_al05_compiled_matches_interpreter():
+    """AL05 exercises the suffix-response lowering: integer-range lane
+    binders (the prefix crash's `\\E last_op \\in 0..op`), suffix logs
+    based at prefix_ceil+1 (module-keyed tracker schema), Nil backup
+    responses, and the prefix+suffix log graft."""
+    from tpuvsr.lower.compile import make_compiled_model
+    spec = al05_spec()
+    codec, kern = make_compiled_model(spec)
+    states = explore_states(spec, 1500)
+    rec_mv = spec.ev.constants["Recovering"]
+    sample = states[:30] + sorted(
+        states,
+        key=lambda st: sum(len(x) for _r, x in
+                           st["rep_rec_recv"].items) * 10
+        + sum(3 for _r, v in st["rep_status"].items if v is rec_mv),
+        reverse=True)[:20]
+    for n, st in enumerate(sample):
+        want = interp_succs(spec, st)
+        got = kernel_succs(kern, codec, st)
+        assert set(want) == set(got), n
+        for name in want:
+            assert want[name] == got[name], (n, name)
+
+
+@pytest.mark.slow
+def test_al05_compiled_level_prefix_matches_hand_kernel():
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.lower.compile import make_compiled_model
+    from tpuvsr.models import registry
+    spec = al05_spec()
+    runs = {}
+    for tag, factory in (("hand", registry.make_model),
+                         ("compiled", make_compiled_model)):
+        eng = DeviceBFS(spec, tile_size=256, fpset_capacity=1 << 20,
+                        next_capacity=1 << 16, model_factory=factory)
+        res = eng.run(max_depth=10)
+        runs[tag] = ([int(x) for x in eng.level_sizes],
+                     res.distinct_states)
+    assert runs["hand"] == runs["compiled"], runs
+
+
+@pytest.mark.slow
+def test_rr05_compiled_level_prefix_matches_hand_kernel():
+    """The compiled RR05 kernel's per-level BFS counts must equal the
+    hand kernel's to a bounded depth (the full space exceeds 12.7M —
+    scripts/recovery_fixpoints.json — so the exact level prefix is the
+    oracle)."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.lower.compile import make_compiled_model
+    from tpuvsr.models import registry
+    spec = rr05_spec()
+    runs = {}
+    for tag, factory in (("hand", registry.make_model),
+                         ("compiled", make_compiled_model)):
+        eng = DeviceBFS(spec, tile_size=256, fpset_capacity=1 << 20,
+                        next_capacity=1 << 16, model_factory=factory)
+        res = eng.run(max_depth=10)
+        runs[tag] = ([int(x) for x in eng.level_sizes],
+                     res.distinct_states)
+    assert runs["hand"] == runs["compiled"], runs
 
 
 @pytest.mark.slow

@@ -49,8 +49,8 @@ import jax.numpy as jnp
 
 from ..core.values import ModelValue, TLAError, tla_eq
 from ..frontend.tla_ast import Def
-from .ir import (D_MSGS, D_REPLICAS, D_SUBSETS, D_VALUES, contains_prime,
-                 extract_action)
+from .ir import (D_INTRANGE, D_MSGS, D_REPLICAS, D_SUBSETS, D_TRACKER,
+                 D_VALUES, contains_prime, extract_action)
 
 I32 = jnp.int32
 INF = jnp.int32(0x7FFFFFFF)
@@ -70,6 +70,8 @@ MSG_FIELD_COLS = {
     "last_normal_vn": (H_LNV, None),
     "first_op": (H_FIRST, None),
     "x": (H_X, None),
+    "op": (H_OP, None),            # AL05 RecoveryMsg floor
+    "prefix_ceil": (H_FIRST, None),  # AL05 response suffix base
 }
 
 # per-message-type record fields, for the deterministic-CHOOSE key
@@ -90,6 +92,12 @@ MSG_TYPE_FIELDS = {
                     "view_number"),
     "NewStateMsg": ("commit_number", "dest", "first_op", "log",
                     "op_number", "source", "type", "view_number"),
+    # `op` is AL05's floor field; on RR05 RecoveryMsg rows the column
+    # is constant 0, so including it cannot affect a tie-break
+    "RecoveryMsg": ("dest", "op", "source", "type", "x"),
+    "RecoveryResponseMsg": ("commit_number", "dest", "log",
+                            "op_number", "source", "type",
+                            "view_number", "x"),
 }
 
 # state variable -> dense plane binding for the ST03 layout family
@@ -115,18 +123,68 @@ VAR_KINDS = {
     "aux_client_acked": ("auxfn", "aux_acked", None),
     "messages": ("bag", None, None),
     "replicas": ("repset_const", None, None),
-    # I01's per-replica DVC tracker: a SET of DVC records stored in
-    # [R, R] source-indexed slot planes (models/i01.py)
-    "rep_recv_dvc": ("tracker", "dvc", None),
+    # per-replica record-SET trackers stored in [R, R] source-indexed
+    # slot planes (models/i01.py, as04.py, rr05.py)
+    "rep_recv_dvc": ("tracker", "rep_recv_dvc", None),
+    "rep_rec_recv": ("tracker", "rep_rec_recv", None),
+    "rep_rec_number": ("rep", "rec_number", None),
+    "aux_restart": ("glob", "aux_restart", None),
 }
 
-# tracker element field -> plane (j-indexed inside the replica row);
-# `source`/`dest`/`type` are implicit (slot index / row / constant)
-TRACKER_FIELD_PLANES = {
-    "view_number": "dvc_view",
-    "last_normal_vn": "dvc_lnv",
-    "op_number": "dvc_op",
-    "commit_number": "dvc_commit",
+# tracker variable -> dense schema.  `planes` maps record fields to
+# slot planes; `source`/`dest`/`type` are implicit (slot index / row /
+# constant); `view_plane` may be absent from a layout (AS04: implied =
+# View(dest)); `has_flag` marks layouts whose log/op/commit fields are
+# Nil-able (RR05 recovery responses: -1 sentinels + rec_has_log);
+# `implied` maps extra fields to the per-replica plane holding their
+# implied value (RR05: x = rep_rec_number[dest]).
+TRACKER_SCHEMAS = {
+    "rep_recv_dvc": {
+        "presence": "dvc",
+        "type_const": "DoViewChangeMsg",
+        "planes": {"last_normal_vn": "dvc_lnv", "op_number": "dvc_op",
+                   "commit_number": "dvc_commit"},
+        "view_plane": "dvc_view",
+        "log": ("dvc_log", "dvc_op"),
+        "has_flag": None,
+        "implied": {},
+        # alphabetical record-field order for deterministic CHOOSE
+        "choose_cols": ("commit_number", "last_normal_vn", "log",
+                        "op_number", "source", "view_number"),
+    },
+    "rep_rec_recv": {
+        "presence": "rec",
+        "type_const": "RecoveryResponseMsg",
+        "planes": {"view_number": "rec_view", "op_number": "rec_op",
+                   "commit_number": "rec_commit"},
+        "view_plane": None,
+        "log": ("rec_log", "rec_op"),
+        "has_flag": "rec_has_log",
+        "implied": {"x": "rec_number"},
+        "choose_cols": ("commit_number", "log", "op_number", "source",
+                        "view_number"),
+    },
+}
+
+# module-specific overrides: AL05's recovery responses carry a SUFFIX
+# log (log_suffix, 0-based from prefix_ceil+1 = rec_ceil+1) instead of
+# a whole log (models/al05.py)
+TRACKER_SCHEMAS_BY_MODULE = {
+    ("VR_REPLICA_RECOVERY_ASYNC_LOG", "rep_rec_recv"): {
+        "presence": "rec",
+        "type_const": "RecoveryResponseMsg",
+        "planes": {"view_number": "rec_view", "op_number": "rec_op",
+                   "commit_number": "rec_commit",
+                   "prefix_ceil": "rec_ceil"},
+        "view_plane": None,
+        "log": ("rec_log", "rec_op"),
+        "log_name": "log_suffix",
+        "suffix_base": "rec_ceil",
+        "has_flag": "rec_has_log",
+        "implied": {"x": "rec_number"},
+        "choose_cols": ("commit_number", "log", "op_number",
+                        "prefix_ceil", "source", "view_number"),
+    },
 }
 
 _BAG_COMBINATORS = ("SendFunc", "BroadcastFunc", "DiscardFunc")
@@ -244,8 +302,10 @@ class Lowerer:
                 return self.codec.status_id[v]
             if v in self.codec.mtype_id:
                 return self.codec.mtype_id[v]
-            if v is self.consts.get("Nil"):
-                return 0
+            # NOTE: no bare-Nil fallthrough — a Nil outside a replica-
+            # valued field has no universal sentinel (logs use -1
+            # lengths, int fields -1), so it must be handled in
+            # context (_select) or fail loud here
         raise LowerError(f"cannot encode static {v!r} in space {space}")
 
     def pack_entry(self, rec, env, st):
@@ -365,6 +425,9 @@ class Lowerer:
             s = self.expr(args[0], env, st)
             if s.kind == "repmask":
                 return d_int(s.bits.sum())
+            if s.kind == "trackrow":
+                pres = self._schema(s.schema)["presence"]
+                return d_int(st[pres][s.i].sum())
             if s.kind == "static" and isinstance(s.v, frozenset):
                 return d_static(len(s.v))
             elems = self._set_elements(s)
@@ -403,6 +466,8 @@ class Lowerer:
             # instead of silently truncating an unbounded recursion.
             self._check_counter_recursion(name, d)
             depth = self._rec_depth.get(name, 0)
+            if depth == 0:
+                self._check_recursion_bound(name, d, args)
             if depth > self.MAX_OPS + 2:
                 raise LowerError(
                     f"recursion in {name} exceeded the unroll bound")
@@ -415,6 +480,69 @@ class Lowerer:
                 self._rec_depth[name] = depth
                 self._rec_cut.discard(name)
         return self.expr(d.body, inner, st)
+
+    def _bounded_int_ast(self, e):
+        """Is this integer expression STRUCTURALLY bounded by the log
+        layout (<= MAX_OPS)?  True for op/commit plane reads
+        (rep_op_number[..], rep_commit_number[..]), message/tracker
+        op/commit/ceil fields, MinVal over one bounded arg, and +/- of
+        a bounded term with a literal.  The unroll/lane bounds derived
+        from MAX_OPS are only sound for such expressions — anything
+        else must fail loud, not truncate silently."""
+        if not isinstance(e, tuple):
+            return False
+        if e[0] == "apply" and e[1][0] == "id":
+            vk = VAR_KINDS.get(e[1][1])
+            return bool(vk and vk[0] == "rep"
+                        and vk[1] in ("op", "commit"))
+        if e[0] == "dot":
+            return e[2] in ("op_number", "commit_number",
+                            "prefix_ceil", "op")
+        if e[0] == "call" and e[1] == "MinVal":
+            return any(self._bounded_int_ast(a) for a in e[2])
+        if e[0] == "call":
+            # e.g. HighestCommitNumber(r): LET m == CHOOSE ... IN
+            # m.commit_number — recurse into the definition body (the
+            # bounded-ness of a field/plane read is name-local)
+            dd = self.module.defs.get(e[1])
+            if dd is not None:
+                return self._bounded_int_ast(dd.body)
+            return False
+        if e[0] == "let":
+            return self._bounded_int_ast(e[2])
+        if e[0] == "if":
+            return (self._bounded_int_ast(e[2])
+                    and self._bounded_int_ast(e[3]))
+        if e[0] == "binop" and e[1] in ("plus", "minus") \
+                and e[3][0] == "num":
+            return self._bounded_int_ast(e[2])
+        if e[0] == "num":
+            return e[1] <= self.MAX_OPS
+        if e[0] == "id":
+            return self._bounded_id(e[1], len(self._ast_args))
+        return False
+
+    def _bounded_id(self, name, upto):
+        """Resolve a name through inlined-call / LET argument AST
+        frames — a frame's values come from the CALLER's scope, so
+        resolution continues strictly in outer frames — then module
+        defs; conservative (False) when opaque."""
+        for i in range(upto - 1, -1, -1):
+            frame = self._ast_args[i]
+            if name in frame:
+                e = frame[name]
+                if isinstance(e, tuple) and e[0] == "id":
+                    return self._bounded_id(e[1], i)
+                saved = self._ast_args
+                self._ast_args = saved[:i]
+                try:
+                    return self._bounded_int_ast(e)
+                finally:
+                    self._ast_args = saved
+        dd = self.module.defs.get(name)
+        if dd is not None and not dd.params:
+            return self._bounded_int_ast(dd.body)
+        return False
 
     def _check_counter_recursion(self, name, d):
         """Structural soundness check for the bounded unroll: the
@@ -462,6 +590,58 @@ class Lowerer:
                 f"RECURSIVE {name} is not counter-stepped recursion; "
                 f"bounded unroll would be unsound")
 
+    def _check_recursion_bound(self, name, d, args):
+        """At the recursion's entry call, the STOP-bound argument (the
+        parameter the body's IF compares the stepped counter against)
+        must be structurally layout-bounded — otherwise the MAX_OPS
+        unroll would silently truncate.  Resolves argument names
+        through the inline-frame stack."""
+        body = d.body
+        while isinstance(body, tuple) and body[0] == "let":
+            body = body[2]
+        if not (isinstance(body, tuple) and body[0] == "if"):
+            raise LowerError(
+                f"RECURSIVE {name}: cutoff needs a top-level IF")
+        cond = body[1]
+        # the stepped params (p +- 1 in self-calls) are the counters;
+        # the OTHER cond side is the stop bound
+        calls = []
+
+        def find(e):
+            if not isinstance(e, tuple):
+                return
+            if e[0] == "call" and e[1] == name:
+                calls.append(e[2])
+            for x in e:
+                if isinstance(x, tuple):
+                    find(x)
+                elif isinstance(x, list):
+                    for y in x:
+                        if isinstance(y, tuple):
+                            find(y)
+        find(d.body)
+        steppedp = set()
+        for cargs in calls:
+            for a, p in zip(cargs, d.params):
+                if (isinstance(a, tuple) and a[0] == "binop"
+                        and a[1] in ("plus", "minus")
+                        and a[2] == ("id", p) and a[3] == ("num", 1)):
+                    steppedp.add(p)
+        bound_idx = None
+        if cond[0] == "binop" and cond[1] in ("gt", "lt", "ge", "le"):
+            for side in (cond[2], cond[3]):
+                if (side[0] == "id" and side[1] in d.params
+                        and side[1] not in steppedp):
+                    bound_idx = d.params.index(side[1])
+        if bound_idx is None or bound_idx >= len(args):
+            raise LowerError(
+                f"RECURSIVE {name}: cannot identify the stop bound")
+        if not self._bounded_int_ast(args[bound_idx]):
+            raise LowerError(
+                f"RECURSIVE {name}: stop bound "
+                f"{args[bound_idx]!r} is not layout-bounded; the "
+                f"MAX_OPS unroll would truncate silently")
+
     # -- state-variable application ------------------------------------
     def _e_apply(self, e, env, st):
         _, fe, idx = e
@@ -475,7 +655,7 @@ class Lowerer:
             if f.kind2 == "repfn":
                 return DV("vecrow", arr=st[f.plane][i])
             if f.kind2 == "tracker":
-                return DV("trackrow", i=i)
+                return DV("trackrow", i=i, schema=f.plane)
         if f.kind == "vecrow":
             j = self._rep_index(self.expr(idx, env, st))
             return d_int(f.arr[j])
@@ -511,23 +691,44 @@ class Lowerer:
 
     def _tracker_field(self, ref, fld, st):
         i, j = ref.i, ref.j
+        sc = self._schema(ref.schema)
         if fld == "source":
             return d_int(self._j(j) + 1, space="replica")
         if fld == "dest":
             return d_int(self._j(i) + 1, space="replica")
         if fld == "type":
-            return d_static(self.consts["DoViewChangeMsg"])
-        if fld == "view_number" and "dvc_view" not in self.planes:
-            # AS04-style tracker: view is implied = View(dest)
-            return d_int(st["view"][i])
-        if fld == "log":
-            if getattr(j, "ndim", 0) != 0 and not isinstance(j, int):
-                raise LowerError("tracker .log needs a scalar element")
-            return d_log(st["dvc_log"][i, j], st["dvc_op"][i, j])
-        p = TRACKER_FIELD_PLANES.get(fld)
-        if p is None:
-            raise LowerError(f"tracker element has no field {fld}")
-        return d_int(st[p][i][j])
+            return d_static(self.consts[sc["type_const"]])
+        if fld in sc["implied"]:
+            return d_int(st[sc["implied"][fld]][i])
+        p = sc["planes"].get(fld)
+        if p is not None and fld != "log":
+            return d_int(st[p][i][j])
+        if fld == "view_number":
+            vp = sc["view_plane"]
+            if vp is None or vp not in self.planes:
+                # implied = View(dest) (AS04-style layouts)
+                return d_int(st["view"][i])
+            return d_int(st[vp][i][j])
+        if fld == sc.get("log_name", "log"):
+            arrp, lenp = sc["log"]
+            base = sc.get("suffix_base")
+            vec = getattr(j, "ndim", 0) != 0 and not isinstance(j, int)
+            if base is None:
+                length = st[lenp][i][j]
+                first = 1
+            else:
+                # suffix log: stored 0-based from prefix_ceil+1, length
+                # op_number - prefix_ceil (Nil rows: op=-1, ceil=0 ->
+                # length -1, the Nil sentinel)
+                length = st[lenp][i][j] - st[base][i][j]
+                first = st[base][i][j] + 1
+            if vec:
+                # vectorized element (inner quantifier): only the
+                # Nil-test (length sentinel) is meaningful — arr=None
+                # makes any other use fail loud in _as_log
+                return d_log(None, length, first=first)
+            return d_log(st[arrp][i, j], length, first=first)
+        raise LowerError(f"tracker element has no field {fld}")
 
     def _msg_field(self, mref, fld, st):
         k = mref.k
@@ -542,6 +743,13 @@ class Lowerer:
             return d_log(st["m_log"][k], length, first=first)
         if fld == "message":
             return DV("entry", v=st["m_entry"][k])
+        if fld == "log_suffix":
+            # AL05 recovery responses: suffix stored 0-based from
+            # prefix_ceil+1 (H_FIRST holds the ceil; models/al05.py);
+            # Nil rows have H_OP=-1 -> length sentinel -1
+            first = st["m_hdr"][k, H_FIRST] + 1
+            length = st["m_hdr"][k, H_OP] - st["m_hdr"][k, H_FIRST]
+            return d_log(st["m_log"][k], length, first=first)
         col, space = MSG_FIELD_COLS[fld]
         return d_int(st["m_hdr"][..., col][k] if getattr(k, "ndim", 0)
                      else st["m_hdr"][k, col], space=space)
@@ -610,12 +818,13 @@ class Lowerer:
         sdv = self.expr(sexpr, env, st)
         if sdv.kind != "trackrow":
             raise LowerError("set filter over unsupported domain")
+        pres = self._schema(sdv.schema)["presence"]
         idx = jnp.arange(self.R, dtype=I32)
-        mask = st["dvc"][sdv.i][idx] == 1
-        ref = DV("tdvc", i=sdv.i, j=idx, axis=-1)
+        mask = st[pres][sdv.i][idx] == 1
+        ref = DV("tdvc", i=sdv.i, j=idx, schema=sdv.schema, axis=-1)
         b = self.expr(pred, env.deeper().bind(var, ref), st)
-        return DV("trackset", i=sdv.i, keep=mask & self._broad(b),
-                  adds=[])
+        return DV("trackset", i=sdv.i, schema=sdv.schema,
+                  keep=mask & self._broad(b), adds=[])
 
     def _e_domain(self, e, env, st):
         b = self.expr(e[1], env, st)
@@ -724,6 +933,23 @@ class Lowerer:
         return out
 
     def _select(self, cb, a, b):
+        # IF-arms mixing a value with Nil (RR05's recovery responses:
+        # `log |-> IF primary THEN rep_log[r] ELSE Nil`) lower Nil to
+        # the layout sentinel of the OTHER arm's kind: length -1 for
+        # logs, -1 for ints (models/rr05.py)
+        nil = self.consts.get("Nil")
+        for x, y in ((a, b), (b, a)):
+            if x.kind == "static" and x.v is nil and nil is not None:
+                if y.kind == "log":
+                    conv = d_log(jnp.zeros((self.MAX_OPS,), I32), -1)
+                elif y.kind in ("int", "entry"):
+                    conv = d_int(-1)
+                else:
+                    raise LowerError("IF-arm Nil of unsupported kind")
+                if x is a:
+                    a = conv
+                else:
+                    b = conv
         if a.kind == "log" or b.kind == "log":
             a, b = self._as_log(a), self._as_log(b)
             return d_log(jnp.where(cb, a.arr, b.arr),
@@ -741,7 +967,12 @@ class Lowerer:
     def _e_let(self, e, env, st):
         _, defs, body = e
         env = self._bind_let(defs, env, st)
-        return self.expr(body, env, st)
+        self._ast_args.append({d.name: d.body for d in defs
+                               if not d.params})
+        try:
+            return self.expr(body, env, st)
+        finally:
+            self._ast_args.pop()
 
     def _bind_let(self, defs, env, st):
         for d in defs:
@@ -794,17 +1025,19 @@ class Lowerer:
                          space=sp)
         if op == "union":
             if a.kind == "trackrow":       # `@ \union {m}` (AS04:685)
-                a = DV("trackset", i=a.i,
-                       keep=st["dvc"][a.i] == 1, adds=[])
+                a = DV("trackset", i=a.i, schema=a.schema,
+                       keep=st[self._schema(a.schema)["presence"]]
+                       [a.i] == 1, adds=[])
             if b.kind == "trackrow":
-                b = DV("trackset", i=b.i,
-                       keep=st["dvc"][b.i] == 1, adds=[])
+                b = DV("trackset", i=b.i, schema=b.schema,
+                       keep=st[self._schema(b.schema)["presence"]]
+                       [b.i] == 1, adds=[])
             if a.kind == "trackset" and b.kind == "dvset":
-                return DV("trackset", i=a.i, keep=a.keep,
-                          adds=a.adds + b.elems)
+                return DV("trackset", i=a.i, schema=a.schema,
+                          keep=a.keep, adds=a.adds + b.elems)
             if a.kind == "dvset" and b.kind == "trackset":
-                return DV("trackset", i=b.i, keep=b.keep,
-                          adds=b.adds + a.elems)
+                return DV("trackset", i=b.i, schema=b.schema,
+                          keep=b.keep, adds=b.adds + a.elems)
             if a.kind == "static" and b.kind == "static":
                 return d_static(a.v | b.v)
             raise LowerError("union of unsupported set kinds")
@@ -874,6 +1107,11 @@ class Lowerer:
                 a, b = b, a
             if b.kind == "static" and b.v == ():
                 return d_bool(self._j(a.length) == 0)
+            if b.kind == "static" and b.v is self.consts.get("Nil"):
+                # Nil-able log fields use a negative length sentinel
+                # (models/rr05.py: H_OP/rec_op = -1 when log is Nil)
+                return d_bool(self._j(a.length) < 0)
+            a = self._as_log(a)
             b = self._as_log(b)
             # both arrays are stored 0-based from their `first`, so
             # equal domains = equal (first, length) and positional
@@ -921,11 +1159,12 @@ class Lowerer:
             mask = st["m_present"][idx] == 1
             return idx, mask, d_msg(idx, mask=mask, axis=-(depth + 1))
         if dv.kind == "trackrow":
+            pres = self._schema(dv.schema)["presence"]
             idx = jnp.arange(self.R, dtype=I32).reshape(
                 (self.R,) + (1,) * depth)
-            mask = st["dvc"][dv.i][idx] == 1
+            mask = st[pres][dv.i][idx] == 1
             return idx, mask, DV("tdvc", i=dv.i, j=idx,
-                                 axis=-(depth + 1))
+                                 schema=dv.schema, axis=-(depth + 1))
         return None
 
     def _quant_rec(self, flat, body, env, st, mode):
@@ -952,8 +1191,9 @@ class Lowerer:
             lo = self.as_int(dv.lo)
             if not isinstance(lo, int):
                 raise LowerError("dynamic range lower bound")
-            idx = jnp.arange(lo, lo + self.MAX_OPS, dtype=I32).reshape(
-                (self.MAX_OPS,) + (1,) * d)
+            idx = jnp.arange(lo, lo + self.MAX_OPS + 1,
+                             dtype=I32).reshape(
+                (self.MAX_OPS + 1,) + (1,) * d)
             mask = idx <= self._j(self.as_int(dv.hi))
             inner = self._quant_rec(
                 rest, body,
@@ -1054,31 +1294,38 @@ class Lowerer:
         return d_msg(jnp.argmax(cand).astype(I32))
 
     def _choose_tracker(self, trow, var, body, env, st):
-        """Deterministic CHOOSE over a DVC tracker row: min value_key
-        among candidates, over the record columns in alphabetical field
-        order (commit_number, dest=const, last_normal_vn, log,
-        op_number, source, type=const, view_number)."""
+        """Deterministic CHOOSE over a tracker row: min value_key among
+        candidates, over the record columns in alphabetical field order
+        (dest/type/implied fields are candidate-invariant and skipped;
+        so is an implied view column)."""
+        sc = self._schema(trow.schema)
         i = trow.i
         idx = jnp.arange(self.R, dtype=I32)
-        mask = st["dvc"][i][idx] == 1
-        ref = DV("tdvc", i=i, j=idx, axis=-1)
+        mask = st[sc["presence"]][i][idx] == 1
+        ref = DV("tdvc", i=i, j=idx, schema=trow.schema, axis=-1)
         b = self.expr(body, env.deeper().bind(var, ref), st)
         cand = mask & self._broad(b)
-        cols = [st["dvc_commit"][i][:, None],
-                st["dvc_lnv"][i][:, None],
-                st["dvc_log"][i],
-                st["dvc_op"][i][:, None],
-                (idx + 1)[:, None]]          # source
-        if "dvc_view" in self.planes:
-            cols.append(st["dvc_view"][i][:, None])
-        # (an implied view column is equal across all candidates and
-        # cannot affect the tie-break)
+        cols = []
+        for fld in sc["choose_cols"]:
+            if fld == "log":
+                cols.append(st[sc["log"][0]][i])
+            elif fld == "source":
+                cols.append((idx + 1)[:, None])
+            elif fld == "view_number" and (
+                    sc["view_plane"] is None
+                    or sc["view_plane"] not in self.planes):
+                continue
+            elif fld == "view_number":
+                cols.append(st[sc["view_plane"]][i][:, None])
+            else:
+                cols.append(st[sc["planes"][fld]][i][:, None])
         keys = jnp.concatenate([jnp.asarray(c, I32) for c in cols],
                                axis=1)
         for c in range(keys.shape[1]):
             col = jnp.where(cand, keys[:, c], INF)
             cand = cand & (col == col.min())
-        return DV("tdvc", i=i, j=jnp.argmax(cand).astype(I32))
+        return DV("tdvc", i=i, j=jnp.argmax(cand).astype(I32),
+                  schema=trow.schema)
 
     def _choose_msg_type(self, body):
         """Find the `x.type = SomeMsg` constraint that fixes the CHOOSE
@@ -1136,7 +1383,7 @@ class Lowerer:
             if isinstance(lo, int):
                 hi = self._j(self.as_int(dv.hi))
                 return [(d_static(i), hi >= i)
-                        for i in range(lo, lo + self.MAX_OPS)]
+                        for i in range(lo, lo + self.MAX_OPS + 1)]
             return None
         if dv.kind == "repmask":
             return [(d_static(r), dv.bits[r - 1] == 1)
@@ -1152,6 +1399,10 @@ class Lowerer:
 
     def _as_log(self, dv):
         if dv.kind == "log":
+            if dv.arr is None:
+                raise LowerError(
+                    "log content of a vectorized tracker element "
+                    "(only Nil-tests are supported there)")
             return dv
         if dv.kind == "static" and dv.v == ():
             return d_log(jnp.zeros((self.MAX_OPS,), I32), 0)
@@ -1171,12 +1422,19 @@ class Lowerer:
     def _jb(x):
         return jnp.asarray(x, bool) if not isinstance(x, bool) else x
 
+    def _schema(self, key):
+        """Tracker schema for this module (module-specific layouts
+        override the shared one: AL05's suffix responses)."""
+        return TRACKER_SCHEMAS_BY_MODULE.get(
+            (self.module.name, key)) or TRACKER_SCHEMAS[key]
+
     # ==================================================================
     # action compilation: binders -> lanes, conjuncts -> guards/updates
     # ==================================================================
     def _dims(self, air):
         sizes = {D_REPLICAS: self.R, D_VALUES: self.V, D_MSGS: self.M,
-                 D_SUBSETS: 1 << self.R}
+                 D_SUBSETS: 1 << self.R, D_TRACKER: self.R,
+                 D_INTRANGE: self.MAX_OPS + 1}
         return [sizes[b.domain] for b in air.binders]
 
     def lane_count(self, air):
@@ -1206,6 +1464,25 @@ class Lowerer:
             elif b.domain == D_SUBSETS:
                 bits = (comp >> jnp.arange(self.R, dtype=I32)) & 1
                 env = env.bind(b.name, DV("repmask", bits=bits))
+            elif b.domain == D_TRACKER:
+                tvar, owner = b.info
+                odv = env.vars[owner]
+                i = self._rep_index(odv)
+                pres = self._schema(tvar)["presence"]
+                env = env.bind(b.name, DV("tdvc", i=i, j=comp,
+                                          schema=tvar))
+                guards.append(st[pres][i][comp] == 1)
+            elif b.domain == D_INTRANGE:
+                lo, hi_ast = b.info
+                if not self._bounded_int_ast(hi_ast):
+                    raise LowerError(
+                        f"range binder bound {hi_ast!r} is not "
+                        f"layout-bounded; {self.MAX_OPS + 1} lanes "
+                        f"would truncate it silently")
+                val = lo + comp
+                env = env.bind(b.name, d_int(val))
+                hi = self._j(self.as_int(self.expr(hi_ast, env, st)))
+                guards.append(val <= hi)
         return env
 
     def compile_action(self, air):
@@ -1251,8 +1528,13 @@ class Lowerer:
                 s2 = self._walk(x, env, st, s2, guards, build)
             return s2
         if tag == "let":
-            return self._walk(node[2], self._bind_let(node[1], env, st),
-                              st, s2, guards, build)
+            env2 = self._bind_let(node[1], env, st)
+            self._ast_args.append({d.name: d.body for d in node[1]
+                                   if not d.params})
+            try:
+                return self._walk(node[2], env2, st, s2, guards, build)
+            finally:
+                self._ast_args.pop()
         if tag == "unchanged":
             return s2
         if (tag == "binop" and node[1] == "eq"
@@ -1420,9 +1702,9 @@ class Lowerer:
                 jnp.clip(vid - 1, 0, self.V - 1)].set(enc)
             return s2
         if kind == "tracker":
-            cur = DV("trackrow", i=i)
+            cur = DV("trackrow", i=i, schema=plane)
             val = self.expr(val_e, env.bind("@", cur), st)
-            return self._tracker_assign(i, val, st, s2)
+            return self._tracker_assign(plane, i, val, st, s2)
         raise LowerError(f"EXCEPT on {kind}")
 
     @staticmethod
@@ -1435,63 +1717,79 @@ class Lowerer:
         raise LowerError(
             "aux_client_acked updates support literal TRUE/FALSE only")
 
-    ALL_TRACKER_PLANES = ("dvc", "dvc_view", "dvc_lnv", "dvc_op",
-                          "dvc_commit", "dvc_log")
+    def _tracker_planes(self, schema):
+        sc = self._schema(schema)
+        planes = [sc["presence"]]
+        if sc["view_plane"] and sc["view_plane"] in self.planes:
+            planes.append(sc["view_plane"])
+        planes.extend(sorted(set(sc["planes"].values())))
+        planes.append(sc["log"][0])
+        if sc["has_flag"]:
+            planes.append(sc["has_flag"])
+        return [p for p in dict.fromkeys(planes) if p in self.planes]
 
-    def tracker_planes(self):
-        return tuple(p for p in self.ALL_TRACKER_PLANES
-                     if p in self.planes)
-
-    def _tracker_assign(self, i, val, st, s2):
-        """rep_recv_dvc[r] := {} / {elements} / filtered-set ∪
-        {elements}.  Dropped slots are ZEROED in every plane
-        (non-present slots must be all-zero or the per-replica row hash
-        loses canonicity)."""
+    def _tracker_assign(self, schema, i, val, st, s2):
+        """tracker[r] := {} / {elements} / filtered-set U {elements}.
+        Dropped slots are ZEROED in every plane (non-present slots must
+        be all-zero or the per-replica row hash loses canonicity)."""
+        sc = self._schema(schema)
         if val.kind == "dvset":
             keep = jnp.zeros((self.R,), bool)
             adds = list(val.elems)
         elif val.kind == "trackset":
+            if val.schema != schema:
+                raise LowerError(
+                    "tracker value from a different tracker")
             keep, adds = val.keep, val.adds
         else:
             raise LowerError(f"unsupported tracker value {val}")
-        planes = self.tracker_planes()
-        plane_field = {"dvc_view": "view", "dvc_lnv": "lnv",
-                       "dvc_op": "op", "dvc_commit": "commit",
-                       "dvc_log": "log"}
+        planes = self._tracker_planes(schema)
         rows = {}
         for p in planes:
             row = st[p][i]
             km = keep if row.ndim == 1 else keep[:, None]
             rows[p] = jnp.where(km, row, 0)
         for el in adds:
-            f = self._tracker_insert_fields(el, st)
-            j = jnp.clip(f["j"], 0, self.R - 1)
-            rows["dvc"] = rows["dvc"].at[j].set(1)
-            for p in planes[1:]:
-                rows[p] = rows[p].at[j].set(f[plane_field[p]])
+            f = self._tracker_insert_fields(sc, el, st)
+            j = jnp.clip(f.pop("j"), 0, self.R - 1)
+            rows[sc["presence"]] = rows[sc["presence"]].at[j].set(1)
+            for p, v in f.items():
+                rows[p] = rows[p].at[j].set(v)
         for p in planes:
             s2[p] = st[p].at[i].set(rows[p])
         return s2
 
-    def _tracker_insert_fields(self, el, st):
+    def _tracker_insert_fields(self, sc, el, st):
+        """Element DV -> {plane: value} for one slot insert (plus the
+        slot index under 'j')."""
         if el.kind == "msg":
             k = el.k
             hdr = st["m_hdr"][k]
-            return {"j": hdr[H_SRC] - 1, "view": hdr[H_VIEW],
-                    "lnv": hdr[H_LNV], "op": hdr[H_OP],
-                    "commit": hdr[H_COMMIT],
-                    "log": jnp.asarray(st["m_log"][k], I32)}
+            out = {"j": hdr[H_SRC] - 1,
+                   sc["log"][0]: jnp.asarray(st["m_log"][k], I32)}
+            for fld, p in sc["planes"].items():
+                out[p] = hdr[MSG_FIELD_COLS[fld][0]]
+            if sc["view_plane"] and sc["view_plane"] in self.planes:
+                out[sc["view_plane"]] = hdr[H_VIEW]
+            if sc["has_flag"]:
+                out[sc["has_flag"]] = jnp.asarray(hdr[H_OP] >= 0, I32)
+            return out
         if el.kind == "record":
             f = el.fields
             lg = self._as_log(f["log"])
-            return {
-                "j": self._j(self.as_int(f["source"], "replica")) - 1,
-                "view": self._j(self.as_int(f["view_number"])),
-                "lnv": self._j(self.as_int(f["last_normal_vn"])),
-                "op": self._j(self.as_int(f["op_number"])),
-                "commit": self._j(self.as_int(f["commit_number"])),
-                "log": jnp.asarray(lg.arr, I32)}
-        raise LowerError(f"cannot insert {el} into a DVC tracker")
+            out = {"j": self._j(self.as_int(f["source"],
+                                            "replica")) - 1,
+                   sc["log"][0]: jnp.asarray(lg.arr, I32)}
+            for fld, p in sc["planes"].items():
+                out[p] = self._j(self.as_int(f[fld]))
+            if sc["view_plane"] and sc["view_plane"] in self.planes:
+                out[sc["view_plane"]] = self._j(
+                    self.as_int(f["view_number"]))
+            if sc["has_flag"]:
+                out[sc["has_flag"]] = jnp.asarray(
+                    self._j(lg.length) >= 0, I32)
+            return out
+        raise LowerError(f"cannot insert {el} into a tracker")
 
     # -- bag combinators ------------------------------------------------
     def _apply_bag(self, rhs, env, st, s2):
@@ -1569,9 +1867,12 @@ class Lowerer:
                     and e[2][0] == "prime" and e[2][1][0] == "id"):
                 var = e[2][1][1]
                 vk = VAR_KINDS.get(var)
-                if vk and vk[0] in ("rep", "replog", "repfn",
-                                    "tracker") \
-                        and vk[1] in rep_planes:
+                plane = None
+                if vk and vk[0] == "tracker":
+                    plane = self._schema(vk[1])["presence"]
+                elif vk and vk[0] in ("rep", "replog", "repfn"):
+                    plane = vk[1]
+                if plane is not None and plane in rep_planes:
                     rhs = e[3]
                     if rhs[0] == "except":
                         path = rhs[2][0][0]
@@ -1616,6 +1917,13 @@ class Lowerer:
         t = f["type"]
         kw["type_"] = self.enc_static(t.v, "mtype") \
             if t.kind == "static" else self.as_int(t, "mtype")
+        nil = self.consts.get("Nil")
+        ls = f.get("log_suffix")
+        if ls is not None and ls.kind == "static" and ls.v is nil:
+            # AL05 backup response form: log_suffix=Nil encodes as
+            # op/commit -1 sentinels and a zero log row (al05.py)
+            kw["op"] = -1
+            kw["commit"] = -1
         for fld, dv in f.items():
             if fld == "type":
                 continue
@@ -1623,11 +1931,15 @@ class Lowerer:
                 kw["entry"] = self._entry_code(dv, env, st)
             elif fld == "log":
                 kw["log"] = jnp.asarray(self._as_log(dv).arr, I32)
+            elif fld == "log_suffix":
+                if not (dv.kind == "static" and dv.v is nil):
+                    kw["log"] = jnp.asarray(self._as_log(dv).arr, I32)
             else:
                 col_kw = {"view_number": "view", "op_number": "op",
                           "commit_number": "commit", "dest": "dest",
                           "source": "src", "last_normal_vn": "lnv",
-                          "first_op": "first", "x": "x"}[fld]
+                          "first_op": "first", "x": "x", "op": "op",
+                          "prefix_ceil": "first"}[fld]
                 kw[col_kw] = self._j(self.as_int(
                     dv, space=MSG_FIELD_COLS[fld][1]))
         return self.kern._row(**kw)

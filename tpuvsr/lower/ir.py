@@ -25,12 +25,23 @@ D_REPLICAS = "replicas"
 D_VALUES = "values"
 D_MSGS = "msgs"
 D_SUBSETS = "subsets"
+D_TRACKER = "tracker"    # `\E m \in rep_rec_recv[r]` with updates
+                         # inside (RR05's CompleteRecovery) — one lane
+                         # per tracker slot
+D_INTRANGE = "intrange"  # `\E last_op \in 0..rep_op_number[r]` with
+                         # updates inside (AL05's prefix crash) — one
+                         # lane per log position (span is layout-
+                         # bounded by MAX_OPS)
+
+# tracker state variables whose per-replica rows are lane-enumerable
+TRACKER_VARS = ("rep_recv_dvc", "rep_rec_recv")
 
 
 @dataclass
 class Binder:
     name: str
     domain: str          # one of the D_* tags
+    info: tuple = None   # D_TRACKER: (tracker var name, owner binder)
 
 
 @dataclass
@@ -40,17 +51,25 @@ class ActionIR:
     body: tuple = None   # conjunct tree (everything under the binders)
 
 
-def classify_domain(dom_expr):
-    """Map a binder's domain expression to a lane-domain tag, or None
-    if it is not lane-enumerable (left as an inner quantifier)."""
+def classify_domain(dom_expr, bound_names=()):
+    """Map a binder's domain expression to (tag, info), or None if it
+    is not lane-enumerable (left as an inner quantifier)."""
     if dom_expr == ("id", "replicas"):
-        return D_REPLICAS
+        return D_REPLICAS, None
     if dom_expr == ("id", "Values"):
-        return D_VALUES
+        return D_VALUES, None
     if dom_expr[0] == "domain" and dom_expr[1] == ("id", "messages"):
-        return D_MSGS
+        return D_MSGS, None
     if dom_expr[0] == "powerset" and dom_expr[1] == ("id", "replicas"):
-        return D_SUBSETS
+        return D_SUBSETS, None
+    if (dom_expr[0] == "apply" and dom_expr[1][0] == "id"
+            and dom_expr[1][1] in TRACKER_VARS
+            and dom_expr[2][0] == "id"
+            and dom_expr[2][1] in bound_names):
+        return D_TRACKER, (dom_expr[1][1], dom_expr[2][1])
+    if (dom_expr[0] == "binop" and dom_expr[1] == "range"
+            and dom_expr[2][0] == "num"):
+        return D_INTRANGE, (dom_expr[2][1], dom_expr[3])
     return None
 
 
@@ -63,21 +82,25 @@ def extract_action(name, expr) -> ActionIR:
     binders = []
     rest = []
 
+    def bound():
+        return tuple(b.name for b in binders)
+
     def walk(e):
         if e[0] == "and":
             items = list(e[1])
-            ex = [i for i, x in enumerate(items) if x[0] == "exists"]
-            if len(ex) == 1 and _liftable(items[ex[0]]):
+            ex = [i for i, x in enumerate(items)
+                  if x[0] == "exists" and _liftable(x, bound())]
+            if len(ex) == 1:
                 inner = items.pop(ex[0])
                 rest.extend(items)
                 walk(inner)
             else:
                 rest.append(e)
-        elif e[0] == "exists" and _liftable(e):
+        elif e[0] == "exists" and _liftable(e, bound()):
             for names, dom in e[1]:
-                tag = classify_domain(dom)
+                tag, info = classify_domain(dom, bound())
                 for n in names:
-                    binders.append(Binder(n, tag))
+                    binders.append(Binder(n, tag, info))
             walk(e[2])
         else:
             rest.append(e)
@@ -87,10 +110,11 @@ def extract_action(name, expr) -> ActionIR:
     return ActionIR(name=name, binders=binders, body=body)
 
 
-def _liftable(e):
+def _liftable(e, bound_names):
     if e[0] != "exists":
         return False
-    return all(classify_domain(dom) is not None for _names, dom in e[1])
+    return all(classify_domain(dom, bound_names) is not None
+               for _names, dom in e[1])
 
 
 def contains_prime(e, module, _seen=None) -> bool:
