@@ -52,10 +52,13 @@ assert spec.symmetry_perms, "shipped VSR.cfg declares SYMMETRY"
 t0 = time.time()
 eng = PagedBFS(spec, tile_size=tile, chunk_tiles=chunk_tiles,
                next_capacity=1 << 17, fpset_capacity=1 << 24)
+from tpuvsr.engine.checkpoint import prior_elapsed  # noqa: E402
+
 resume = CKPT if os.path.isdir(CKPT) else None
+prev_elapsed = prior_elapsed(CKPT) if resume else 0.0
 if resume:
     print(f"[shipped] resuming from {CKPT}", flush=True)
-res = eng.run(max_seconds=seconds, resume_from=resume,
+res = eng.run(max_seconds=prev_elapsed + seconds, resume_from=resume,
               checkpoint_path=CKPT, checkpoint_every=120.0,
               log=lambda m: print(f"[shipped] {m}", flush=True))
 elapsed = res.elapsed

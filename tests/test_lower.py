@@ -298,10 +298,10 @@ def test_rr05_compiled_matches_interpreter():
     from tpuvsr.lower.compile import make_compiled_model
     spec = rr05_spec()
     codec, kern = make_compiled_model(spec)
-    states = explore_states(spec, 40)
+    states = explore_states(spec, 1200)
     rec_mv = spec.ev.constants["Recovering"]
-    states = states + sorted(
-        explore_states(spec, 1200),
+    states = states[:40] + sorted(
+        states,
         key=lambda st: sum(len(x) for _r, x in
                            st["rep_rec_recv"].items) * 10
         + sum(3 for _r, v in st["rep_status"].items if v is rec_mv),

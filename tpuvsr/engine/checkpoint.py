@@ -105,6 +105,18 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         shutil.rmtree(old)
 
 
+def prior_elapsed(path) -> float:
+    """Cumulative wall-clock recorded in a snapshot's manifest (0.0
+    when absent/unreadable).  Resumable window scripts add this to
+    their window budget: a resumed run's elapsed is CUMULATIVE (run()
+    rewinds t0 by it), so a bare window budget would no-op."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            return float(json.load(f)["elapsed"])
+    except (OSError, ValueError, KeyError):
+        return 0.0
+
+
 def load_checkpoint(path, expect_digest=None):
     """Read a snapshot; returns a dict mirroring save_checkpoint.
 

@@ -456,6 +456,13 @@ class Lowerer:
             raise LowerError(f"unknown operator {name}")
         vals = [self.expr(a, env, st) for a in args]
         inner = defenv.bind_many(dict(zip(d.params, vals)))
+        self._ast_args.append(dict(zip(d.params, args)))
+        try:
+            return self._e_call_body(name, d, inner, st)
+        finally:
+            self._ast_args.pop()
+
+    def _e_call_body(self, name, d, inner, st):
         if getattr(d, "recursive", False):
             # bounded unroll with a cutoff that forces the base IF-arm
             # (see _e_if).  The prune is only sound for counter-stepped
@@ -467,7 +474,16 @@ class Lowerer:
             self._check_counter_recursion(name, d)
             depth = self._rec_depth.get(name, 0)
             if depth == 0:
-                self._check_recursion_bound(name, d, args)
+                # the entry call's own frame is innermost; its arg ASTs
+                # come from the CALLER's scope, so resolve one frame up
+                saved = self._ast_args
+                frame = saved[-1]
+                self._ast_args = saved[:-1]
+                try:
+                    self._check_recursion_bound(
+                        name, d, [frame.get(p) for p in d.params])
+                finally:
+                    self._ast_args = saved
             if depth > self.MAX_OPS + 2:
                 raise LowerError(
                     f"recursion in {name} exceeded the unroll bound")
@@ -480,6 +496,7 @@ class Lowerer:
                 self._rec_depth[name] = depth
                 self._rec_cut.discard(name)
         return self.expr(d.body, inner, st)
+
 
     def _bounded_int_ast(self, e):
         """Is this integer expression STRUCTURALLY bounded by the log
@@ -831,11 +848,14 @@ class Lowerer:
         if b.kind == "bag":
             return DV("msgdom")
         if b.kind == "log":
+            # log domains are layout-bounded by construction
             if isinstance(b.first, int):
                 return DV("intrange", lo=d_static(b.first),
-                          hi=d_int(self._j(b.length) + b.first - 1))
+                          hi=d_int(self._j(b.length) + b.first - 1),
+                          bounded=True)
             return DV("intrange", lo=d_int(b.first),
-                      hi=d_int(self._j(b.length) + self._j(b.first) - 1))
+                      hi=d_int(self._j(b.length) + self._j(b.first) - 1),
+                      bounded=True)
         if b.kind == "auxfn":
             elems = []
             for mv, vid in self.codec.value_id.items():
@@ -1005,7 +1025,9 @@ class Lowerer:
             return d_static(not v.v) if v.kind == "static" \
                 else d_bool(~self._jb(v.v))
         if op == "range":
-            return DV("intrange", lo=a, hi=b)
+            return DV("intrange", lo=a, hi=b,
+                      bounded=(b.kind == "static"
+                               or self._bounded_int_ast(re_)))
         if op in ("lt", "gt", "le", "ge", "plus", "minus", "mod",
                   "div", "times"):
             sp = getattr(a, "space", None) or getattr(b, "space", None)
@@ -1187,6 +1209,11 @@ class Lowerer:
             return d_bool((~mask | bi).all(axis=-(d + 1)))
         if dv.kind == "intrange" and not (
                 dv.lo.kind == "static" and dv.hi.kind == "static"):
+            if not getattr(dv, "bounded", False):
+                raise LowerError(
+                    "dynamic integer range is not layout-bounded; "
+                    "vectorizing over MAX_OPS+1 positions would "
+                    "truncate it silently")
             d = env.depth
             lo = self.as_int(dv.lo)
             if not isinstance(lo, int):
@@ -1379,6 +1406,11 @@ class Lowerer:
             if dv.lo.kind == "static" and dv.hi.kind == "static":
                 return [(d_static(i), None)
                         for i in range(dv.lo.v, dv.hi.v + 1)]
+            if not getattr(dv, "bounded", False):
+                raise LowerError(
+                    "dynamic integer range is not layout-bounded; "
+                    "enumerating MAX_OPS+1 positions would truncate "
+                    "it silently")
             lo = self.as_int(dv.lo)
             if isinstance(lo, int):
                 hi = self._j(self.as_int(dv.hi))
