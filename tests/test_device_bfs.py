@@ -254,6 +254,7 @@ def test_fpset_insert_duplicates_single_fresh():
 # ---------------------------------------------------------------------
 # checkpoint/resume
 # ---------------------------------------------------------------------
+@requires_reference
 def test_checkpoint_resume_reaches_same_frontier(tmp_path):
     """Kill-and-resume: a run checkpointed at a level boundary must,
     after resuming in a FRESH engine, reach the same per-level frontier

@@ -1,0 +1,18 @@
+"""Pass registry for the speclint analyzer.
+
+Each pass module exposes ``PASS`` (its name) and ``run(spec, report)``.
+``PASS_ORDER`` is the canonical execution order: cheap pure-AST passes
+first, the kernel cross-check (which instantiates a codec/kernel)
+last.  ``PREFLIGHT_PASSES`` is the subset the engines gate dispatch on
+— spec-level only, so the pre-flight stays well under the 5 s budget
+and needs no device model.
+"""
+
+from __future__ import annotations
+
+from . import drift, frames, symmetry, vacuity, widths
+
+PASSES = {m.PASS: m.run for m in (frames, widths, vacuity, symmetry,
+                                  drift)}
+PASS_ORDER = ("frames", "widths", "vacuity", "symmetry", "drift")
+PREFLIGHT_PASSES = ("frames", "widths", "vacuity", "symmetry")

@@ -1,0 +1,116 @@
+"""Pass 5 — kernel/IR drift detection.
+
+The hand-written kernels (models/*_kernel.py) and the lowerer
+(lower/compile.py) are two implementations of the same spec; the
+kernels are also the differential oracle the lowerer is held to.  The
+hazard this pass guards against is silent drift: a spec edit renames
+or adds an action, the lowerer picks it up from the AST automatically,
+and the hand kernel keeps exploring the OLD action set — every
+differential test still passes on the states both can reach.
+
+Cross-checks, per registered module:
+
+* action list — the kernel's ``action_names`` must equal the Next
+  disjunct names the spec model derives (a renamed/missing/extra
+  action is an ERROR; an order difference only reorders lane ids and
+  is a WARN);
+* lane-binder domains — for every action whose top-level existential
+  chain the IR extractor can lift (lower/ir.extract_action), the
+  binder-domain product must equal the kernel's ``_lane_count``
+  (a mismatch means the kernel enumerates a different bound-variable
+  space than the spec declares: WARN, since hand kernels may
+  legitimately over-enumerate and mask the excess with guards);
+* state layout — the kernel's hashed key tables (REP_KEYS/MSG_KEYS/
+  AUX_KEYS and, where present, GLOBAL_KEYS) must exactly cover the
+  codec's ``zero_state`` planes: a plane the kernel does not hash is
+  invisible to fingerprinting (ERROR), a key without a plane is a
+  stale layout reference (ERROR).
+"""
+
+from __future__ import annotations
+
+from ...core.values import TLAError
+from ...lower.ir import extract_action
+from ..report import SEV_ERROR, SEV_INFO, SEV_WARN
+
+PASS = "drift"
+
+
+def run(spec, report):
+    from ...models import registry
+    try:
+        codec_cls, kern_cls = registry._resolve(spec.module.name)
+    except KeyError:
+        report.add(PASS, SEV_INFO, spec.module.name,
+                   "no registered device kernel for this module; "
+                   "nothing to cross-check")
+        return
+    try:
+        codec = codec_cls(spec.ev.constants)
+    except TLAError as e:
+        report.add(PASS, SEV_WARN, spec.module.name,
+                   f"dense layout refuses these constants ({e}); "
+                   f"kernel cross-check skipped")
+        return
+    kern = kern_cls(codec, perms=registry.value_perm_table(spec, codec))
+    check_drift(spec, codec, kern, report)
+
+
+def check_drift(spec, codec, kern, report):
+    """Cross-check one (spec, codec, kernel) triple.  Split out from
+    ``run`` so tests can drive it with a stub kernel."""
+    spec_actions = [a.name for a in spec.actions]
+    kern_actions = list(kern.action_names)
+
+    missing = [n for n in spec_actions if n not in kern_actions]
+    extra = [n for n in kern_actions if n not in spec_actions]
+    for n in missing:
+        report.add(PASS, SEV_ERROR, n,
+                   "spec action has no kernel implementation (the "
+                   "kernel's action list has drifted from the spec's "
+                   "Next disjuncts)")
+    for n in extra:
+        report.add(PASS, SEV_ERROR, n,
+                   "kernel implements an action the spec's Next does "
+                   "not mention (renamed or removed in the spec)")
+    if not missing and not extra and spec_actions != kern_actions:
+        report.add(PASS, SEV_WARN, spec.module.name,
+                   "kernel action order differs from the spec's Next "
+                   "disjunct order (lane ids are permuted)")
+
+    # lane-binder domains vs kernel lane counts
+    shape = codec.shape
+    dims = {"replicas": shape.R, "values": shape.V,
+            "msgs": shape.MAX_MSGS, "subsets": 1 << shape.R,
+            "tracker": shape.R, "intrange": shape.MAX_OPS + 1}
+    for action in spec.actions:
+        if action.name not in kern_actions:
+            continue
+        air = extract_action(action.name, action.expr)
+        if not air.binders:
+            continue               # nothing liftable to compare
+        expected = 1
+        for b in air.binders:
+            expected *= dims[b.domain]
+        got = kern._lane_count(action.name)
+        if got != expected:
+            doms = "x".join(b.domain for b in air.binders)
+            report.add(PASS, SEV_WARN, action.name,
+                       f"kernel enumerates {got} lanes but the spec's "
+                       f"binder chain ({doms}) spans {expected} "
+                       f"combinations — lane plan drift")
+
+    # state-layout coverage: hashed keys vs dense planes
+    keys = set()
+    for attr in ("REP_KEYS", "MSG_KEYS", "AUX_KEYS", "GLOBAL_KEYS"):
+        keys.update(getattr(kern, attr, ()))
+    planes = set(codec.zero_state().keys())
+    for k in sorted(planes - keys):
+        report.add(PASS, SEV_ERROR, k,
+                   "dense state plane is not covered by the kernel's "
+                   "hashed key tables — the plane would be invisible "
+                   "to fingerprint dedup")
+    for k in sorted(keys - planes):
+        report.add(PASS, SEV_ERROR, k,
+                   "kernel key table names a plane the codec layout "
+                   "does not allocate (stale layout reference)")

@@ -1,0 +1,148 @@
+"""Pass 2 — width/overflow abstract interpretation.
+
+The dense layouts (models/*.py) pack narrow protocol fields into wider
+lanes: VSR's deterministic-CHOOSE sort key packs (client_id, operation,
+request_number, view_number) into one int32 at bit offsets 20/16/8/0
+(vsr_kernel._entry_sort_key), and the whole A01→CP06 family packs log
+entries as ``value_id << 8 | view_number`` (ENTRY_VIEW_BITS).  A cfg
+whose bound constants let a field exceed its lane silently corrupts
+fingerprints and CHOOSE tie-breaks — the classic "wraps after hours"
+failure the reference never had because TLC has no packed layouts.
+
+This pass derives per-field value ranges from the bound cfg constants
+alone (interval abstract interpretation over the constant bindings —
+no codec construction, so it still fires when the codec itself would
+refuse the config) and proves each range fits its allocated bit-width:
+
+* view_number  <= 1 + StartViewOnTimerLimit (+ RestartEmptyLimit on
+  VSR: views are only minted by TimerSendSVC under ``aux_svc < limit``,
+  VSR.tla:578-580; a restarted replica can re-reach old views)
+* op_number / request_number / operation id <= |Values| (each value is
+  requested at most once — the aux_client_acked ghost guard)
+* client_id <= ClientCount
+* recovery nonce x <= 1 + CrashLimit (UniqueNumber mints one per crash)
+
+plus a generic int31 check on every derived range and every integer
+constant (all dense planes are int32 lanes).
+"""
+
+from __future__ import annotations
+
+from ..report import SEV_ERROR, SEV_INFO, SEV_WARN
+
+PASS = "widths"
+
+INT31 = 1 << 31
+
+# Packed-field budgets per layout family: (field, limit, where) — a
+# field whose derived max REACHES the limit no longer fits.
+_VSR_PACKED = (
+    ("client_id", 1 << 11, "packed sort key bits 20..30 "
+                           "(vsr_kernel._entry_sort_key)"),
+    ("operation", 1 << 4, "packed sort key bits 16..19 "
+                          "(vsr_kernel._entry_sort_key)"),
+    ("request_number", 1 << 8, "packed sort key bits 8..15 "
+                               "(vsr_kernel._entry_sort_key)"),
+    ("view_number", 1 << 8, "packed sort key bits 0..7 "
+                            "(vsr_kernel._entry_sort_key)"),
+)
+_PACKED_ENTRY = (
+    ("view_number", 1 << 8, "packed log entry low byte "
+                            "(ENTRY_VIEW_BITS, models/a01.py)"),
+    ("operation", 1 << 23, "packed log entry high bits "
+                           "(value_id << 8 must fit int32)"),
+)
+
+# module name -> packed-field table (absent = generic checks only)
+FAMILY_PACKED = {
+    "VSR": _VSR_PACKED,
+    "VR_STATE_TRANSFER": (),          # scalar int32 entries, no packing
+    "VR_ASSUME_NEWVIEWCHANGE": _PACKED_ENTRY,
+    "VR_INC_RESEND": _PACKED_ENTRY,
+    "VR_APP_STATE": _PACKED_ENTRY,
+    "VR_REPLICA_RECOVERY": _PACKED_ENTRY,
+    "VR_REPLICA_RECOVERY_ASYNC_LOG": _PACKED_ENTRY,
+    "VR_REPLICA_RECOVERY_CP": _PACKED_ENTRY,
+}
+
+
+def derive_ranges(spec):
+    """Interval ranges of the protocol quantities, from cfg constants
+    alone.  Returns {} entries only for derivable quantities."""
+    c = spec.ev.constants
+    rng = {}
+
+    def geti(name, default=None):
+        v = c.get(name, default)
+        return v if isinstance(v, int) and not isinstance(v, bool) \
+            else None
+
+    timer = geti("StartViewOnTimerLimit")
+    restarts = geti("RestartEmptyLimit", 0)
+    crashes = geti("CrashLimit", 0)
+    values = c.get("Values")
+    nvalues = len(values) if isinstance(values, frozenset) else None
+    clients = geti("ClientCount", 1)
+    replicas = geti("ReplicaCount")
+
+    if timer is not None:
+        extra = restarts or 0
+        if spec.module.name != "VSR":
+            extra = 0          # only VSR's RestartEmpty re-mints views
+        rng["view_number"] = (0, 1 + timer + extra)
+    if nvalues is not None:
+        rng["operation"] = (0, nvalues)
+        rng["op_number"] = (0, nvalues)        # MAX_OPS = |Values|
+        rng["commit_number"] = (0, nvalues)
+        rng["request_number"] = (0, nvalues)
+    if clients is not None:
+        rng["client_id"] = (0, clients)
+    if replicas is not None:
+        rng["replica_id"] = (0, replicas)
+    if crashes is not None:
+        rng["recovery_nonce"] = (0, 1 + crashes)
+    return rng
+
+
+def run(spec, report):
+    rng = derive_ranges(spec)
+    c = spec.ev.constants
+
+    # generic int31 lane check: every derived range and every integer
+    # constant must fit a signed 32-bit dense plane
+    for name, (_lo, hi) in sorted(rng.items()):
+        if hi >= INT31:
+            report.add(PASS, SEV_ERROR, name,
+                       f"derived range [0, {hi}] exceeds the int32 "
+                       f"dense-plane width")
+    for name, v in sorted(c.items()):
+        if isinstance(v, int) and not isinstance(v, bool) and \
+                abs(v) >= INT31:
+            report.add(PASS, SEV_ERROR, name,
+                       f"constant {v} does not fit an int32 lane")
+
+    packed = FAMILY_PACKED.get(spec.module.name)
+    if packed is None:
+        report.add(PASS, SEV_INFO, spec.module.name,
+                   "no registered packed layout for this module; "
+                   "generic int32 checks only")
+        return
+
+    for fld, limit, where in packed:
+        if fld not in rng:
+            report.add(PASS, SEV_WARN, fld,
+                       f"cannot derive a static bound for {fld!r} from "
+                       f"the cfg constants; packed width {limit} in "
+                       f"{where} is unverified")
+            continue
+        lo, hi = rng[fld]
+        if hi >= limit:
+            report.add(PASS, SEV_ERROR, fld,
+                       f"derived range [{lo}, {hi}] overflows the "
+                       f"{limit.bit_length() - 1}-bit field in {where} "
+                       f"(max representable {limit - 1}); values would "
+                       f"wrap silently")
+        else:
+            report.add(PASS, SEV_INFO, fld,
+                       f"range [{lo}, {hi}] fits {where} "
+                       f"(headroom {limit - 1 - hi})")

@@ -35,7 +35,16 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    per-level host syncs — the remote-TPU mode; not
                    combinable with -checkpoint/-recover or temporal
                    properties)
+  -lint            run the speclint static analyzer (tpuvsr/analysis)
+                   over the bound spec and exit: 0 clean/warnings,
+                   1 errors.  With -json the report is one JSON object.
+                   -lint=off disables the engines' fail-fast pre-flight
+                   gate (equivalent to TPUVSR_LINT=off).
   -json            emit a one-line JSON result summary
+
+Mutually exclusive flags (argparse errors, exit code 2, before any
+spec is loaded): -fused with -checkpoint/-recover; -fpset host with
+-engine device; -fpset hbm/paged with -engine interp.
 """
 
 from __future__ import annotations
@@ -80,24 +89,40 @@ def build_parser():
                         "instead of the hand-written kernel; falls "
                         "back to the hand kernel for modules beyond "
                         "the lowerer's surface")
+    p.add_argument("-lint", nargs="?", const="full", default=None,
+                   choices=["full", "off"], metavar="MODE",
+                   help="run the speclint static analyzer and exit "
+                        "(plain -lint), or -lint=off to disable the "
+                        "engine pre-flight gate")
     return p
+
+
+def validate_args(parser, args):
+    """Flag-conflict validation at parse time: documented mutual
+    exclusions fail with argparse's usage error (exit code 2) instead
+    of a late engine failure."""
+    if args.fused and (args.checkpoint is not None or args.recover):
+        parser.error("-fused cannot be combined with "
+                     "-checkpoint/-recover (the fused fixpoint never "
+                     "syncs at a level boundary to snapshot)")
+    if args.fpset == "host" and args.engine == "device":
+        parser.error("-fpset host requires -engine interp (the host "
+                     "fingerprint set only exists in the interpreter)")
+    if args.fpset in ("hbm", "paged") and args.engine == "interp":
+        parser.error(f"-fpset {args.fpset} requires the device engine")
 
 
 def _pick_engine(requested, fpset, spec):
     # -fpset mirrors TLC's pluggable FPSet class selection: the HBM
     # table only exists in the device engine, the host set only in the
-    # interpreter (BASELINE.json north_star gating)
+    # interpreter (BASELINE.json north_star gating).  Conflicting
+    # fpset/engine combinations are rejected at parse time by
+    # validate_args (exit code 2), so only consistent ones reach here.
     if fpset == "hbm":
-        if requested == "interp":
-            raise SystemExit("-fpset hbm requires the device engine")
         return "device"
     if fpset == "paged":
-        if requested == "interp":
-            raise SystemExit("-fpset paged requires the device engine")
         return "paged"
     if fpset == "host":
-        if requested == "device":
-            raise SystemExit("-fpset host requires -engine interp")
         return "interp"
     if requested != "auto":
         return requested
@@ -108,15 +133,27 @@ def _pick_engine(requested, fpset, spec):
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    validate_args(parser, args)
     if args.lower:
         os.environ["TPUVSR_COMPILED"] = "1"
+    if args.lint == "off":
+        os.environ["TPUVSR_LINT"] = "off"
     from ..engine.spec import load_spec
     from ..engine.trace import format_trace
     from ..platform_select import ensure_backend
 
     cfg_path = args.config or os.path.splitext(args.spec)[0] + ".cfg"
     spec = load_spec(args.spec, cfg_path)
+
+    if args.lint == "full":
+        # lint-only mode: full report (all five passes), no dispatch
+        from ..analysis import run_lint
+        report = run_lint(spec)
+        print(report.to_json() if args.json else report.render())
+        return report.exit_code
+
     engine = _pick_engine(args.engine, args.fpset, spec)
     t0 = time.time()
 
@@ -128,6 +165,16 @@ def main(argv=None):
         log(f"backend: {backend}")
     log(f"spec {spec.module.name}, engine {engine}, "
         f"{'simulation' if args.simulate else 'BFS'}")
+
+    # speclint pre-flight: same gate the engines run, surfaced here as
+    # a clean exit instead of a traceback (the engines' own call then
+    # hits the per-spec cache).  -lint=off / TPUVSR_LINT=off bypasses.
+    from ..analysis import LintError, preflight
+    try:
+        preflight(spec, log=log)
+    except LintError as e:
+        print(f"[tpuvsr] {e}", file=sys.stderr)
+        return 1
 
     if args.simulate:
         if engine in ("device", "paged"):

@@ -40,6 +40,8 @@ class CheckResult:
 def bfs_check(spec: SpecModel, check_deadlock: bool = False,
               max_states: int = None, progress_every: float = 10.0,
               log=None) -> CheckResult:
+    from ..analysis import preflight
+    preflight(spec, log=log)      # speclint gate (TPUVSR_LINT=off skips)
     res = CheckResult()
     t0 = time.time()
     seen = {}           # canonical view value -> state id

@@ -57,15 +57,6 @@ from .trace import TraceEntry
 
 I32 = jnp.int32
 
-# The jitted level kernel takes minutes to build; persist compiled
-# binaries across processes (bench, CLI, tests share one cache).
-if not jax.config.jax_compilation_cache_dir:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("TPUVSR_JAX_CACHE",
-                       os.path.expanduser("~/.cache/tpuvsr_jax")))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-
 # level-kernel stop reasons
 RUNNING = 0
 R_VIOLATION = 2      # an invariant failed on a generated state
@@ -121,6 +112,8 @@ class DeviceBFS:
         # is the hand-kernel registry, tests/the CLI can pass the
         # AST-compiled factory (lower/compile.make_compiled_model)
         self._model_factory = model_factory or registry.make_model
+        registry.ensure_compile_cache()
+        self.debug_checks = registry.ensure_debug_flags()
         self._build(max_msgs)
 
     # ------------------------------------------------------------------
@@ -557,6 +550,8 @@ class DeviceBFS:
             check_deadlock=False, log=None, progress_every=10.0,
             checkpoint_path=None, checkpoint_every=None,
             resume_from=None) -> CheckResult:
+        from ..analysis import preflight
+        preflight(self.spec, log=log)   # fail fast, before any dispatch
         spec, codec = self.spec, self.codec  # codec only for init encode
         res = CheckResult()
         t0 = time.time()
@@ -739,6 +734,8 @@ class DeviceBFS:
             front, bufs = nb, (front, fpar, fact, fprm)
             fpar, fact, fprm = nbp, nba, nbprm
             n_front = n_next
+            if self.debug_checks and n_next:
+                self._debug_assert_widths(front, n_next, depth)
             if checkpoint_path and n_next and (
                     checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
@@ -779,6 +776,28 @@ class DeviceBFS:
         res.diameter = depth
         return self._finish(res, t0, depth, fp_count)
 
+    def _debug_assert_widths(self, front, n_front, depth):
+        """TPUVSR_DEBUG_NANS=1 overflow guard: after each level, pull
+        the view/op planes of the committed frontier and assert they
+        stay inside the statically derived ranges (the widths lint
+        pass).  Catches packed-field wrap the moment it happens instead
+        of as a fingerprint anomaly millions of states later."""
+        if not hasattr(self, "_debug_bounds"):
+            from ..analysis.passes.widths import derive_ranges
+            rng = derive_ranges(self.spec)
+            self._debug_bounds = {
+                k: rng[q] for k, q in (("view", "view_number"),
+                                       ("op", "op_number"))
+                if q in rng and k in front}
+        for plane, (lo, hi) in self._debug_bounds.items():
+            vals = np.asarray(front[plane][:n_front])
+            if vals.size and (vals.min() < lo or vals.max() > hi):
+                raise TLAError(
+                    f"debug overflow guard: plane {plane!r} reached "
+                    f"[{int(vals.min())}, {int(vals.max())}] at depth "
+                    f"{depth}, outside the derived range [{lo}, {hi}] "
+                    f"(TPUVSR_DEBUG_NANS width assertion)")
+
     # ------------------------------------------------------------------
     # fused run: whole fixpoint in O(1) dispatches
     # ------------------------------------------------------------------
@@ -792,6 +811,8 @@ class DeviceBFS:
         Trace pointers and level sizes accumulate on device and are
         pulled once at the end.  No checkpoint/resume (use run() for
         long preemptible jobs)."""
+        from ..analysis import preflight
+        preflight(self.spec, log=log)   # fail fast, before any dispatch
         spec, codec = self.spec, self.codec
         res = CheckResult()
         t0 = time.time()

@@ -90,6 +90,8 @@ class PagedBFS(DeviceBFS):
             check_deadlock=False, log=None, progress_every=10.0,
             checkpoint_path=None, checkpoint_every=None,
             resume_from=None) -> CheckResult:
+        from ..analysis import preflight
+        preflight(self.spec, log=log)   # fail fast, before any dispatch
         spec = self.spec
         res = CheckResult()
         t0 = time.time()

@@ -2000,6 +2000,10 @@ def make_compiled_model(spec, max_msgs=None):
     differential oracle (tests/test_lower.py)."""
     from ..models import registry
 
+    # direct callers (tests/test_lower.py, scripts) bypass make_model,
+    # so set up the persistent compilation cache here too — the jitted
+    # level kernels built from these models take minutes to compile
+    registry.ensure_compile_cache()
     codec_cls, base_cls = registry._resolve(spec.module.name)
     codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
     perms = registry.value_perm_table(spec, codec)

@@ -52,6 +52,7 @@ REP_KEYS = RR05Kernel.REP_KEYS + (
 class CP06Kernel(RR05Kernel):
     action_names = ACTION_NAMES
     REP_KEYS = REP_KEYS
+    MSG_KEYS = RR05Kernel.MSG_KEYS + ("m_cp",)
     PERM_REP_KEYS = ("log", "app", "dvc_log", "dvc_cp", "rec_log",
                      "rec_cp")
     PERM_MSG_KEYS = ("m_entry", "m_log", "m_cp")

@@ -25,16 +25,43 @@ import numpy as np
 
 import jax
 
-# Jitted kernels (level pass, sim chunk, sharded step) take minutes to
-# build on a single CPU core; persist compiled binaries across processes
-# so bench/CLI/tests/hunt scripts share one cache.  Lives here because
-# every engine imports the registry.
-if not jax.config.jax_compilation_cache_dir:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("TPUVSR_JAX_CACHE",
-                       os.path.expanduser("~/.cache/tpuvsr_jax")))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+_cache_configured = False
+
+
+def ensure_compile_cache():
+    """Persistent-compilation-cache setup, shared by every engine entry
+    point (device_bfs, device_sim, sharded_bfs, make_model).
+
+    Jitted kernels (level pass, sim chunk, sharded step) take minutes
+    to build on a single CPU core; persisting compiled binaries lets
+    bench/CLI/tests/hunt scripts share one cache.  Idempotent, never
+    overrides an explicitly configured cache dir, and honors
+    ``TPUVSR_JAX_CACHE=""`` (empty) to disable entirely.  This used to
+    run unconditionally at import time, which mutated global jax config
+    for any process that merely imported the registry."""
+    global _cache_configured
+    if _cache_configured or jax.config.jax_compilation_cache_dir:
+        return
+    cache_dir = os.environ.get("TPUVSR_JAX_CACHE",
+                               os.path.expanduser("~/.cache/tpuvsr_jax"))
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+    _cache_configured = True
+
+
+def ensure_debug_flags():
+    """Opt-in numerical debugging for device-engine runs:
+    ``TPUVSR_DEBUG_NANS=1`` enables jax_debug_nans (every dispatch
+    checks outputs) and tells the engines to assert on kernel overflow
+    flags instead of only surfacing them as growth events.  Returns
+    True when debug mode is active."""
+    if os.environ.get("TPUVSR_DEBUG_NANS") != "1":
+        return False
+    if not jax.config.jax_debug_nans:
+        jax.config.update("jax_debug_nans", True)
+    return True
 
 
 def value_perm_table(spec, codec):
@@ -78,6 +105,7 @@ def make_model(spec, max_msgs=None):
     compiled from the spec AST (lower/compile.py) instead of using the
     hand-written kernel — the hand kernel stays the differential
     oracle (tests/test_lower.py)."""
+    ensure_compile_cache()
     if os.environ.get("TPUVSR_COMPILED") == "1":
         from ..core.values import TLAError
         from ..lower.compile import make_compiled_model

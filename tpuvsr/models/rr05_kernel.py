@@ -53,6 +53,7 @@ REP_KEYS = AS04Kernel.REP_KEYS + (
 class RR05Kernel(AS04Kernel):
     action_names = ACTION_NAMES
     REP_KEYS = REP_KEYS
+    AUX_KEYS = AS04Kernel.AUX_KEYS + ("aux_restart",)
     PERM_REP_KEYS = ("log", "app", "dvc_log", "rec_log")
 
     def __init__(self, codec: RR05Codec, perms=None):

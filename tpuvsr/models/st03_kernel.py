@@ -73,6 +73,9 @@ class ST03Kernel:
     action_names = ACTION_NAMES
     REP_KEYS = REP_KEYS          # per-replica hashed planes (class attr
                                  # so subclasses can extend the layout)
+    MSG_KEYS = MSG_KEYS
+    AUX_KEYS = AUX_KEYS
+    GLOBAL_KEYS = GLOBAL_KEYS
     # value-id planes a symmetry permutation must remap
     PERM_REP_KEYS = ("log",)
     PERM_MSG_KEYS = ("m_entry", "m_log")

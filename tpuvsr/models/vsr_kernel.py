@@ -102,6 +102,11 @@ def _lex_less(a, b):
 
 class VSRKernel:
     action_names = ACTION_NAMES
+    # layout key tables as class attributes: the speclint drift pass
+    # (analysis/passes/drift.py) checks them against codec.zero_state
+    REP_KEYS = REP_KEYS
+    MSG_KEYS = MSG_KEYS
+    AUX_KEYS = AUX_KEYS
 
     def __init__(self, codec: VSRCodec, perms: np.ndarray = None):
         self.codec = codec

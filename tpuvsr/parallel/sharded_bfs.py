@@ -349,6 +349,8 @@ class ShardedBFS:
 
     def _build(self, max_msgs):
         from ..models import registry
+        registry.ensure_compile_cache()
+        registry.ensure_debug_flags()
         self.codec, self.kern = registry.make_model(self.spec,
                                                     max_msgs=max_msgs)
         self._inv = self.kern.invariant_fn(self.inv_names)
@@ -411,9 +413,11 @@ class ShardedBFS:
             log=None, check_deadlock=None, checkpoint_path=None,
             checkpoint_every=None, resume_from=None) -> "CheckResult":
         import time as _time
+        from ..analysis import preflight
         from ..core.values import TLAError
         from ..engine.bfs import CheckResult
         from ..engine.fpset import grow as fp_grow
+        preflight(self.spec, log=log)   # fail fast, before any dispatch
         spec, codec = self.spec, self.codec
         D = self.D
         res = CheckResult()
