@@ -187,6 +187,10 @@ def main():
         "elapsed_s": round(res.elapsed, 2),
         "generated_per_s": round(dev_sps, 1),
         "reached_fixpoint": res.error is None,
+        # tpuvsr-metrics/1 document of the timed run (phase timers,
+        # counters, per-level trajectory) — BENCH_*.json files become
+        # directly diffable via scripts/compare_bench.py
+        "metrics": res.metrics,
     })
     # second timed run on the same engine: separates machine noise from
     # real throughput (VERDICT r3 item 8 asked the r2->r3 CPU drop be

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Diff two metrics-schema JSON files and gate on throughput
+regression — the check future perf PRs cite (ISSUE 2 satellite).
+
+    python scripts/compare_bench.py BASELINE.json CANDIDATE.json \
+        [--max-regression PCT] [--metric NAME]
+
+Accepts any of:
+  * a tpuvsr-metrics/1 document (the CLI's -metrics dump, or
+    CheckResult.metrics embedded anywhere);
+  * a bench.py RESULT line (BENCH_*.json) — uses its embedded
+    "metrics" document when present, else the legacy top-level
+    "value" (distinct states/sec) field.
+
+Exit codes: 0 = candidate within tolerance, 1 = regression beyond
+--max-regression percent, 2 = inputs unusable.  Phase-timer and
+counter deltas are printed for context either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+METRICS_SCHEMA = "tpuvsr-metrics/1"
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def find_metrics(doc):
+    """The tpuvsr-metrics/1 document inside `doc`, or None."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("schema") == METRICS_SCHEMA:
+        return doc
+    m = doc.get("metrics")
+    if isinstance(m, dict) and m.get("schema") == METRICS_SCHEMA:
+        return m
+    return None
+
+
+def throughput(doc, metric):
+    """(value, source_description) for the gated metric."""
+    if not isinstance(doc, dict):
+        return None, None
+    m = find_metrics(doc)
+    if m is not None:
+        g = m.get("gauges", {})
+        if metric in g:
+            return float(g[metric]), f"gauges.{metric}"
+        # derivable fallback for distinct_per_s
+        if metric == "distinct_per_s" and m.get("elapsed_s"):
+            d = m.get("distinct")
+            if d is not None:
+                return d / float(m["elapsed_s"]), "distinct/elapsed_s"
+    if metric == "distinct_per_s" and "value" in doc:
+        # legacy bench.py RESULT line: value IS distinct states/sec
+        return float(doc["value"]), "legacy bench value"
+    return None, None
+
+
+def fmt_delta(base, cand):
+    if base in (0, None):
+        return "n/a"
+    return f"{100.0 * (cand - base) / base:+.1f}%"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--max-regression", type=float, default=10.0,
+                    metavar="PCT",
+                    help="fail when the metric drops more than PCT%% "
+                         "below baseline (default 10)")
+    ap.add_argument("--metric", default="distinct_per_s",
+                    help="gauge to gate on (default distinct_per_s)")
+    args = ap.parse_args(argv)
+
+    try:
+        base_doc, cand_doc = load(args.baseline), load(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"compare_bench: cannot load inputs: {e}",
+              file=sys.stderr)
+        return 2
+    base, bsrc = throughput(base_doc, args.metric)
+    cand, csrc = throughput(cand_doc, args.metric)
+    if base is None or cand is None:
+        print(f"compare_bench: metric {args.metric!r} not found "
+              f"(baseline: {bsrc}, candidate: {csrc})", file=sys.stderr)
+        return 2
+
+    print(f"{args.metric}: baseline {base:.1f} ({bsrc}) -> "
+          f"candidate {cand:.1f} ({csrc})  [{fmt_delta(base, cand)}]")
+
+    # context: phase-timer and counter drift between the documents
+    bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
+    if bm and cm:
+        for section in ("phases", "counters"):
+            keys = sorted(set(bm.get(section, {}))
+                          | set(cm.get(section, {})))
+            for k in keys:
+                b = bm.get(section, {}).get(k, 0)
+                c = cm.get(section, {}).get(k, 0)
+                if b or c:
+                    print(f"  {section}.{k}: {b} -> {c} "
+                          f"({fmt_delta(b, c)})")
+        bl, cl = bm.get("levels") or [], cm.get("levels") or []
+        if bl and cl and (len(bl) != len(cl)
+                          or bl[-1].get("distinct")
+                          != cl[-1].get("distinct")):
+            print(f"  trajectory: {len(bl)} levels / "
+                  f"{bl[-1].get('distinct')} distinct -> {len(cl)} / "
+                  f"{cl[-1].get('distinct')} (NOT the same exploration"
+                  f" — throughput comparison may be meaningless)")
+
+    if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
+        print(f"compare_bench: REGRESSION beyond "
+              f"{args.max_regression:.1f}% tolerance", file=sys.stderr)
+        return 1
+    print("compare_bench: within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

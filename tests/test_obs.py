@@ -1,0 +1,631 @@
+"""Observability layer (tpuvsr/obs) tests.
+
+Golden-schema half (no reference needed): the journal JSONL and the
+metrics document from interpreter runs must validate against the
+tpuvsr-journal/1 / tpuvsr-metrics/1 schemas, and the collector must
+set CheckResult timing fields uniformly.
+
+Device half (reference-gated, CPU backend like every device test):
+* interp and device runs of the same spec emit journals whose shared
+  event types carry IDENTICAL key sets (the drift-proofing the golden
+  files exist for);
+* the device phase timers (compile + dispatch + host_sync + check)
+  sum to within 10% of wall-clock elapsed (ISSUE 2 acceptance);
+* a -checkpoint/-recover pair appended to ONE journal file yields a
+  continuous event stream with cumulative elapsed preserved.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.conftest import requires_reference, vsr_spec
+from tpuvsr.engine.bfs import bfs_check
+from tpuvsr.engine.spec import SpecModel
+from tpuvsr.frontend.cfg import parse_cfg_text
+from tpuvsr.frontend.parser import parse_module_text
+from tpuvsr.obs import (Metrics, RunObserver, read_journal,
+                        validate_journal_line, validate_metrics)
+
+COUNTER = """---- MODULE ObsCounter ----
+EXTENDS Naturals
+CONSTANTS Limit
+VARIABLES x, y
+
+Init == x = 0 /\\ y = 0
+
+IncX ==
+    /\\ x < Limit
+    /\\ x' = x + 1
+    /\\ UNCHANGED y
+
+IncY ==
+    /\\ y < Limit
+    /\\ y' = y + 1
+    /\\ UNCHANGED x
+
+Next == IncX \\/ IncY
+
+Bound == x + y <= 2 * Limit
+====
+"""
+COUNTER_CFG = ("CONSTANTS\n    Limit = 3\n"
+               "INIT Init\nNEXT Next\nINVARIANT Bound\n")
+
+
+def counter_spec():
+    return SpecModel(parse_module_text(COUNTER),
+                     parse_cfg_text(COUNTER_CFG))
+
+
+# ---------------------------------------------------------------------
+# collector unit tests
+# ---------------------------------------------------------------------
+def test_metrics_timers_are_exclusive_and_sum():
+    m = Metrics()
+    with m.timer("outer"):
+        time.sleep(0.02)
+        with m.timer("inner"):
+            time.sleep(0.02)
+    # inner time is carved OUT of outer: both ~20ms, not outer ~40ms
+    assert m.phases["inner"] >= 0.015
+    assert m.phases["outer"] >= 0.015
+    assert m.phases["outer"] < m.phases["inner"] + 0.05
+    total = sum(m.phases.values())
+    assert 0.03 <= total <= 0.2
+
+
+def test_metrics_same_phase_nesting_accumulates_once():
+    m = Metrics()
+    with m.timer("check"):
+        with m.timer("check"):
+            time.sleep(0.01)
+    assert 0.008 <= m.phases["check"] <= 0.1
+
+
+def test_metrics_drain_closes_open_frames():
+    m = Metrics()
+    m.begin("check")
+    m.begin("dispatch")
+    time.sleep(0.01)
+    m.drain()
+    assert not m._stack
+    assert "dispatch" in m.phases and "check" in m.phases
+
+
+def test_validate_metrics_rejects_malformed():
+    m = Metrics()
+    doc = m.to_dict(run_id="r", engine="interp", elapsed_s=0.0)
+    validate_metrics(doc)
+    with pytest.raises(ValueError):
+        validate_metrics({k: v for k, v in doc.items()
+                          if k != "phases"})
+    bad = dict(doc)
+    bad["schema"] = "tpuvsr-metrics/999"
+    with pytest.raises(ValueError):
+        validate_metrics(bad)
+
+
+def test_validate_journal_line_rejects_unknown_and_missing():
+    with pytest.raises(ValueError):
+        validate_journal_line({"event": "nope", "ts": 0, "run_id": "r"})
+    with pytest.raises(ValueError):
+        validate_journal_line({"event": "level_done", "ts": 0,
+                               "run_id": "r", "depth": 1})
+
+
+def test_progress_formatter_is_uniform():
+    lines = []
+    obs = RunObserver(log=lines.append, progress_every=0.0)
+    obs.start(time.time() - 2.0, backend="host")
+    obs.progress(depth=3, distinct=100, generated=400, force=True)
+    obs.progress(walks=20, steps=900, force=True)
+    assert lines[0].startswith("depth 3: 100 distinct, 400 generated")
+    assert "distinct/s" in lines[0] and "gen/s" in lines[0]
+    assert lines[1].startswith("20 walks, 900 steps")
+    assert "steps/s" in lines[1]
+
+
+def test_progress_throttles():
+    lines = []
+    obs = RunObserver(log=lines.append, progress_every=3600.0)
+    obs.start(time.time())
+    assert not obs.progress(depth=1, distinct=1, generated=1)
+    assert obs.progress(depth=1, distinct=1, generated=1, force=True)
+    assert len(lines) == 1
+
+
+# ---------------------------------------------------------------------
+# interpreter engines emit schema-valid artifacts (no reference)
+# ---------------------------------------------------------------------
+def test_interp_bfs_journal_and_metrics(tmp_path):
+    jp = str(tmp_path / "run.jsonl")
+    mp = str(tmp_path / "metrics.json")
+    obs = RunObserver(journal_path=jp, metrics_path=mp)
+    res = bfs_check(counter_spec(), obs=obs)
+    assert res.ok
+    # collector-set result fields (ISSUE 2 satellite: first-class,
+    # uniform — not patched post hoc per engine)
+    assert res.levels == [1, 2, 3, 4, 3, 2, 1]
+    assert res.elapsed > 0
+    assert res.states_per_sec == pytest.approx(
+        res.states_generated / res.elapsed, rel=1e-6)
+    events = read_journal(jp)          # validates every line
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert kinds.count("level_done") == 7
+    assert events[0]["resumed"] is False
+    end = events[-1]
+    assert end["ok"] is True and end["distinct"] == 16
+    # per-level rows mirror the journal
+    doc = validate_metrics(json.load(open(mp)))
+    assert doc == res.metrics
+    assert [r["frontier"] for r in doc["levels"]] == [1, 2, 3, 4, 3, 2, 1]
+    assert doc["levels"][-1]["distinct"] == 16
+    # phases cover the wall clock (interp: everything under "check")
+    assert sum(doc["phases"].values()) <= res.elapsed * 1.05
+    assert sum(doc["phases"].values()) >= res.elapsed * 0.5
+
+
+def test_interp_bfs_violation_event(tmp_path):
+    jp = str(tmp_path / "viol.jsonl")
+    cfg = ("CONSTANTS\n    Limit = 3\n"
+           "INIT Init\nNEXT Next\nINVARIANT Small\n")
+    src = COUNTER.replace("Bound == x + y <= 2 * Limit",
+                          "Small == x + y <= 2")
+    spec = SpecModel(parse_module_text(src), parse_cfg_text(cfg))
+    res = bfs_check(spec, obs=RunObserver(journal_path=jp))
+    assert not res.ok and res.violated_invariant == "Small"
+    events = read_journal(jp)
+    viol = [e for e in events if e["event"] == "violation"]
+    assert len(viol) == 1
+    assert viol[0]["kind"] == "invariant" and viol[0]["name"] == "Small"
+    assert events[-1]["event"] == "run_end"
+    assert events[-1]["ok"] is False
+
+
+def test_interp_simulate_metrics():
+    from tpuvsr.engine.simulate import simulate
+    res = simulate(counter_spec(), num=5, depth=10, seed=3)
+    doc = validate_metrics(res.metrics)
+    assert doc["engine"] == "interp-sim"
+    assert doc["walks"] == 5 and doc["steps"] == res.steps
+
+
+def test_observer_rearm_on_reuse(tmp_path):
+    # one observer across two runs (the checkpoint/recover idiom):
+    # the second segment must journal too, not silently vanish
+    jp = str(tmp_path / "reuse.jsonl")
+    obs = RunObserver(journal_path=jp)
+    bfs_check(counter_spec(), obs=obs)
+    bfs_check(counter_spec(), obs=obs)
+    kinds = [e["event"] for e in read_journal(jp)]
+    assert kinds.count("run_start") == 2
+    assert kinds.count("run_end") == 2
+
+
+def test_default_observer_always_collects():
+    res = bfs_check(counter_spec())
+    validate_metrics(res.metrics)
+    assert res.levels and res.states_per_sec > 0
+
+
+# ---------------------------------------------------------------------
+# compare_bench gate
+# ---------------------------------------------------------------------
+def _metrics_doc(distinct_per_s):
+    m = Metrics()
+    m.gauge("distinct_per_s", distinct_per_s)
+    return m.to_dict(run_id="r", engine="device", elapsed_s=1.0,
+                     distinct=1000)
+
+
+def test_compare_bench_gates_regression(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import compare_bench
+    base = tmp_path / "base.json"
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    base.write_text(json.dumps(_metrics_doc(1000.0)))
+    good.write_text(json.dumps(_metrics_doc(950.0)))
+    bad.write_text(json.dumps(_metrics_doc(500.0)))
+    assert compare_bench.main([str(base), str(good)]) == 0
+    assert compare_bench.main([str(base), str(bad)]) == 1
+    # 60% tolerance admits the slow candidate
+    assert compare_bench.main([str(base), str(bad),
+                               "--max-regression", "60"]) == 0
+    # legacy bench.py RESULT line (top-level "value")
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"value": 990.0}))
+    assert compare_bench.main([str(base), str(legacy)]) == 0
+    junk = tmp_path / "junk.json"
+    junk.write_text("{}")
+    assert compare_bench.main([str(base), str(junk)]) == 2
+    scalar = tmp_path / "scalar.json"
+    scalar.write_text("5")         # valid JSON, not an object
+    assert compare_bench.main([str(base), str(scalar)]) == 2
+
+
+# ---------------------------------------------------------------------
+# CLI flags (interp engine; no reference needed)
+# ---------------------------------------------------------------------
+def test_cli_metrics_journal_flags(tmp_path):
+    (tmp_path / "ObsCounter.tla").write_text(COUNTER)
+    (tmp_path / "ObsCounter.cfg").write_text(COUNTER_CFG)
+    mp, jp = tmp_path / "m.json", tmp_path / "j.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpuvsr",
+         str(tmp_path / "ObsCounter.tla"), "-engine", "interp",
+         "-json", "-metrics", str(mp), "-journal", str(jp)],
+        capture_output=True, text=True, timeout=420,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))),
+             "HOME": "/root"})
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    # -json carries the collector summary (phases/counters/gauges);
+    # the per-level trajectory stays in the -metrics file only
+    assert out["metrics"]["phases"].get("check", 0) > 0
+    assert "levels" not in out and "levels" not in out["metrics"]
+    doc = validate_metrics(json.load(open(mp)))
+    assert doc["module"] == "ObsCounter"
+    assert [r_["frontier"] for r_ in doc["levels"]] == [
+        1, 2, 3, 4, 3, 2, 1]
+    events = read_journal(str(jp))
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "run_end"
+    # final stats table rendered on stderr for -metrics runs
+    assert "phase seconds:" in r.stderr
+
+
+# ---------------------------------------------------------------------
+# device engines driven through a stub kernel (no reference needed):
+# exercises the REAL DeviceBFS/PagedBFS loops — dispatch accounting,
+# journal events, checkpoint/recover continuity — on the inline
+# counter spec via the model_factory hook
+# ---------------------------------------------------------------------
+import numpy as np
+
+
+def _stub_factory(limit=3):
+    import jax
+    import jax.numpy as jnp
+
+    class _Shape:
+        MAX_MSGS = 4
+
+    class StubCodec:
+        MSG_KEYS = ()
+
+        def __init__(self):
+            self.shape = _Shape()
+
+        def zero_state(self):
+            # "status" is the plane the level kernel sizes buffers by
+            return {"status": 0, "x": 0, "y": 0, "err": 0}
+
+        def encode(self, st):
+            return {"status": np.int32(0), "x": np.int32(st["x"]),
+                    "y": np.int32(st["y"]), "err": np.int32(0)}
+
+        def decode(self, d):
+            return {"x": int(np.asarray(d["x"])),
+                    "y": int(np.asarray(d["y"]))}
+
+        def pad_msgs(self, batch, old):
+            return batch
+
+    class StubKern:
+        action_names = ["IncX", "IncY"]
+        n_lanes = 2
+
+        def _lane_count(self, name):
+            return 1
+
+        def _guard_fns(self):
+            return [lambda st, ln: st["x"] < limit,
+                    lambda st, ln: st["y"] < limit]
+
+        def _action_fns(self):
+            def incx(st, ln):
+                succ = {"status": st["status"], "x": st["x"] + 1,
+                        "y": st["y"], "err": jnp.int32(0)}
+                return succ, st["x"] < limit
+
+            def incy(st, ln):
+                succ = {"status": st["status"], "x": st["x"],
+                        "y": st["y"] + 1, "err": jnp.int32(0)}
+                return succ, st["y"] < limit
+            return [incx, incy]
+
+        lane_action = np.array([0, 1], np.int32)
+        lane_param = np.array([0, 0], np.int32)
+
+        def step_all(self, st):
+            succs, ens = [], []
+            for f in self._action_fns():
+                s, e = f(st, jnp.int32(0))
+                succs.append(s)
+                ens.append(e)
+            return ({k: jnp.stack([s[k] for s in succs])
+                     for k in succs[0]}, jnp.stack(ens))
+
+        def fingerprint(self, st):
+            x = jnp.uint32(st["x"])
+            y = jnp.uint32(st["y"])
+            return jnp.stack([x * jnp.uint32(7) + y + jnp.uint32(1),
+                              x + jnp.uint32(1), y + jnp.uint32(1),
+                              jnp.uint32(99)])
+
+        def fingerprint_batch(self, batch):
+            arr = {k: jnp.asarray(v) for k, v in batch.items()}
+            return jax.vmap(self.fingerprint)(arr)
+
+        def invariant_fn(self, names):
+            return lambda st: jnp.asarray(True)
+
+    return lambda spec, max_msgs=None: (StubCodec(), StubKern())
+
+
+def _stub_device_engine(cls=None, **kw):
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    cls = cls or DeviceBFS
+    return cls(counter_spec(), model_factory=_stub_factory(),
+               hash_mode="full", tile_size=4, fpset_capacity=1 << 8,
+               next_capacity=1 << 6, **kw)
+
+
+def test_stub_device_bfs_journal_metrics(tmp_path):
+    jp = str(tmp_path / "dev.jsonl")
+    mp = str(tmp_path / "dev.json")
+    eng = _stub_device_engine()
+    res = eng.run(obs=RunObserver(journal_path=jp, metrics_path=mp))
+    assert res.ok and res.distinct_states == 16
+    assert res.levels == [1, 2, 3, 4, 3, 2, 1]
+    assert res.states_per_sec > 0 and res.elapsed > 0
+    events = read_journal(jp)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert kinds.count("level_done") == 7
+    assert events[0]["engine"] == "device"
+    doc = validate_metrics(json.load(open(mp)))
+    assert doc["counters"]["dispatches"] >= 7
+    ph = doc["phases"]
+    core = sum(ph.get(k, 0.0) for k in ("compile", "dispatch",
+                                        "host_sync", "check"))
+    # ISSUE 2 acceptance: the four core phases cover >=90% of elapsed
+    assert core >= 0.90 * res.elapsed, (ph, res.elapsed)
+    assert sum(ph.values()) <= 1.05 * res.elapsed
+    assert ph.get("compile", 0) > 0      # first dispatch charged there
+    assert 0 < doc["gauges"]["fpset_occupancy"] <= 1.0
+    assert "fpset_collision_rate" in doc["gauges"]
+
+
+def test_stub_device_interp_journal_key_sets_match(tmp_path):
+    ji, jd = str(tmp_path / "i.jsonl"), str(tmp_path / "d.jsonl")
+    ri = bfs_check(counter_spec(), obs=RunObserver(journal_path=ji))
+    rd = _stub_device_engine().run(obs=RunObserver(journal_path=jd))
+    assert ri.distinct_states == rd.distinct_states == 16
+    assert ri.levels == rd.levels
+
+    def keysets(events):
+        out = {}
+        for e in events:
+            out.setdefault(e["event"], set()).update(e.keys())
+        return out
+    ki, kd = keysets(read_journal(ji)), keysets(read_journal(jd))
+    for ev in set(ki) & set(kd):
+        assert ki[ev] == kd[ev], f"{ev} keys drifted between engines"
+    for ev in ("run_start", "level_done", "run_end"):
+        assert ev in ki and ev in kd
+
+
+def test_stub_fused_run_metrics():
+    eng = _stub_device_engine()
+    res = eng.run_fused()
+    assert res.ok and res.distinct_states == 16
+    assert res.levels == [1, 2, 3, 4, 3, 2, 1]
+    doc = validate_metrics(res.metrics)
+    assert doc["engine"] == "device-fused"
+    assert doc["counters"]["dispatches"] >= 1
+    # fused records the 6 non-empty levels beyond init (the final
+    # expansion that generates nothing gets no on-device row)
+    assert len(doc["levels"]) == 6
+    assert [r["frontier"] for r in doc["levels"]] == [1, 2, 3, 4, 3, 2]
+    assert doc["levels"][-1]["distinct"] == 16
+
+
+def test_stub_paged_bfs_spill_events(tmp_path):
+    from tpuvsr.engine.paged_bfs import PagedBFS
+    jp = str(tmp_path / "paged.jsonl")
+    eng = _stub_device_engine(cls=PagedBFS, chunk_tiles=1)
+    res = eng.run(obs=RunObserver(journal_path=jp))
+    assert res.ok and res.distinct_states == 16
+    events = read_journal(jp)
+    spills = [e for e in events if e["event"] == "spill"]
+    assert spills, "paged run must journal its host page-outs"
+    assert all(e["bytes"] == e["rows"] * 16 for e in spills)  # 4 planes
+    doc = validate_metrics(res.metrics)
+    assert doc["counters"]["spill_rows"] == sum(
+        e["rows"] for e in spills)
+    assert doc["counters"]["spill_bytes"] > 0
+
+
+def test_stub_recover_continues_one_journal(tmp_path):
+    """ISSUE 2 acceptance: a checkpoint/recover pair pointed at the
+    same journal file yields ONE continuous journal with cumulative
+    elapsed preserved."""
+    ckpt = str(tmp_path / "stub.ckpt")
+    jp = str(tmp_path / "run.jsonl")
+    eng1 = _stub_device_engine()
+    res1 = eng1.run(max_depth=3, checkpoint_path=ckpt,
+                    obs=RunObserver(journal_path=jp))
+    assert res1.error                          # depth-limited
+    eng2 = _stub_device_engine()
+    res2 = eng2.run(resume_from=ckpt,
+                    obs=RunObserver(journal_path=jp))
+    assert res2.ok and res2.distinct_states == 16
+    events = read_journal(jp)
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert [s["resumed"] for s in starts] == [False, True]
+    ends = [e for e in events if e["event"] == "run_end"]
+    assert len(ends) == 2
+    assert any(e["event"] == "checkpoint" for e in events)
+    # cumulative elapsed across the recover seam
+    assert res2.elapsed >= res1.elapsed
+    assert ends[1]["elapsed_s"] >= ends[0]["elapsed_s"]
+    # level_done depths continue instead of restarting at 1
+    seg2 = events[events.index(starts[1]):]
+    seg2_levels = [e["depth"] for e in seg2
+                   if e["event"] == "level_done"]
+    assert seg2_levels and min(seg2_levels) == 4
+    # resumed exploration matches an uninterrupted oracle
+    res3 = _stub_device_engine().run()
+    assert res2.distinct_states == res3.distinct_states
+    assert res2.levels == res3.levels
+
+
+def test_stub_device_sim_metrics():
+    from tpuvsr.engine.device_sim import DeviceSimulator
+    sim = DeviceSimulator(counter_spec(), walkers=8, chunk_steps=4,
+                          model_factory=_stub_factory())
+    res = sim.run(num=8, depth=12, seed=1)
+    assert res.ok and res.walks == 8 and res.steps > 0
+    doc = validate_metrics(res.metrics)
+    assert doc["engine"] == "device-sim"
+    assert doc["counters"]["dispatches"] >= 3
+    assert doc["phases"].get("compile", 0) > 0
+    assert doc["gauges"]["steps_per_s"] > 0
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 2,
+                    reason="needs 2 virtual devices")
+def test_stub_sharded_journal_and_shard_metrics(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+    from tpuvsr.parallel.sharded_bfs import ShardedBFS
+    jp = str(tmp_path / "sharded.jsonl")
+    mp = str(tmp_path / "sharded.json")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+    eng = ShardedBFS(counter_spec(), mesh, tile=4, bucket_cap=64,
+                     next_capacity=1 << 6, fpset_capacity=1 << 8,
+                     model_factory=_stub_factory())
+    res = eng.run(obs=RunObserver(journal_path=jp, metrics_path=mp))
+    assert res.ok and res.distinct_states == 16
+    assert res.levels == [1, 2, 3, 4, 3, 2, 1]
+    events = read_journal(jp)
+    assert events[0]["engine"] == "sharded"
+    assert [e["event"] for e in events].count("level_done") == 7
+    doc = validate_metrics(json.load(open(mp)))
+    # per-shard distinct counts, reduced on host 0
+    shard = doc["gauges"]["shard_distinct"]
+    assert len(shard) == 2 and sum(shard) == 16
+    assert doc["gauges"]["exchange_useful_rows"] >= 15
+    assert doc["counters"]["dispatches"] >= 7
+    ph = doc["phases"]
+    core = sum(ph.get(k, 0.0) for k in ("compile", "dispatch",
+                                        "host_sync", "check"))
+    assert core >= 0.90 * res.elapsed, (ph, res.elapsed)
+
+
+# ---------------------------------------------------------------------
+# device engine (reference-gated, CPU backend)
+# ---------------------------------------------------------------------
+@requires_reference
+def test_device_and_interp_journals_share_key_sets(tmp_path):
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    spec = vsr_spec(values=("v1",), timer=0)
+    ji = str(tmp_path / "interp.jsonl")
+    jd = str(tmp_path / "device.jsonl")
+    mi = str(tmp_path / "interp.json")
+    md = str(tmp_path / "device.json")
+    ri = bfs_check(vsr_spec(values=("v1",), timer=0),
+                   obs=RunObserver(journal_path=ji, metrics_path=mi))
+    eng = DeviceBFS(spec, tile_size=8)
+    rd = eng.run(obs=RunObserver(journal_path=jd, metrics_path=md))
+    assert ri.ok and rd.ok
+    assert ri.distinct_states == rd.distinct_states
+    assert ri.levels == rd.levels == eng.level_sizes
+    ei, ed = read_journal(ji), read_journal(jd)
+
+    def keysets(events):
+        out = {}
+        for e in events:
+            out.setdefault(e["event"], set()).update(e.keys())
+        return out
+    ki, kd = keysets(ei), keysets(ed)
+    for ev in set(ki) & set(kd):
+        assert ki[ev] == kd[ev], f"{ev} keys drifted between engines"
+    # both journals cover the golden event vocabulary for a clean run
+    for ev in ("run_start", "level_done", "run_end"):
+        assert ev in ki and ev in kd
+    # metrics documents carry the same key sets too
+    di = validate_metrics(json.load(open(mi)))
+    dd = validate_metrics(json.load(open(md)))
+    assert set(di) == set(dd)
+
+
+@requires_reference
+def test_device_phase_timers_sum_to_elapsed(tmp_path):
+    """ISSUE 2 acceptance: compile + dispatch + host-sync + check sum
+    to within 10% of wall-clock elapsed on a device run with
+    -metrics."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    mp = str(tmp_path / "m.json")
+    eng = DeviceBFS(vsr_spec(values=("v1",), timer=0), tile_size=8)
+    res = eng.run(obs=RunObserver(metrics_path=mp))
+    assert res.ok
+    doc = validate_metrics(json.load(open(mp)))
+    ph = doc["phases"]
+    core = sum(ph.get(k, 0.0) for k in ("compile", "dispatch",
+                                        "host_sync", "check"))
+    assert core >= 0.90 * res.elapsed, (ph, res.elapsed)
+    assert sum(ph.values()) <= 1.05 * res.elapsed, (ph, res.elapsed)
+    assert doc["counters"]["dispatches"] >= 1
+    assert 0.0 < doc["gauges"]["fpset_occupancy"] <= 1.0
+    assert doc["gauges"]["distinct_per_s"] > 0
+
+
+@requires_reference
+def test_recover_continues_one_journal(tmp_path):
+    """ISSUE 2 acceptance: a -checkpoint/-recover pair pointed at the
+    same journal yields ONE continuous journal with cumulative elapsed
+    preserved."""
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    ckpt = str(tmp_path / "vsr.ckpt")
+    jp = str(tmp_path / "run.jsonl")
+    spec = vsr_spec(values=("v1",), timer=1)
+    eng1 = DeviceBFS(spec, tile_size=32)
+    res1 = eng1.run(max_depth=4, checkpoint_path=ckpt,
+                    obs=RunObserver(journal_path=jp))
+    assert res1.error                   # depth-limited
+    eng2 = DeviceBFS(vsr_spec(values=("v1",), timer=1), tile_size=32)
+    res2 = eng2.run(max_depth=7, resume_from=ckpt,
+                    obs=RunObserver(journal_path=jp))
+    events = read_journal(jp)
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert [s["resumed"] for s in starts] == [False, True]
+    # the resumed segment appended to the same file, after segment 1
+    ends = [e for e in events if e["event"] == "run_end"]
+    assert len(ends) == 2
+    # cumulative elapsed: segment 2 continues segment 1's clock
+    assert res2.elapsed >= res1.elapsed
+    assert ends[1]["elapsed_s"] >= ends[0]["elapsed_s"]
+    ckpts = [e for e in events if e["event"] == "checkpoint"]
+    assert ckpts, "checkpointed run must journal checkpoint events"
+    # level_done depths continue across the seam instead of restarting
+    seg2_levels = [e["depth"] for e in events[events.index(starts[1]):]
+                   if e["event"] == "level_done"]
+    assert seg2_levels and min(seg2_levels) == 5
+    assert ends[1]["distinct"] == res2.distinct_states
+    # the resumed run matches an uninterrupted oracle
+    eng3 = DeviceBFS(vsr_spec(values=("v1",), timer=1), tile_size=32)
+    res3 = eng3.run(max_depth=7)
+    assert res2.distinct_states == res3.distinct_states
+    assert res2.levels == res3.levels

@@ -17,9 +17,11 @@ Entry points:
 
 * ``run_lint(spec)`` — full report (CLI ``-lint``,
   scripts/lint_corpus.py);
-* ``preflight(spec)`` — the engine gate: spec-level passes only,
-  raises ``LintError`` on error-severity findings, caches per spec
-  object, honors ``TPUVSR_LINT=off`` (the CLI's ``-lint=off``).
+* ``preflight(spec)`` — the engine gate: all five passes (the drift
+  kernel cross-check became cheap once the key tables moved to class
+  attributes), raises ``LintError`` on error-severity findings, caches
+  per spec object, honors ``TPUVSR_LINT=off`` (the CLI's
+  ``-lint=off``).
 """
 
 from __future__ import annotations
@@ -53,9 +55,10 @@ def lint_enabled() -> bool:
 def preflight(spec, log=None):
     """Fail-fast gate the engines call before dispatch.
 
-    Runs the spec-level passes once per spec object; raises
-    ``LintError`` if any error-severity finding survives.  Returns the
-    report (or None when disabled via TPUVSR_LINT=off)."""
+    Runs all five passes (including the kernel drift cross-check) once
+    per spec object; raises ``LintError`` if any error-severity finding
+    survives.  Returns the report (or None when disabled via
+    TPUVSR_LINT=off)."""
     if not lint_enabled():
         return None
     cached = getattr(spec, "_speclint_report", None)

@@ -40,7 +40,21 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    1 errors.  With -json the report is one JSON object.
                    -lint=off disables the engines' fail-fast pre-flight
                    gate (equivalent to TPUVSR_LINT=off).
-  -json            emit a one-line JSON result summary
+  -json            emit a one-line JSON result summary (includes a
+                   "metrics" object: phase timers, counters, gauges
+                   from the obs collector)
+  -metrics FILE    dump the full tpuvsr-metrics/1 document (phase
+                   timers, counters, gauges, per-level trajectory) to
+                   FILE as JSON, and render a final stats table on
+                   stderr (schema: tpuvsr/obs/SCHEMA.md)
+  -journal FILE    append a JSONL run journal (run_start/level_done/
+                   checkpoint/spill/grow/violation/run_end events) to
+                   FILE; a -recover resume pointed at the same FILE
+                   continues the same journal with cumulative elapsed
+
+Environment: TPUVSR_PROFILE=DIR wraps the engine fixpoint loop in
+jax.profiler.trace(DIR) with per-level/per-phase TraceAnnotation
+spans (view with TensorBoard / Perfetto).
 
 Mutually exclusive flags (argparse errors, exit code 2, before any
 spec is loaded): -fused with -checkpoint/-recover; -fpset host with
@@ -94,6 +108,13 @@ def build_parser():
                    help="run the speclint static analyzer and exit "
                         "(plain -lint), or -lint=off to disable the "
                         "engine pre-flight gate")
+    p.add_argument("-metrics", default=None, metavar="FILE",
+                   help="dump the tpuvsr-metrics/1 JSON document "
+                        "(phase timers, counters, per-level rows) to "
+                        "FILE and print a stats table on stderr")
+    p.add_argument("-journal", default=None, metavar="FILE",
+                   help="append the JSONL run journal to FILE "
+                        "(continues across -recover)")
     return p
 
 
@@ -176,22 +197,39 @@ def main(argv=None):
         print(f"[tpuvsr] {e}", file=sys.stderr)
         return 1
 
+    # observability: one RunObserver rides the whole engine run —
+    # journal (JSONL event stream), metrics collector, profiler hooks
+    from ..obs import RunObserver
+    obs = RunObserver(journal_path=args.journal,
+                      metrics_path=args.metrics, log=log)
+
+    def summary_metrics(m):
+        """The -json merge: collector output minus the per-level rows
+        (those live in the -metrics file; the one-line summary stays
+        one line)."""
+        if not m:
+            return None
+        return {k: m[k] for k in ("run_id", "phases", "counters",
+                                  "gauges") if k in m}
+
     if args.simulate:
         if engine in ("device", "paged"):
             from ..engine.device_sim import device_simulate
             res = device_simulate(spec, num=args.num, depth=args.depth,
                                   seed=args.seed, log=log,
                                   check_deadlock=args.deadlock,
-                                  max_seconds=args.maxseconds)
+                                  max_seconds=args.maxseconds, obs=obs)
         else:
             from ..engine.simulate import simulate
             res = simulate(spec, num=args.num, depth=args.depth,
                            seed=args.seed, check_deadlock=args.deadlock,
-                           log=log, time_budget=args.maxseconds)
+                           log=log, time_budget=args.maxseconds,
+                           obs=obs)
         summary = {"mode": "simulate", "ok": res.ok,
                    "walks": res.walks, "steps": res.steps,
                    "violated": res.violated_invariant,
-                   "elapsed_s": round(res.elapsed, 3)}
+                   "elapsed_s": round(res.elapsed, 3),
+                   "metrics": summary_metrics(res.metrics)}
     else:
         if engine in ("device", "paged"):
             from ..engine.device_bfs import DeviceBFS
@@ -221,12 +259,12 @@ def main(argv=None):
                 res = eng.run_fused(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
-                    check_deadlock=args.deadlock, log=log)
+                    check_deadlock=args.deadlock, log=log, obs=obs)
             else:
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
-                    check_deadlock=args.deadlock, log=log,
+                    check_deadlock=args.deadlock, log=log, obs=obs,
                     checkpoint_path=(ckpt_dir if args.checkpoint or
                                      args.recover else None),
                     # checkpoint_every=None means "every level
@@ -244,14 +282,16 @@ def main(argv=None):
                     "ignored for the interpreter")
             from ..engine.bfs import bfs_check
             res = bfs_check(spec, check_deadlock=args.deadlock,
-                            max_states=args.maxstates, log=log)
+                            max_states=args.maxstates, log=log, obs=obs)
         summary = {"mode": "bfs", "ok": res.ok,
                    "distinct_states": res.distinct_states,
                    "states_generated": res.states_generated,
                    "diameter": res.diameter,
+                   "states_per_sec": round(res.states_per_sec, 1),
                    "violated": res.violated_invariant,
                    "error": res.error,
-                   "elapsed_s": round(res.elapsed, 3)}
+                   "elapsed_s": round(res.elapsed, 3),
+                   "metrics": summary_metrics(res.metrics)}
         if res.ok and not res.error and spec.temporal_props:
             from ..engine.liveness import liveness_check
             log(f"checking temporal properties: "
@@ -270,8 +310,13 @@ def main(argv=None):
                 else:
                     graph = DeviceGraph(spec, engine=eng, result=res,
                                         log=log)
+            # the liveness pass gets its own observer segment in the
+            # same journal (second run_start/run_end pair, engine
+            # "liveness"); the -metrics file stays the BFS engine's
+            lobs = RunObserver(journal_path=args.journal, log=log)
             lres = liveness_check(spec, max_states=args.maxstates,
-                                  log=log, graph=graph)
+                                  log=log, graph=graph, obs=lobs)
+            summary["liveness"] = summary_metrics(lres.metrics)
             summary["properties_ok"] = lres.ok
             if not lres.ok:
                 res.ok = False

@@ -28,30 +28,37 @@ class CheckResult:
     violated_invariant: str = None
     deadlock_state: dict = None
     trace: list = field(default_factory=list)
+    # timing/trajectory fields set uniformly by RunObserver.finish —
+    # engines never patch them post hoc (ISSUE 2 satellite)
     elapsed: float = 0.0
+    states_per_sec: float = 0.0
+    levels: list = None       # per-level frontier sizes, init included
+    metrics: dict = None      # tpuvsr-metrics/1 document for this run
     error: str = None
     exchange: dict = None     # sharded-engine ICI exchange metrics
-
-    @property
-    def states_per_sec(self):
-        return self.states_generated / self.elapsed if self.elapsed > 0 else 0.0
 
 
 def bfs_check(spec: SpecModel, check_deadlock: bool = False,
               max_states: int = None, progress_every: float = 10.0,
-              log=None) -> CheckResult:
+              log=None, obs=None) -> CheckResult:
     from ..analysis import preflight
+    from ..obs import RunObserver
     preflight(spec, log=log)      # speclint gate (TPUVSR_LINT=off skips)
+    obs = RunObserver.ensure(obs, "interp", spec, log=log,
+                             progress_every=progress_every)
     res = CheckResult()
     t0 = time.time()
+    obs.start(t0, backend="host")
     seen = {}           # canonical view value -> state id
     parents = {}        # state id -> (parent id, action name, action location)
     states = []         # state id -> state dict (kept for trace replay)
     frontier = []
+    level_sizes = []
 
-    def emit(msg):
-        if log:
-            log(msg)
+    def finish(depth):
+        res.distinct_states = len(states)
+        res.diameter = depth
+        return obs.finish(res, levels=level_sizes)
 
     def register(state, parent_id, action):
         key = spec.view_value(state)
@@ -65,6 +72,7 @@ def bfs_check(spec: SpecModel, check_deadlock: bool = False,
             return sid, True
         return sid, False
 
+    depth = 0
     try:
         for st in spec.init_states():
             res.states_generated += 1
@@ -75,60 +83,49 @@ def bfs_check(spec: SpecModel, check_deadlock: bool = False,
                     res.ok = False
                     res.violated_invariant = bad
                     res.trace = reconstruct_trace(sid, parents, states)
-                    res.distinct_states = len(states)
-                    res.elapsed = time.time() - t0
-                    return res
+                    return finish(depth)
                 frontier.append(sid)
+        level_sizes.append(len(frontier))
 
-        depth = 0
-        last_progress = t0
         while frontier:
             depth += 1
             next_frontier = []
-            for sid in frontier:
-                state = states[sid]
-                n_succ = 0
-                for action, succ in spec.successors(state):
-                    n_succ += 1
-                    res.states_generated += 1
-                    tid, fresh = register(succ, sid, action)
-                    if fresh:
-                        bad = spec.check_invariants(succ)
-                        if bad:
-                            res.ok = False
-                            res.violated_invariant = bad
-                            res.trace = reconstruct_trace(tid, parents, states)
-                            res.distinct_states = len(states)
-                            res.diameter = depth
-                            res.elapsed = time.time() - t0
-                            return res
-                        next_frontier.append(tid)
-                if n_succ == 0 and check_deadlock:
-                    res.ok = False
-                    res.error = "deadlock"
-                    res.deadlock_state = state
-                    res.trace = reconstruct_trace(sid, parents, states)
-                    res.distinct_states = len(states)
-                    res.diameter = depth
-                    res.elapsed = time.time() - t0
-                    return res
-                if max_states and len(states) >= max_states:
-                    res.error = f"state limit {max_states} reached"
-                    res.distinct_states = len(states)
-                    res.diameter = depth
-                    res.elapsed = time.time() - t0
-                    return res
-                now = time.time()
-                if now - last_progress >= progress_every:
-                    last_progress = now
-                    emit(f"depth {depth}: {len(states)} distinct, "
-                         f"{res.states_generated} generated, "
-                         f"{res.states_generated / (now - t0):.0f} states/s")
+            with obs.annotate(f"level {depth}"):
+                for sid in frontier:
+                    state = states[sid]
+                    n_succ = 0
+                    for action, succ in spec.successors(state):
+                        n_succ += 1
+                        res.states_generated += 1
+                        tid, fresh = register(succ, sid, action)
+                        if fresh:
+                            bad = spec.check_invariants(succ)
+                            if bad:
+                                res.ok = False
+                                res.violated_invariant = bad
+                                res.trace = reconstruct_trace(
+                                    tid, parents, states)
+                                return finish(depth)
+                            next_frontier.append(tid)
+                    if n_succ == 0 and check_deadlock:
+                        res.ok = False
+                        res.error = "deadlock"
+                        res.deadlock_state = state
+                        res.trace = reconstruct_trace(
+                            sid, parents, states)
+                        return finish(depth)
+                    if max_states and len(states) >= max_states:
+                        res.error = f"state limit {max_states} reached"
+                        return finish(depth)
+                    obs.progress(depth=depth, distinct=len(states),
+                                 generated=res.states_generated)
+            if next_frontier:
+                level_sizes.append(len(next_frontier))
+            obs.level_done(depth, frontier=len(frontier),
+                           distinct=len(states),
+                           generated=res.states_generated)
             frontier = next_frontier
-        res.diameter = depth
     except TLAError as e:
         res.ok = False
         res.error = str(e)
-    res.distinct_states = len(states)
-    res.elapsed = time.time() - t0
-    return res
+    return finish(depth)

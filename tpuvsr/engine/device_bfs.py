@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from ..core.values import TLAError
 from ..models import registry
 from ..models.vsr import ERR_BAG_OVERFLOW
+from ..obs import RunObserver, closes_observer
 from .bfs import CheckResult
 from .fpset import empty_table, grow, insert_batch, insert_core
 from .spec import SpecModel
@@ -141,6 +142,9 @@ class DeviceBFS:
         self._level = jax.jit(self._make_level(),
                               donate_argnums=(0, 4, 5, 6, 7))
         self._ml = None         # fused pass, built lazily (run_fused)
+        # obs accounting: the first dispatch after a (re)jit is charged
+        # to the "compile" phase (jit traces+compiles at first call)
+        self._fresh_jit = True
 
     def _tile_body_factory(self):
         """Build the one-tile expansion body shared by the chunked
@@ -546,19 +550,22 @@ class DeviceBFS:
         res.states_generated += len(init_dense)
         return table, init_batch, n0, None
 
+    @closes_observer
     def run(self, max_states=None, max_depth=None, max_seconds=None,
             check_deadlock=False, log=None, progress_every=10.0,
             checkpoint_path=None, checkpoint_every=None,
-            resume_from=None) -> CheckResult:
+            resume_from=None, obs=None) -> CheckResult:
         from ..analysis import preflight
         preflight(self.spec, log=log)   # fail fast, before any dispatch
+        obs = RunObserver.ensure(obs, "device", self.spec, log=log,
+                                 progress_every=progress_every)
+        self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         res = CheckResult()
         t0 = time.time()
-
-        def emit(msg):
-            if log:
-                log(msg)
+        obs.start(t0, backend=jax.default_backend(),
+                  resumed=resume_from is not None)
+        emit = obs.log
 
         if resume_from is not None:
             # --- resume from a level-boundary snapshot ----------------
@@ -586,6 +593,7 @@ class DeviceBFS:
             fp_count = ck["fp_count"]
             res.states_generated = ck["states_generated"]
             t0 -= ck["elapsed"]            # keep cumulative wall clock
+            obs.set_epoch(t0)
             n_front = ck["n_front"]
             f_cap = max(self.next_cap, n_front)
             front, fpar, fact, fprm = self._alloc_bufs(f_cap)
@@ -593,15 +601,19 @@ class DeviceBFS:
                 jnp.asarray(ck["frontier"][k])) for k in front}
             bufs = self._alloc_bufs(self.next_cap)
             level_base = sum(self.level_sizes[:-1])
-            last_progress = time.time()
             emit(f"resumed from {resume_from}: depth {depth}, "
                  f"{fp_count} distinct, frontier {n_front}")
         else:
             fp_cap = self.fpset_capacity
+            # reset BEFORE registration: a reused engine instance must
+            # not leak the previous run's trajectory into an
+            # init-violation result
+            self.level_sizes = []
             table, init_batch, n0, viol = self._register_init(res)
             fp_count = n0
             if viol is not None:
-                return self._finish(res, t0, 0, fp_count)
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
 
             # --- device frontier + next buffers -----------------------
             f_cap = max(self.next_cap, n0)
@@ -612,10 +624,29 @@ class DeviceBFS:
             n_front = n0
             level_base = 0          # gid of frontier[0]
             depth = 0
-            last_progress = t0
             self.level_sizes = [n0]
         last_checkpoint = time.time()
+        return self._run_loop(
+            res, obs, table=table, front=front, bufs=bufs, fpar=fpar,
+            fact=fact, fprm=fprm, n_front=n_front,
+            level_base=level_base, depth=depth, fp_count=fp_count,
+            fp_cap=fp_cap, t0=t0, max_states=max_states,
+            max_depth=max_depth, max_seconds=max_seconds,
+            check_deadlock=check_deadlock,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            last_checkpoint=last_checkpoint)
 
+    def _run_loop(self, res, obs, *, table, front, bufs, fpar, fact,
+                  fprm, n_front, level_base, depth, fp_count, fp_cap,
+                  t0, max_states, max_depth, max_seconds,
+                  check_deadlock, checkpoint_path, checkpoint_every,
+                  last_checkpoint):
+        # keyword-only: the loop state is a pile of same-typed ints and
+        # identically shaped buffers — a transposed positional arg
+        # would type-check and silently corrupt traces/metrics
+        spec = self.spec
+        emit = obs.log
         while n_front > 0:
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
@@ -627,17 +658,26 @@ class DeviceBFS:
             stop = None
             while start_t < n_tiles:
                 nb, nbp, nba, nbprm = bufs
-                out = self._level(
-                    table["slots"], front,
-                    jnp.asarray(n_front, I32), jnp.asarray(start_t, I32),
-                    nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
-                    jnp.asarray(bool(check_deadlock)))
+                phase = "compile" if self._fresh_jit else "dispatch"
+                with obs.timer(phase), obs.annotate(
+                        f"level {depth} {phase}"):
+                    out = self._level(
+                        table["slots"], front,
+                        jnp.asarray(n_front, I32),
+                        jnp.asarray(start_t, I32),
+                        nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
+                        jnp.asarray(bool(check_deadlock)))
+                    out["reason"].block_until_ready()
+                self._fresh_jit = False
+                obs.count("dispatches")
                 table = {"slots": out["slots"]}
                 bufs = (out["nb"], out["nbp"], out["nba"], out["nbprm"])
                 # ONE host round-trip for all control scalars — separate
                 # int() pulls cost one tunnel RTT each on a remote TPU
-                sc = jax.device_get([out["reason"], out["t"], out["nn"],
-                                     out["gen"], out["dist"]])
+                with obs.timer("host_sync"):
+                    sc = jax.device_get([out["reason"], out["t"],
+                                         out["nn"], out["gen"],
+                                         out["dist"]])
                 reason, start_t, n_next, gen_add, dist_add = (
                     int(x) for x in sc)
                 res.states_generated += gen_add
@@ -665,18 +705,27 @@ class DeviceBFS:
                     res.violated_invariant = bad
                     res.trace = self._trace(gid, extra=(va, vprm))
                     res.diameter = depth
-                    return self._finish(res, t0, depth, fp_count)
+                    return self._finish(res, obs, fp_count,
+                                        table=table, fp_cap=fp_cap)
                 elif reason == R_BAG_GROW:
                     front, nb = self._grow_msgs([front, bufs[0]])
                     bufs = (nb,) + bufs[1:]
+                    obs.grow("message_table", self.codec.shape.MAX_MSGS)
                     emit(f"message table grown to "
                          f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
                 elif reason == R_FPSET_GROW:
                     table = grow(table)
                     fp_cap *= 4
+                    # shape change -> the next dispatch retraces and
+                    # recompiles; charge it to "compile", not
+                    # "dispatch" (same for every growth below)
+                    self._fresh_jit = True
+                    obs.grow("fpset", fp_cap)
                     emit(f"FPSet grown to {fp_cap} slots")
                 elif reason == R_NEXT_GROW:
                     bufs = self._grow_next(bufs)
+                    self._fresh_jit = True
+                    obs.grow("next_buffer", bufs[1].shape[0])
                     emit(f"next-frontier buffer grown to "
                          f"{bufs[1].shape[0]}")
                 elif reason == R_EXPAND_GROW:
@@ -684,6 +733,8 @@ class DeviceBFS:
                     self.expand_mults[aid] *= 2
                     self._level = jax.jit(self._make_level(),
                                           donate_argnums=(0, 4, 5, 6, 7))
+                    self._fresh_jit = True
+                    obs.grow("expand_buffer", self.expand_mults[aid])
                     emit(f"expand buffer for {self.kern.action_names[aid]} grown "
                          f"to tile x {self.expand_mults[aid]} "
                          f"(recompiling)")
@@ -702,20 +753,18 @@ class DeviceBFS:
                         self._fetch_row(front, di))
                     res.trace = self._trace(gid)
                     res.diameter = depth
-                    return self._finish(res, t0, depth, fp_count)
+                    return self._finish(res, obs, fp_count,
+                                        table=table, fp_cap=fp_cap)
 
-                now = time.time()
-                if now - last_progress >= progress_every:
-                    last_progress = now
-                    emit(f"depth {depth}: {fp_count} distinct, "
-                         f"{res.states_generated} generated, "
-                         f"{res.states_generated / (now - t0):.0f} gen/s, "
-                         f"{fp_count / (now - t0):.0f} distinct/s")
-                if max_seconds and now - t0 > max_seconds:
+                obs.progress(depth=depth, distinct=fp_count,
+                             generated=res.states_generated)
+                if max_seconds and time.time() - t0 > max_seconds:
                     stop = f"time budget {max_seconds}s reached"
                     break
 
             # ---- level complete: pull trace pointers, swap buffers ---
+            obs.level_done(depth, frontier=n_front, distinct=fp_count,
+                           generated=res.states_generated)
             nb, nbp, nba, nbprm = bufs
             if n_next:
                 # async pointer fetch: the copies overlap the next
@@ -740,22 +789,25 @@ class DeviceBFS:
                     checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
-                self._flush_pointers()
-                save_checkpoint(
-                    checkpoint_path,
-                    slots=table["slots"], frontier=front, n_front=n_next,
-                    h_parent=np.concatenate(self._h_parent),
-                    h_action=np.concatenate(self._h_action),
-                    h_param=np.concatenate(self._h_param),
-                    init_dense=self._init_dense,
-                    level_sizes=self.level_sizes, depth=depth,
-                    fp_count=fp_count,
-                    states_generated=res.states_generated,
-                    max_msgs=self.codec.shape.MAX_MSGS,
-                    expand_mults=self.expand_mults,
-                    elapsed=time.time() - t0,
-                    digest=spec_digest(spec))
+                with obs.timer("checkpoint"):
+                    self._flush_pointers()
+                    save_checkpoint(
+                        checkpoint_path,
+                        slots=table["slots"], frontier=front,
+                        n_front=n_next,
+                        h_parent=np.concatenate(self._h_parent),
+                        h_action=np.concatenate(self._h_action),
+                        h_param=np.concatenate(self._h_param),
+                        init_dense=self._init_dense,
+                        level_sizes=self.level_sizes, depth=depth,
+                        fp_count=fp_count,
+                        states_generated=res.states_generated,
+                        max_msgs=self.codec.shape.MAX_MSGS,
+                        expand_mults=self.expand_mults,
+                        elapsed=time.time() - t0,
+                        digest=spec_digest(spec))
                 last_checkpoint = time.time()
+                obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
             if stop:
@@ -771,10 +823,13 @@ class DeviceBFS:
             if fp_count > 0.5 * fp_cap:
                 table = grow(table)
                 fp_cap *= 4
+                self._fresh_jit = True
+                obs.grow("fpset", fp_cap)
                 emit(f"FPSet grown to {fp_cap} slots")
 
         res.diameter = depth
-        return self._finish(res, t0, depth, fp_count)
+        return self._finish(res, obs, fp_count,
+                            table=table, fp_cap=fp_cap)
 
     def _debug_assert_widths(self, front, n_front, depth):
         """TPUVSR_DEBUG_NANS=1 overflow guard: after each level, pull
@@ -801,9 +856,10 @@ class DeviceBFS:
     # ------------------------------------------------------------------
     # fused run: whole fixpoint in O(1) dispatches
     # ------------------------------------------------------------------
+    @closes_observer
     def run_fused(self, max_states=None, max_depth=None,
                   max_seconds=None, check_deadlock=False, log=None,
-                  levels_per_dispatch=256) -> CheckResult:
+                  levels_per_dispatch=256, obs=None) -> CheckResult:
         """Like run(), but through the fused multi-level pass
         (_make_multilevel): the whole reachable space is explored in a
         handful of dispatches (one, absent growth pauses), eliminating
@@ -813,18 +869,19 @@ class DeviceBFS:
         long preemptible jobs)."""
         from ..analysis import preflight
         preflight(self.spec, log=log)   # fail fast, before any dispatch
+        obs = RunObserver.ensure(obs, "device-fused", self.spec, log=log)
+        self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
         res = CheckResult()
         t0 = time.time()
-
-        def emit(msg):
-            if log:
-                log(msg)
+        obs.start(t0, backend=jax.default_backend())
+        emit = obs.log
 
         fp_cap = self.fpset_capacity
+        self.level_sizes = []      # no stale trajectory on init-viol
         table, init_batch, n0, viol = self._register_init(res)
         if viol is not None:
-            return self._finish(res, t0, 0, n0)
+            return self._finish(res, obs, n0, table=table, fp_cap=fp_cap)
 
         # ping-pong buffers share one capacity in fused mode
         f_cap = max(self.next_cap, n0)
@@ -860,38 +917,54 @@ class DeviceBFS:
             self._h_param = [np.asarray(tpm[:n])]
 
         while True:
+            fresh = self._fresh_jit or self._ml is None
             if self._ml is None:
                 self._ml = jax.jit(self._make_multilevel(),
                                    donate_argnums=tuple(range(10)))
-            out = self._ml(
-                table["slots"], front, nb, nbp, nba, nbprm,
-                tpp, tpa, tpm, lvl_buf,
-                jnp.asarray(n_front, I32), jnp.asarray(start_t, I32),
-                jnp.asarray(nn, I32), jnp.asarray(gen_level, I32),
-                jnp.asarray(depth, I32), jnp.asarray(level_base, I32),
-                jnp.asarray(fp_count, I32),
-                jnp.asarray(bool(check_deadlock)),
-                jnp.asarray(md, I32), jnp.asarray(ms, I32),
-                jnp.asarray(min(quantum, levels_per_dispatch), I32))
+            with obs.timer("compile" if fresh else "dispatch"), \
+                    obs.annotate(f"fused dispatch (depth {depth}+)"):
+                out = self._ml(
+                    table["slots"], front, nb, nbp, nba, nbprm,
+                    tpp, tpa, tpm, lvl_buf,
+                    jnp.asarray(n_front, I32), jnp.asarray(start_t, I32),
+                    jnp.asarray(nn, I32), jnp.asarray(gen_level, I32),
+                    jnp.asarray(depth, I32), jnp.asarray(level_base, I32),
+                    jnp.asarray(fp_count, I32),
+                    jnp.asarray(bool(check_deadlock)),
+                    jnp.asarray(md, I32), jnp.asarray(ms, I32),
+                    jnp.asarray(min(quantum, levels_per_dispatch), I32))
+                out["reason"].block_until_ready()
+            self._fresh_jit = False
+            obs.count("dispatches")
             quantum = min(quantum * 4, levels_per_dispatch)
             table = {"slots": out["slots"]}
             front, nb = out["front"], out["nb"]
             nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
             tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
             lvl_buf = out["lvl_buf"]
-            sc = jax.device_get(
-                [out[k] for k in ("reason", "n_front", "start_t", "nn",
-                                  "gen_level", "gen", "depth",
-                                  "level_base", "fp_count", "lvl_cur")])
+            with obs.timer("host_sync"):
+                sc = jax.device_get(
+                    [out[k] for k in ("reason", "n_front", "start_t",
+                                      "nn", "gen_level", "gen", "depth",
+                                      "level_base", "fp_count",
+                                      "lvl_cur")])
             (reason, n_front, start_t, nn, gen_level, gen_add, depth,
              level_base, fp_count, lvl_cur) = (int(x) for x in sc)
             res.states_generated += gen_add
             if lvl_cur:
-                self.level_sizes.extend(
-                    int(x) for x in np.asarray(lvl_buf[:lvl_cur]))
-            emit(f"depth {depth}: {fp_count} distinct, "
-                 f"{res.states_generated} generated "
-                 f"({fp_count / (time.time() - t0):.0f} distinct/s)")
+                # level boundaries inside one dispatch share its
+                # host-side timestamp and generated count (the device
+                # never synced mid-dispatch) — documented in SCHEMA.md
+                cum = sum(self.level_sizes)
+                for x in np.asarray(lvl_buf[:lvl_cur]):
+                    prev = self.level_sizes[-1]
+                    self.level_sizes.append(int(x))
+                    cum += int(x)
+                    obs.level_done(len(self.level_sizes) - 1,
+                                   frontier=prev, distinct=cum,
+                                   generated=res.states_generated)
+            obs.progress(depth=depth, distinct=fp_count,
+                         generated=res.states_generated, force=True)
 
             if reason == RUNNING:
                 if n_front == 0:
@@ -914,6 +987,8 @@ class DeviceBFS:
                     tpm = jnp.concatenate(
                         [tpm, jnp.zeros((add,), I32)])
                     tp_cap += add
+                    self._fresh_jit = True   # shape change: retrace
+                    obs.grow("trace_pointer_store", tp_cap)
                     emit(f"trace-pointer store grown to {tp_cap}")
                 # else: level counter full — drained above, re-enter
                 continue
@@ -939,7 +1014,8 @@ class DeviceBFS:
                 # depth counts committed levels; the violation is in
                 # the in-progress one (chunked run() parity)
                 res.diameter = depth + 1
-                return self._finish(res, t0, depth + 1, fp_count)
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
             if reason == R_DEADLOCK:
                 res.states_generated += gen_level
                 di = int(out["dead"])
@@ -950,14 +1026,18 @@ class DeviceBFS:
                     self._fetch_row(front, di))
                 res.trace = self._trace(level_base + di)
                 res.diameter = depth + 1
-                return self._finish(res, t0, depth + 1, fp_count)
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
             if reason == R_BAG_GROW:
                 front, nb = self._grow_msgs([front, nb])
+                obs.grow("message_table", self.codec.shape.MAX_MSGS)
                 emit(f"message table grown to "
                      f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
             elif reason == R_FPSET_GROW:
                 table = grow(table)
                 fp_cap *= 4
+                self._fresh_jit = True       # shape change: retrace
+                obs.grow("fpset", fp_cap)
                 emit(f"FPSet grown to {fp_cap} slots")
             elif reason == R_NEXT_GROW:
                 old_cap = nbp.shape[0]
@@ -967,13 +1047,17 @@ class DeviceBFS:
                 nb = {k: jnp.concatenate(
                     [v, jnp.zeros((f_cap - old_cap,) + v.shape[1:],
                                   v.dtype)]) for k, v in nb.items()}
+                self._fresh_jit = True       # shape change: retrace
+                obs.grow("next_buffer", f_cap)
                 emit(f"frontier buffers grown to {f_cap}")
             elif reason == R_EXPAND_GROW:
                 aid = int(out["grow_aid"])
                 self.expand_mults[aid] *= 2
                 self._level = jax.jit(self._make_level(),
                                       donate_argnums=(0, 4, 5, 6, 7))
+                self._fresh_jit = True
                 self._ml = None
+                obs.grow("expand_buffer", self.expand_mults[aid])
                 emit(f"expand buffer for "
                      f"{self.kern.action_names[aid]} grown to tile x "
                      f"{self.expand_mults[aid]} (recompiling)")
@@ -990,7 +1074,8 @@ class DeviceBFS:
         set_pointers(fp_count if reason == RUNNING and n_front == 0
                      else level_base + n_front)
         res.diameter = depth
-        return self._finish(res, t0, depth, fp_count)
+        return self._finish(res, obs, fp_count,
+                            table=table, fp_cap=fp_cap)
 
     # ------------------------------------------------------------------
     def _flush_pointers(self):
@@ -1022,10 +1107,20 @@ class DeviceBFS:
         return {k: np.asarray(v)[0] for k, v in succ.items()
                 if not k.startswith("_")}
 
-    def _finish(self, res, t0, depth, fp_count):
+    def _finish(self, res, obs, fp_count, table=None, fp_cap=None):
+        """Uniform result finalization: the collector (not the engine)
+        stamps elapsed/states_per_sec/levels/metrics (ISSUE 2
+        satellite — no more post-hoc res.elapsed patching)."""
         res.distinct_states = fp_count
-        res.elapsed = time.time() - t0
-        return res
+        if fp_cap:
+            obs.gauge("fpset_capacity", int(fp_cap))
+            obs.gauge("fpset_occupancy", fp_count / fp_cap)
+        if table is not None and obs.detailed:
+            from .fpset import table_stats
+            st = table_stats(table["slots"])
+            obs.gauge("fpset_occupancy", st["occupancy"])
+            obs.gauge("fpset_collision_rate", st["collision_rate"])
+        return obs.finish(res, levels=getattr(self, "level_sizes", None))
 
     def _trace(self, gid, extra=None):
         """Walk the host pointer table back to an init state, then
@@ -1058,8 +1153,8 @@ class DeviceBFS:
 
 def device_bfs_check(spec: SpecModel, max_states=None, max_depth=None,
                      check_deadlock=False, tile_size=128, max_msgs=None,
-                     log=None) -> CheckResult:
+                     log=None, obs=None) -> CheckResult:
     """Run the device BFS (message-table growth happens in place)."""
     eng = DeviceBFS(spec, max_msgs=max_msgs, tile_size=tile_size)
     return eng.run(max_states=max_states, max_depth=max_depth,
-                   check_deadlock=check_deadlock, log=log)
+                   check_deadlock=check_deadlock, log=log, obs=obs)

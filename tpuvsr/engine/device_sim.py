@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import registry
+from ..obs import RunObserver, closes_observer
 from .simulate import SimResult
 from .spec import SpecModel
 from .trace import TraceEntry
@@ -77,7 +78,10 @@ class DeviceSimulator:
     def __init__(self, spec: SpecModel, max_msgs=None, walkers=256,
                  chunk_steps=32, action_weights=None, swarm_sigma=0.0,
                  guided=False, split_beta=1.5, dispatch="grouped",
-                 group_caps=None):
+                 group_caps=None, model_factory=None):
+        # model_factory(spec, max_msgs=..) -> (codec, kernel); default
+        # is the hand-kernel registry (DeviceBFS parity)
+        self._model_factory = model_factory or registry.make_model
         self.spec = spec
         self.W = walkers
         self.chunk = chunk_steps
@@ -99,7 +103,8 @@ class DeviceSimulator:
 
     def _build(self, max_msgs):
         spec = self.spec
-        self.codec, self.kern = registry.make_model(spec, max_msgs=max_msgs)
+        self.codec, self.kern = self._model_factory(spec,
+                                                    max_msgs=max_msgs)
         kern = self.kern
         names = kern.action_names
         aw = self._action_weights
@@ -244,6 +249,8 @@ class DeviceSimulator:
             return states, alive, bad, dead, err_any, ovf, steps, hist
 
         self._chunk = jax.jit(chunk_fn)
+        self._fresh_jit = True   # first dispatch after a (re)build is
+        #                          charged to the "compile" phase
         if self.guided:
             if not hasattr(kern, "hunt_score"):
                 raise ValueError(
@@ -299,13 +306,17 @@ class DeviceSimulator:
         return {k: np.asarray(v)[0] for k, v in succ.items()
                 if not k.startswith("_")}
 
+    @closes_observer
     def run(self, num=1000, depth=100, seed=0, check_deadlock=False,
-            log=None, max_seconds=None) -> SimResult:
+            log=None, max_seconds=None, obs=None) -> SimResult:
         """Run `num` walks of `depth` steps (W at a time, `chunk` steps
         per device sync)."""
+        obs = RunObserver.ensure(obs, "device-sim", self.spec, log=log)
+        self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
         res = SimResult()
         t0 = time.time()
+        obs.start(t0, backend=jax.default_backend())
         init_dense = [codec.encode(st) for st in spec.init_states()]
         init = {k: np.repeat(np.stack([d[k] for d in init_dense])[:1],
                              self.W, axis=0) for k in init_dense[0]}
@@ -314,8 +325,7 @@ class DeviceSimulator:
         if bad0:
             res.ok = False
             res.violated_invariant = bad0
-            res.elapsed = time.time() - t0
-            return res
+            return obs.finish(res)
         key = jax.random.PRNGKey(seed)
         rng = np.random.default_rng(seed ^ 0x5EED)
         init = {k: jnp.asarray(v) for k, v in init.items()}
@@ -333,17 +343,28 @@ class DeviceSimulator:
                 key, sub = jax.random.split(key)
                 keys = jax.random.split(sub, k)
                 while True:
-                    (nstates, alive, bad, dead, err_any, ovf, steps,
-                     hist) = self._chunk(states, was_alive, keys, logw)
-                    if bool(err_any):
+                    phase = "compile" if self._fresh_jit else "dispatch"
+                    with obs.timer(phase), obs.annotate(
+                            f"sim chunk (depth {d}) {phase}"):
+                        (nstates, alive, bad, dead, err_any, ovf, steps,
+                         hist) = self._chunk(states, was_alive, keys,
+                                             logw)
+                        err_any.block_until_ready()
+                    self._fresh_jit = False
+                    obs.count("dispatches")
+                    with obs.timer("host_sync"):
+                        err_any_h = bool(err_any)
+                        ovf = np.asarray(ovf)
+                    if err_any_h:
                         # bag overflow inside the chunk: grow the table,
                         # pad saved entry states, redraw the chunk
                         init, states = self._grow_msgs([init, states])
+                        obs.grow("message_table",
+                                 self.codec.shape.MAX_MSGS)
                         if log:
                             log(f"message table grown to "
                                 f"{self.codec.shape.MAX_MSGS} slots")
                         continue
-                    ovf = np.asarray(ovf)
                     if ovf.any():
                         # a dispatch group overflowed its gather cap:
                         # double the caps of the flagged actions and
@@ -352,6 +373,8 @@ class DeviceSimulator:
                         for a in np.nonzero(ovf)[0]:
                             self.group_caps[a] = min(
                                 self.W, self.group_caps[a] * 2)
+                            obs.grow("dispatch_group",
+                                     self.group_caps[a])
                             if log:
                                 log(f"dispatch group for "
                                     f"{self.kern.action_names[a]} grown "
@@ -361,9 +384,10 @@ class DeviceSimulator:
                         continue
                     break
                 hists.append(hist)
-                res.steps += int(steps)
-                bad = np.asarray(bad)
-                dead = np.asarray(dead)
+                with obs.timer("host_sync"):
+                    res.steps += int(steps)
+                    bad = np.asarray(bad)
+                    dead = np.asarray(dead)
                 # report whichever event happened at the earlier step of
                 # the chunk; within one step deadlocks are checked first
                 # (matching the per-step engine semantics)
@@ -375,8 +399,7 @@ class DeviceSimulator:
                     res.deadlocks += 1
                     res.trace = self._replay(init, hists, w, d + ds)
                     res.violated_invariant = None
-                    res.elapsed = time.time() - t0
-                    return res
+                    return obs.finish(res)
                 if bad[0] >= 0:
                     w, ds = int(bad[0]), int(bad[1])
                     res.ok = False
@@ -396,8 +419,7 @@ class DeviceSimulator:
                         err.trace = res.trace
                         raise err
                     res.violated_invariant = confirmed
-                    res.elapsed = time.time() - t0
-                    return res
+                    return obs.finish(res)
                 states, was_alive = nstates, alive
                 d += k
                 if self.guided and d < depth:
@@ -408,14 +430,10 @@ class DeviceSimulator:
                     stop = True
                     break
             res.walks += self.W
-            if log:
-                el = time.time() - t0
-                extra = (f", best score {best_score}"
-                         if self.guided else "")
-                log(f"{res.walks} walks, {res.steps / el:.0f} steps/s"
-                    f"{extra}")
-        res.elapsed = time.time() - t0
-        return res
+            obs.progress(walks=res.walks, steps=res.steps,
+                         extra=(f"best score {best_score}"
+                                if self.guided else None))
+        return obs.finish(res)
 
     def _replay(self, init, hists, w, n_steps):
         """Re-execute walker `w`'s first `n_steps` recorded choices into
@@ -441,7 +459,7 @@ def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
                     walkers=256, max_msgs=None, check_deadlock=False,
                     log=None, max_seconds=None, chunk_steps=32,
                     action_weights=None, swarm_sigma=0.0,
-                    guided=False, split_beta=1.5) -> SimResult:
+                    guided=False, split_beta=1.5, obs=None) -> SimResult:
     sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers,
                           chunk_steps=chunk_steps,
                           action_weights=action_weights,
@@ -449,4 +467,4 @@ def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
                           split_beta=split_beta)
     return sim.run(num=num, depth=depth, seed=seed,
                    check_deadlock=check_deadlock, log=log,
-                   max_seconds=max_seconds)
+                   max_seconds=max_seconds, obs=obs)

@@ -148,6 +148,35 @@ def insert_core(table, fps, mask):
 insert_batch = partial(jax.jit, donate_argnums=(0,))(insert_core)
 
 
+def table_stats(slots):
+    """Host-side occupancy/collision stats of a table's ``slots``
+    array (device or numpy).  "Displaced" slots are occupied slots not
+    sitting at their probe-chain start — the linear-probing collision
+    measure the obs layer reports as ``fpset_collision_rate``.  Costs
+    one table pull; callers gate it on metrics being requested."""
+    s = np.asarray(slots)
+    cap = int(s.shape[0])
+    occ = s[:, 0] != 0
+    n = int(occ.sum())
+    out = {"capacity": cap, "occupied": n,
+           "occupancy": n / cap if cap else 0.0,
+           "displaced": 0, "collision_rate": 0.0}
+    if n == 0:
+        return out
+    keyed = s[occ, :4].astype(np.uint32)
+    with np.errstate(over="ignore"):
+        # numpy replica of _slot_hash (stored words are already keyed)
+        h = keyed[:, 0] ^ (keyed[:, 1] * np.uint32(0x9E3779B1))
+        h = h ^ (keyed[:, 2] * np.uint32(0x85EBCA6B)) ^ (keyed[:, 3] >> 5)
+        h = h ^ (h >> 15)
+        home = (h * np.uint32(0x27D4EB2F)) & np.uint32(cap - 1)
+    idx = np.nonzero(occ)[0].astype(np.uint32)
+    displaced = int((home != idx).sum())
+    out["displaced"] = displaced
+    out["collision_rate"] = displaced / n
+    return out
+
+
 def query_core(table, fps, mask):
     """Read-only membership probe: returns (fresh, overflow).  `fresh`
     marks masked lanes whose fingerprint is NOT in the table (duplicate

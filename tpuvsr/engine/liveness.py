@@ -43,6 +43,7 @@ class LivenessResult:
     trace: list = field(default_factory=list)   # prefix + cycle
     cycle_start: int = 0                        # index into trace
     error: str = None
+    metrics: dict = None      # tpuvsr-metrics/1 document for this run
 
 
 def _build_graph(spec: SpecModel, max_states=None):
@@ -221,30 +222,34 @@ def build_graph(spec: SpecModel, max_states=None):
 
 
 def liveness_check(spec: SpecModel, max_states=None,
-                   log=None, graph=None) -> LivenessResult:
+                   log=None, graph=None, obs=None) -> LivenessResult:
     """`graph` may be the interpreter-built (states, edges, inits)
     triple from build_graph, or a device-built
     engine.device_liveness.DeviceGraph (same attributes, lazy state
     decode, batched predicate evaluation)."""
+    from ..obs import RunObserver
+    obs = RunObserver.ensure(obs, "liveness", spec, log=log)
     res = LivenessResult()
     t0 = time.time()
+    obs.start(t0, backend="host")
     dev_graph = None
     try:
-        if graph is None:
-            states, edges, inits = _build_graph(spec, max_states)
-        elif hasattr(graph, "batch_predicate"):
-            dev_graph = graph
-            states, inits = graph.states, graph.inits
-            # don't touch .edges when CSR arrays exist — materializing
-            # the list-of-lists view defeats the array representation
-            edges = None if hasattr(graph, "csr") else graph.edges
-        else:
-            states, edges, inits = graph
+        with obs.timer("graph_build"):
+            if graph is None:
+                states, edges, inits = _build_graph(spec, max_states)
+            elif hasattr(graph, "batch_predicate"):
+                dev_graph = graph
+                states, inits = graph.states, graph.inits
+                # don't touch .edges when CSR arrays exist —
+                # materializing the list-of-lists view defeats the
+                # array representation
+                edges = None if hasattr(graph, "csr") else graph.edges
+            else:
+                states, edges, inits = graph
     except TLAError as e:
         res.ok = False
         res.error = str(e)
-        res.elapsed = time.time() - t0
-        return res
+        return obs.finish(res)
     import numpy as np
 
     res.distinct_states = len(states)
@@ -327,6 +332,8 @@ def liveness_check(spec: SpecModel, max_states=None,
             (_eval_pred(spec, expr, env, states[sid])
              for sid in range(n)), bool, n)
 
+    obs.gauge("graph_states", n)
+    obs.gauge("graph_edges", int(n_edges))
     for prop_name in spec.temporal_props:
         for kind, p_expr, q_expr, env in _collect_props(spec, prop_name):
             if kind == "gf":
@@ -389,10 +396,8 @@ def liveness_check(spec: SpecModel, max_states=None,
                     res.ok = False
                     res.property_name = prop_name
                     res.trace, res.cycle_start = path
-                    res.elapsed = time.time() - t0
-                    return res
-    res.elapsed = time.time() - t0
-    return res
+                    return obs.finish(res)
+    return obs.finish(res)
 
 
 def _flatten_env(env):

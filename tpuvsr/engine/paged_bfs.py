@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.values import TLAError
+from ..obs import RunObserver, closes_observer
 from .bfs import CheckResult
 from .device_bfs import (DeviceBFS, I32, R_BAG_GROW, R_DEADLOCK,
                          R_EXPAND_GROW, R_FPSET_GROW, R_NEXT_GROW,
@@ -86,19 +87,28 @@ class PagedBFS(DeviceBFS):
                 {k: np.asarray(v)[None] for k, v in d.items()}, old)
             self._init_dense[i] = {k: v[0] for k, v in padded.items()}
 
+    def _state_row_bytes(self):
+        """Dense bytes of one frontier row (the paged-spill unit)."""
+        zero = self.codec.zero_state()
+        return sum(int(np.prod(np.shape(v)) or 1) * 4
+                   for v in zero.values())
+
+    @closes_observer
     def run(self, max_states=None, max_depth=None, max_seconds=None,
             check_deadlock=False, log=None, progress_every=10.0,
             checkpoint_path=None, checkpoint_every=None,
-            resume_from=None) -> CheckResult:
+            resume_from=None, obs=None) -> CheckResult:
         from ..analysis import preflight
         preflight(self.spec, log=log)   # fail fast, before any dispatch
+        obs = RunObserver.ensure(obs, "paged", self.spec, log=log,
+                                 progress_every=progress_every)
+        self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         res = CheckResult()
         t0 = time.time()
-
-        def emit(msg):
-            if log:
-                log(msg)
+        obs.start(t0, backend=jax.default_backend(),
+                  resumed=resume_from is not None)
+        emit = obs.log
 
         self.spill_count = 0     # drains triggered by a full buffer
         self.spill_rows = 0      # total rows paged out to host
@@ -128,6 +138,7 @@ class PagedBFS(DeviceBFS):
             fp_count = ck["fp_count"]
             res.states_generated = ck["states_generated"]
             t0 -= ck["elapsed"]
+            obs.set_epoch(t0)
             n_front = ck["n_front"]
             host_front = {k: np.asarray(v)
                           for k, v in ck["frontier"].items()}
@@ -136,10 +147,12 @@ class PagedBFS(DeviceBFS):
                  f"{fp_count} distinct, frontier {n_front}")
         else:
             fp_cap = self.fpset_capacity
+            self.level_sizes = []  # no stale trajectory on init-viol
             table, init_batch, n0, viol = self._register_init(res)
             fp_count = n0
             if viol is not None:
-                return self._finish(res, t0, 0, fp_count)
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
             host_front = {k: init_batch[k][:n0].astype(np.int32)
                           for k in init_batch}
             n_front = n0
@@ -147,7 +160,6 @@ class PagedBFS(DeviceBFS):
             depth = 0
             self.level_sizes = [n0]
 
-        last_progress = time.time()
         last_checkpoint = time.time()
         dev_chunk = None        # allocated lazily; realloc on bag growth
         # the level kernel refuses to commit a tile unless the next
@@ -185,9 +197,10 @@ class PagedBFS(DeviceBFS):
                 if n_next == 0:
                     return
                 nb, nbp, nba, nbprm = bufs
-                rows, par, act, prm = jax.device_get(
-                    ({k: v[:n_next] for k, v in nb.items()},
-                     nbp[:n_next], nba[:n_next], nbprm[:n_next]))
+                with obs.timer("host_sync"):
+                    rows, par, act, prm = jax.device_get(
+                        ({k: v[:n_next] for k, v in nb.items()},
+                         nbp[:n_next], nba[:n_next], nbprm[:n_next]))
                 drained.append({k: np.asarray(v) for k, v in rows.items()})
                 # par is chunk-relative; lift to level-relative now
                 d_par.append(np.asarray(par, np.int64) + chunk_start)
@@ -195,6 +208,8 @@ class PagedBFS(DeviceBFS):
                 d_prm.append(np.asarray(prm))
                 n_next_total += n_next
                 self.spill_rows += n_next
+                obs.spill(depth, n_next,
+                          n_next * self._state_row_bytes())
                 n_next = 0
 
             def put_chunk():
@@ -216,19 +231,29 @@ class PagedBFS(DeviceBFS):
                 start_t = 0
                 while start_t < n_tiles_c and stop is None:
                     nb, nbp, nba, nbprm = bufs
-                    out = self._level(
-                        table["slots"], dev_chunk,
-                        jnp.asarray(n_c, I32), jnp.asarray(start_t, I32),
-                        nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
-                        jnp.asarray(bool(check_deadlock)))
+                    phase = "compile" if self._fresh_jit else "dispatch"
+                    with obs.timer(phase), obs.annotate(
+                            f"level {depth} {phase}"):
+                        out = self._level(
+                            table["slots"], dev_chunk,
+                            jnp.asarray(n_c, I32),
+                            jnp.asarray(start_t, I32),
+                            nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
+                            jnp.asarray(bool(check_deadlock)))
+                        out["reason"].block_until_ready()
+                    self._fresh_jit = False
+                    obs.count("dispatches")
                     table = {"slots": out["slots"]}
                     bufs = (out["nb"], out["nbp"], out["nba"],
                             out["nbprm"])
-                    reason, start_t, n_next = (int(out["reason"]),
-                                               int(out["t"]),
-                                               int(out["nn"]))
-                    res.states_generated += int(out["gen"])
-                    fp_count += int(out["dist"])
+                    with obs.timer("host_sync"):
+                        sc = jax.device_get([out["reason"], out["t"],
+                                             out["nn"], out["gen"],
+                                             out["dist"]])
+                    reason, start_t, n_next, gen_add, dist_add = (
+                        int(x) for x in sc)
+                    res.states_generated += gen_add
+                    fp_count += dist_add
 
                     if reason == RUNNING:
                         pass
@@ -254,7 +279,8 @@ class PagedBFS(DeviceBFS):
                         res.violated_invariant = bad
                         res.trace = self._trace(gid, extra=(va, vprm))
                         res.diameter = depth
-                        return self._finish(res, t0, depth, fp_count)
+                        return self._finish(res, obs, fp_count,
+                                            table=table, fp_cap=fp_cap)
                     elif reason == R_NEXT_GROW:
                         # the spill tier: page the filled buffer out to
                         # host RAM instead of growing it in HBM
@@ -264,6 +290,8 @@ class PagedBFS(DeviceBFS):
                         old = self.codec.shape.MAX_MSGS
                         drain()
                         self._build(old * 2)
+                        obs.grow("message_table",
+                                 self.codec.shape.MAX_MSGS)
                         host_front = self.codec.pad_msgs(host_front, old)
                         drained = [self.codec.pad_msgs(d, old)
                                    for d in drained]
@@ -282,6 +310,8 @@ class PagedBFS(DeviceBFS):
                     elif reason == R_FPSET_GROW:
                         table = grow(table)
                         fp_cap *= 4
+                        self._fresh_jit = True   # shape change
+                        obs.grow("fpset", fp_cap)
                         emit(f"FPSet grown to {fp_cap} slots")
                     elif reason == R_EXPAND_GROW:
                         aid = int(out["grow_aid"])
@@ -289,10 +319,12 @@ class PagedBFS(DeviceBFS):
                         self._level = jax.jit(
                             self._make_level(),
                             donate_argnums=(0, 4, 5, 6, 7))
+                        self._fresh_jit = True
                         if self.next_cap < self._total_E() + self.tile:
                             drain()
                             self.next_cap = self._total_E() + self.tile
                             bufs = self._alloc_bufs(self.next_cap)
+                        obs.grow("expand_buffer", self.expand_mults[aid])
                         emit(f"expand buffer for "
                              f"{self.kern.action_names[aid]} grown to "
                              f"tile x {self.expand_mults[aid]} "
@@ -314,24 +346,21 @@ class PagedBFS(DeviceBFS):
                              for k in host_front})
                         res.trace = self._trace(gid)
                         res.diameter = depth
-                        return self._finish(res, t0, depth, fp_count)
+                        return self._finish(res, obs, fp_count,
+                                            table=table, fp_cap=fp_cap)
 
-                    now = time.time()
-                    if now - last_progress >= progress_every:
-                        last_progress = now
-                        emit(f"depth {depth}: {fp_count} distinct, "
-                             f"{res.states_generated} generated, "
-                             f"{res.states_generated / (now - t0):.0f} "
-                             f"gen/s, "
-                             f"{fp_count / (now - t0):.0f} distinct/s, "
-                             f"frontier {n_front} (host-paged)")
-                    if max_seconds and now - t0 > max_seconds:
+                    obs.progress(depth=depth, distinct=fp_count,
+                                 generated=res.states_generated,
+                                 frontier=n_front, extra="host-paged")
+                    if max_seconds and time.time() - t0 > max_seconds:
                         stop = f"time budget {max_seconds}s reached"
                 # chunk done (or stopped): spill whatever accumulated
                 drain()
                 chunk_start += n_c
 
             # ---- level complete: assemble next frontier on host ------
+            obs.level_done(depth, frontier=n_front, distinct=fp_count,
+                           generated=res.states_generated)
             if n_next_total:
                 host_next = {
                     k: np.concatenate([d[k] for d in drained])
@@ -354,22 +383,24 @@ class PagedBFS(DeviceBFS):
                     checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
-                save_checkpoint(
-                    checkpoint_path,
-                    slots=table["slots"], frontier=host_front,
-                    n_front=n_front,
-                    h_parent=np.concatenate(self._h_parent),
-                    h_action=np.concatenate(self._h_action),
-                    h_param=np.concatenate(self._h_param),
-                    init_dense=self._init_dense,
-                    level_sizes=self.level_sizes, depth=depth,
-                    fp_count=fp_count,
-                    states_generated=res.states_generated,
-                    max_msgs=self.codec.shape.MAX_MSGS,
-                    expand_mults=self.expand_mults,
-                    elapsed=time.time() - t0,
-                    digest=spec_digest(spec))
+                with obs.timer("checkpoint"):
+                    save_checkpoint(
+                        checkpoint_path,
+                        slots=table["slots"], frontier=host_front,
+                        n_front=n_front,
+                        h_parent=np.concatenate(self._h_parent),
+                        h_action=np.concatenate(self._h_action),
+                        h_param=np.concatenate(self._h_param),
+                        init_dense=self._init_dense,
+                        level_sizes=self.level_sizes, depth=depth,
+                        fp_count=fp_count,
+                        states_generated=res.states_generated,
+                        max_msgs=self.codec.shape.MAX_MSGS,
+                        expand_mults=self.expand_mults,
+                        elapsed=time.time() - t0,
+                        digest=spec_digest(spec))
                 last_checkpoint = time.time()
+                obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
             if n_front == 0:
@@ -380,16 +411,19 @@ class PagedBFS(DeviceBFS):
             if fp_count > 0.5 * fp_cap:
                 table = grow(table)
                 fp_cap *= 4
+                self._fresh_jit = True       # shape change
+                obs.grow("fpset", fp_cap)
                 emit(f"FPSet grown to {fp_cap} slots")
 
         res.diameter = depth
-        return self._finish(res, t0, depth, fp_count)
+        return self._finish(res, obs, fp_count,
+                            table=table, fp_cap=fp_cap)
 
 
 def paged_bfs_check(spec, max_states=None, max_depth=None,
                     check_deadlock=False, tile_size=128, max_msgs=None,
-                    chunk_tiles=64, log=None) -> CheckResult:
+                    chunk_tiles=64, log=None, obs=None) -> CheckResult:
     eng = PagedBFS(spec, max_msgs=max_msgs, tile_size=tile_size,
                    chunk_tiles=chunk_tiles)
     return eng.run(max_states=max_states, max_depth=max_depth,
-                   check_deadlock=check_deadlock, log=log)
+                   check_deadlock=check_deadlock, log=log, obs=obs)

@@ -28,6 +28,7 @@ class SimResult:
     trace: list = field(default_factory=list)
     elapsed: float = 0.0
     deadlocks: int = 0
+    metrics: dict = None      # tpuvsr-metrics/1 document for this run
 
     @property
     def steps_per_sec(self):
@@ -36,10 +37,13 @@ class SimResult:
 
 def simulate(spec: SpecModel, num: int = 100, depth: int = 100,
              seed: int = 0, check_deadlock: bool = False,
-             log=None, time_budget: float = None) -> SimResult:
+             log=None, time_budget: float = None, obs=None) -> SimResult:
+    from ..obs import RunObserver
+    obs = RunObserver.ensure(obs, "interp-sim", spec, log=log)
     rng = random.Random(seed)
     res = SimResult()
     t0 = time.time()
+    obs.start(t0, backend="host")
     inits = list(spec.init_states())
     for w in range(num):
         res.walks = w + 1
@@ -69,11 +73,8 @@ def simulate(spec: SpecModel, num: int = 100, depth: int = 100,
                            state=s)
                 for i, (a, s) in enumerate(walk)]
             break
-        if log and (w + 1) % 10 == 0:
-            el = time.time() - t0
-            log(f"{w + 1}/{num} walks, {res.steps} steps, "
-                f"{res.steps / el:.0f} steps/s")
+        if (w + 1) % 10 == 0:
+            obs.progress(walks=res.walks, steps=res.steps)
         if time_budget and time.time() - t0 > time_budget:
             break
-    res.elapsed = time.time() - t0
-    return res
+    return obs.finish(res)

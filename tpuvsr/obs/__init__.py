@@ -1,0 +1,37 @@
+"""tpuvsr.obs — shared observability layer for every checking engine.
+
+Three pieces (ISSUE 2 tentpole):
+
+* **run journal** (``journal.py``) — append-only JSONL event stream
+  (``run_start`` / ``level_done`` / ``checkpoint`` / ``spill`` /
+  ``grow`` / ``violation`` / ``run_end``) with a stable, validated
+  schema; survives ``-recover`` by appending to the same file;
+* **metrics collector** (``metrics.py``) — per-level counters and
+  exclusive phase timers, dumped as ``tpuvsr-metrics/1`` JSON
+  (``-metrics FILE.json``), merged into the ``-json`` one-line
+  summary, and rendered as a final stats table on stderr;
+* **profiler hooks** (``profiler.py``) — ``TPUVSR_PROFILE=DIR`` wraps
+  the fixpoint loops in ``jax.profiler.trace`` with per-level/phase
+  ``TraceAnnotation`` spans.
+
+``RunObserver`` (``observer.py``) bundles the three; engines accept
+``obs=None`` and collect privately, so ``CheckResult.metrics`` exists
+on every run.  Schemas are documented in ``SCHEMA.md``.
+"""
+
+from __future__ import annotations
+
+from .journal import (EVENT_REQUIRED, JOURNAL_SCHEMA, Journal,
+                      new_run_id, read_journal, validate_journal_line)
+from .metrics import (LEVEL_ROW_KEYS, METRICS_SCHEMA, Metrics,
+                      validate_metrics)
+from .observer import RunObserver, closes_observer
+from .profiler import annotate, profile_dir, profile_trace
+
+__all__ = [
+    "RunObserver", "closes_observer", "Metrics", "Journal",
+    "JOURNAL_SCHEMA", "METRICS_SCHEMA", "EVENT_REQUIRED",
+    "LEVEL_ROW_KEYS", "new_run_id", "read_journal",
+    "validate_journal_line", "validate_metrics",
+    "annotate", "profile_dir", "profile_trace",
+]

@@ -1,0 +1,126 @@
+"""Run journal: append-only JSONL event stream for a checking run.
+
+Every line is one JSON object with at least ``event`` (the type),
+``ts`` (unix seconds), and ``run_id``.  The event vocabulary and the
+per-event required keys are fixed (``EVENT_REQUIRED``) so downstream
+tooling can parse any journal the framework ever wrote; engines may
+add EXTRA keys but never omit required ones — ``validate_journal_line``
+enforces exactly that and is what the golden-file tests run.
+
+The journal is opened in APPEND mode and each event is flushed as it
+is written, so:
+
+* a run killed mid-flight leaves a valid prefix (the whole point:
+  multi-hour TLC-style runs whose only artifact today is a scrollback
+  of progress lines);
+* a ``-recover`` resume pointed at the same path CONTINUES the same
+  file — one journal spans the checkpoint/resume chain, with the
+  resumed segment announcing itself via ``run_start{resumed: true}``
+  and all ``elapsed_s`` fields cumulative across the chain (engines
+  rewind their t0 by the checkpoint's recorded elapsed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+
+JOURNAL_SCHEMA = "tpuvsr-journal/1"
+
+# event type -> required keys (beyond the common event/ts/run_id)
+EVENT_REQUIRED = {
+    "run_start": ("schema", "engine", "module", "backend", "resumed"),
+    "level_done": ("depth", "frontier", "distinct", "generated",
+                   "elapsed_s"),
+    "checkpoint": ("path", "depth", "distinct", "elapsed_s"),
+    "spill": ("depth", "rows", "bytes", "elapsed_s"),
+    "grow": ("what", "to", "elapsed_s"),
+    "violation": ("kind", "name", "elapsed_s"),
+    "run_end": ("ok", "elapsed_s"),
+}
+COMMON_REQUIRED = ("event", "ts", "run_id")
+
+
+def new_run_id():
+    return uuid.uuid4().hex[:12]
+
+
+class Journal:
+    """Append-only JSONL writer.  ``path=None`` makes every method a
+    no-op so engines can call unconditionally."""
+
+    def __init__(self, path=None, run_id=None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        self._fh = None
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a")
+
+    @property
+    def enabled(self):
+        return self._fh is not None
+
+    def reopen(self):
+        """Re-open a closed journal in append mode (observer reuse
+        across a checkpoint/recover pair).  No-op when pathless or
+        already open."""
+        if self.path and self._fh is None:
+            self._fh = open(self.path, "a")
+
+    def write(self, event, **fields):
+        if self._fh is None:
+            return None
+        rec = {"event": event, "ts": round(time.time(), 3),
+               "run_id": self.run_id}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, sort_keys=True,
+                                  default=str) + "\n")
+        self._fh.flush()
+        return rec
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def validate_journal_line(obj):
+    """Raise ValueError unless `obj` is a schema-valid journal event.
+    Returns the event type."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"journal line is {type(obj).__name__}, "
+                         f"not an object")
+    missing = [k for k in COMMON_REQUIRED if k not in obj]
+    if missing:
+        raise ValueError(f"journal line missing common keys: {missing}")
+    ev = obj["event"]
+    if ev not in EVENT_REQUIRED:
+        raise ValueError(f"unknown journal event type {ev!r}")
+    missing = [k for k in EVENT_REQUIRED[ev] if k not in obj]
+    if missing:
+        raise ValueError(f"{ev} event missing keys: {missing}")
+    if ev == "run_start" and obj["schema"] != JOURNAL_SCHEMA:
+        raise ValueError(f"run_start schema {obj['schema']!r}, "
+                         f"want {JOURNAL_SCHEMA!r}")
+    return ev
+
+
+def read_journal(path):
+    """Parse + validate a journal file into a list of event dicts."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSON: {e}")
+            validate_journal_line(obj)
+            out.append(obj)
+    return out

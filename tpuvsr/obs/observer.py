@@ -1,0 +1,345 @@
+"""RunObserver: the one observability object an engine run carries.
+
+Bundles the three obs pieces — run journal (JSONL event stream),
+metrics collector (phase timers + counters + per-level rows), and the
+JAX profiler hooks — behind a single interface every engine threads
+through its fixpoint loop:
+
+    obs = RunObserver.ensure(obs, "device", spec, log=log)
+    obs.start(t0, backend=jax.default_backend(), resumed=False)
+    # start() opens the run-wide "check" phase frame and (under
+    # TPUVSR_PROFILE) the jax.profiler trace; finish() closes both
+    while ...:
+        with obs.timer("dispatch"), obs.annotate(f"level {d}"):
+            out = self._level(...)
+        with obs.timer("host_sync"):
+            sc = jax.device_get(...)
+        obs.level_done(depth, frontier=.., distinct=.., generated=..)
+        obs.progress(depth=.., distinct=.., generated=..)
+    return self._finish(res, obs, fp_count)   # -> obs.finish(res, ...)
+
+Engines that are handed ``obs=None`` get a private collector: metrics
+are always gathered (they're cheap dict/clock ops and become
+``CheckResult.metrics``), while the journal file, the ``-metrics``
+dump, and the stderr stats table only exist when the caller asked for
+them (CLI ``-journal`` / ``-metrics`` flags).
+
+``primary`` exists for the multi-host sharded path: every process
+collects, only host 0 writes files / renders the table (per-shard
+numbers are reduced host-side before they reach the collector).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+from .journal import JOURNAL_SCHEMA, Journal
+from .metrics import Metrics
+from .profiler import annotate as _annotate
+from .profiler import profile_trace
+
+
+def closes_observer(fn):
+    """Decorator for engine ``run`` methods: on ANY escaping exception,
+    finalize the engine's active observer (``self._obs_active``, set
+    right after ``RunObserver.ensure``) — drains timers, stops the
+    TPUVSR_PROFILE jax-profiler session so the failing run's trace is
+    still written, closes the journal — then re-raises."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        except BaseException:
+            obs = getattr(self, "_obs_active", None)
+            if obs is not None:
+                self._obs_active = None
+                obs.close()
+            raise
+    return wrapper
+
+
+class RunObserver:
+    def __init__(self, journal_path=None, metrics_path=None, log=None,
+                 progress_every=10.0, run_id=None, primary=True,
+                 table=None):
+        self.journal = Journal(journal_path if primary else None,
+                               run_id=run_id)
+        self.run_id = self.journal.run_id
+        self.metrics = Metrics()
+        self.metrics_path = metrics_path
+        self.primary = primary
+        self.progress_every = progress_every
+        self.engine = None
+        self.module = None
+        self.backend = None
+        self._log = log
+        # stats table on stderr: on when explicitly requested, else only
+        # for runs that asked for observability artifacts
+        self._table = table
+        self._t0 = None
+        self._last_progress = None
+        self._finished = False
+        self._profile_cm = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def ensure(cls, obs, engine, spec=None, log=None,
+               progress_every=None):
+        """Engine entry point: adopt the caller's observer or create a
+        private one; stamp run identity either way."""
+        if obs is None:
+            obs = cls(log=log,
+                      progress_every=(10.0 if progress_every is None
+                                      else progress_every))
+        else:
+            if obs._log is None:
+                obs._log = log
+            if progress_every is not None:
+                obs.progress_every = progress_every
+        obs.engine = engine
+        if spec is not None and obs.module is None:
+            obs.module = spec.module.name
+        return obs
+
+    @property
+    def detailed(self):
+        """True when the run asked for observability artifacts (journal
+        or metrics dump) — the gate for stats that cost a device pull."""
+        return self.journal.enabled or self.metrics_path is not None
+
+    def log(self, msg):
+        if self._log:
+            self._log(msg)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, t0, backend=None, resumed=False, **extra):
+        """Begin the run clock.  `t0` is the engine's epoch — already
+        rewound by the checkpoint's elapsed on a resume, so every
+        ``elapsed_s`` this observer reports is cumulative across a
+        checkpoint/recover chain.
+
+        Also opens the run-wide instrumentation: the catch-all "check"
+        phase frame (inner compile/dispatch/host_sync timers carve
+        their time out of it, so the reported phases are disjoint and
+        sum to the run's wall-clock) and, under ``TPUVSR_PROFILE=DIR``,
+        the ``jax.profiler.trace`` session around the fixpoint loop.
+        Both are closed by ``finish``.  Starting a FINISHED observer
+        re-arms it (journal reopened in append mode, run_end guard
+        reset) so one observer can ride a checkpoint run and its
+        resume — the documented one-continuous-journal pattern —
+        without the second segment silently journaling nothing;
+        metrics keep accumulating across the segments, matching the
+        cumulative elapsed convention."""
+        if self._finished:
+            self._finished = False
+            if self.primary:
+                self.journal.reopen()
+        self._t0 = t0
+        self._last_progress = time.time()
+        self.backend = backend or self.backend or "host"
+        self.journal.write("run_start", schema=JOURNAL_SCHEMA,
+                           engine=self.engine, module=self.module,
+                           backend=self.backend, resumed=bool(resumed),
+                           **extra)
+        self._profile_cm = profile_trace(log=self._log)
+        self._profile_cm.__enter__()
+        self.metrics.begin("check")
+
+    def close(self):
+        """Finalize instrumentation on an abnormal exit: drain open
+        timer frames, stop the profiler session (so the trace of the
+        FAILING run — the one worth inspecting — still gets written),
+        close the journal file.  Idempotent; a normal ``finish`` covers
+        all of it.  Engines with a delegating run funnel call this on
+        exception; elsewhere an in-band engine error behaves like a
+        kill (valid journal prefix, no run_end — the documented crash
+        contract)."""
+        self.metrics.drain()
+        if self._profile_cm is not None:
+            self._profile_cm.__exit__(None, None, None)
+            self._profile_cm = None
+        self.journal.close()
+
+    def set_epoch(self, t0):
+        """Re-anchor the run clock after ``start`` — used when a resume
+        rewinds t0 by the checkpoint's recorded elapsed so reported
+        ``elapsed_s`` stays cumulative across the recover chain."""
+        self._t0 = t0
+
+    def elapsed(self):
+        return time.time() - self._t0 if self._t0 is not None else 0.0
+
+    # -- metrics delegates ---------------------------------------------
+    def timer(self, phase):
+        return self.metrics.timer(phase)
+
+    def count(self, name, n=1):
+        self.metrics.count(name, n)
+
+    def gauge(self, name, value):
+        self.metrics.gauge(name, value)
+
+    # -- profiler delegates --------------------------------------------
+    def annotate(self, name):
+        return _annotate(name)
+
+    # -- events --------------------------------------------------------
+    def level_done(self, depth, *, frontier, distinct, generated,
+                   **extra):
+        el = self.elapsed()
+        self.metrics.level(depth, frontier=frontier, distinct=distinct,
+                           generated=generated, elapsed_s=el, **extra)
+        self.journal.write("level_done", depth=int(depth),
+                           frontier=int(frontier), distinct=int(distinct),
+                           generated=int(generated),
+                           elapsed_s=round(el, 3), **extra)
+
+    def checkpoint(self, path, depth, distinct):
+        self.count("checkpoints")
+        self.journal.write("checkpoint", path=str(path), depth=int(depth),
+                           distinct=int(distinct),
+                           elapsed_s=round(self.elapsed(), 3))
+
+    def spill(self, depth, rows, nbytes):
+        self.count("spills")
+        self.count("spill_rows", rows)
+        self.count("spill_bytes", nbytes)
+        self.journal.write("spill", depth=int(depth), rows=int(rows),
+                           bytes=int(nbytes),
+                           elapsed_s=round(self.elapsed(), 3))
+
+    def grow(self, what, to):
+        """A growth pause (message table / FPSet / buffers / exchange
+        bucket): counters + journal; the engine logs its own wording."""
+        self.count("grows")
+        self.count(f"grow_{what}")
+        self.journal.write("grow", what=what, to=int(to),
+                           elapsed_s=round(self.elapsed(), 3))
+
+    # -- the one progress formatter (drift-proof across engines) -------
+    def progress(self, depth=None, distinct=None, generated=None,
+                 frontier=None, walks=None, steps=None, extra=None,
+                 force=False):
+        """Throttled, uniformly formatted progress line.  BFS engines
+        pass depth/distinct/generated(/frontier); simulation engines
+        pass walks/steps.  Returns True when a line was emitted."""
+        if self._log is None:
+            return False
+        now = time.time()
+        if not force and self._last_progress is not None and \
+                now - self._last_progress < self.progress_every:
+            return False
+        self._last_progress = now
+        el = max(now - self._t0, 1e-9) if self._t0 is not None else None
+        parts = []
+        if walks is not None:
+            parts.append(f"{walks} walks")
+            if steps is not None:
+                parts.append(f"{steps} steps")
+                if el:
+                    parts.append(f"{steps / el:.0f} steps/s")
+        else:
+            if depth is not None:
+                parts.append(f"depth {depth}")
+            if distinct is not None:
+                parts.append(f"{distinct} distinct")
+            if generated is not None:
+                parts.append(f"{generated} generated")
+            if el and distinct is not None:
+                parts.append(f"{distinct / el:.0f} distinct/s")
+            if el and generated is not None:
+                parts.append(f"{generated / el:.0f} gen/s")
+            if frontier is not None:
+                parts.append(f"frontier {frontier}")
+        if extra:
+            parts.append(str(extra))
+        first, rest = parts[0], ", ".join(parts[1:])
+        self._log(f"{first}: {rest}" if (depth is not None and rest)
+                  else ", ".join(parts))
+        return True
+
+    # -- finish --------------------------------------------------------
+    def finish(self, res, levels=None):
+        """Uniform result finalization for every engine: stamps
+        ``elapsed`` / ``states_per_sec`` / ``levels`` / ``metrics`` on
+        the result object, journals violation + run_end, dumps the
+        ``-metrics`` file, renders the stderr stats table."""
+        self.metrics.drain()          # close "check" + any open frames
+        if self._profile_cm is not None:
+            self._profile_cm.__exit__(None, None, None)
+            self._profile_cm = None
+        elapsed = self.elapsed() if self._t0 is not None \
+            else getattr(res, "elapsed", 0.0) or 0.0
+        res.elapsed = elapsed
+        el = max(elapsed, 1e-9)
+        summary = {"ok": bool(res.ok), "elapsed_s": round(elapsed, 6)}
+        violated = getattr(res, "violated_invariant",
+                           getattr(res, "property_name", None))
+        error = getattr(res, "error", None)
+        if hasattr(res, "states_generated"):            # CheckResult
+            if levels is not None:
+                res.levels = [int(x) for x in levels]
+            res.states_per_sec = res.states_generated / el
+            self.gauge("states_per_sec", res.states_per_sec)
+            self.gauge("distinct_per_s", res.distinct_states / el)
+            if res.states_generated:
+                self.gauge("dedup_hit_rate",
+                           1.0 - res.distinct_states
+                           / res.states_generated)
+            summary.update(distinct=int(res.distinct_states),
+                           generated=int(res.states_generated),
+                           diameter=int(res.diameter))
+        elif hasattr(res, "walks"):                     # SimResult
+            self.gauge("steps_per_s", res.steps / el)
+            summary.update(walks=int(res.walks), steps=int(res.steps),
+                           deadlocks=int(res.deadlocks))
+        elif hasattr(res, "property_name"):             # LivenessResult
+            summary.update(distinct=int(res.distinct_states))
+        summary["violated"] = violated
+        summary["error"] = error
+        if not res.ok and not self._finished:
+            kind = ("invariant" if violated else
+                    "deadlock" if (error == "deadlock"
+                                   or getattr(res, "deadlocks", 0))
+                    else "error")
+            self.journal.write("violation", kind=kind,
+                               name=violated or error or kind,
+                               elapsed_s=round(elapsed, 3))
+        if not self._finished:
+            self.journal.write("run_end", **summary)
+        self._finished = True
+        doc = self.metrics.to_dict(
+            run_id=self.run_id, engine=self.engine, module=self.module,
+            backend=self.backend, **summary)
+        res.metrics = doc
+        if self.metrics_path and self.primary:
+            d = os.path.dirname(os.path.abspath(self.metrics_path))
+            os.makedirs(d, exist_ok=True)
+            with open(self.metrics_path, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            self.log(f"metrics written to {self.metrics_path}")
+        if self._log and self.primary and (
+                self._table or (self._table is None and self.detailed)):
+            self._render_table(doc)
+        self.journal.close()
+        return res
+
+    def _render_table(self, doc):
+        ph = doc["phases"]
+        if ph:
+            tot = sum(ph.values()) or 1e-9
+            self.log("phase seconds: " + ", ".join(
+                f"{k} {v:.2f}s ({100 * v / tot:.0f}%)"
+                for k, v in sorted(ph.items(), key=lambda kv: -kv[1])))
+        if doc["counters"]:
+            self.log("counters: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(doc["counters"].items())))
+        ga = doc["gauges"]
+        keyed = [f"{k}={ga[k]:.3g}" if isinstance(ga[k], (int, float))
+                 else f"{k}={ga[k]}" for k in sorted(ga)]
+        if keyed:
+            self.log("gauges: " + ", ".join(keyed))

@@ -1,0 +1,73 @@
+"""JAX profiler hooks, gated on ``TPUVSR_PROFILE=DIR``.
+
+With the env var set (or an explicit directory passed), the engines'
+fixpoint loops run inside ``jax.profiler.trace(DIR)`` and the
+per-level / per-phase sections are wrapped in
+``jax.profiler.TraceAnnotation`` spans — so a TensorBoard / Perfetto
+trace of a checking run shows ``level 7`` / ``dispatch`` /
+``host_sync`` spans instead of an undifferentiated wall of XLA ops.
+
+Everything degrades to a no-op when profiling is off (the default):
+``annotate`` costs one env check per call and ``profile_trace`` yields
+immediately, so the hooks can stay permanently wired into every
+engine.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+
+
+def profile_dir():
+    """The profile output directory, or None when profiling is off."""
+    return os.environ.get("TPUVSR_PROFILE") or None
+
+
+@contextmanager
+def profile_trace(directory=None, log=None):
+    """Wrap a fixpoint loop in ``jax.profiler.trace``.
+
+    `directory` defaults to ``$TPUVSR_PROFILE``; with neither set (or
+    jax.profiler unavailable) this is a transparent no-op."""
+    directory = directory or profile_dir()
+    if not directory:
+        yield False
+        return
+    try:
+        import jax.profiler as _prof
+    except Exception:                           # pragma: no cover
+        yield False
+        return
+    os.makedirs(directory, exist_ok=True)
+    try:
+        ctx = _prof.trace(directory)
+        ctx.__enter__()
+    except Exception as e:                      # noqa: BLE001
+        # e.g. a previous run leaked its session ("profiler already
+        # active"): degrade to no-trace instead of killing the run
+        if log:
+            log(f"profiler unavailable ({e}); continuing untraced")
+        yield False
+        return
+    if log:
+        log(f"profiling to {directory} (TPUVSR_PROFILE)")
+    try:
+        yield True
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception:                       # noqa: BLE001
+            pass
+
+
+def annotate(name):
+    """A ``jax.profiler.TraceAnnotation(name)`` span when profiling is
+    on, else a free nullcontext."""
+    if not profile_dir():
+        return nullcontext()
+    try:
+        import jax.profiler as _prof
+        return _prof.TraceAnnotation(name)
+    except Exception:                           # pragma: no cover
+        return nullcontext()

@@ -48,9 +48,27 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.fpset import dedup_batch, insert_core
+from ..obs import closes_observer
 from .multihost import make_replicator, put_sharded
 
 U32 = jnp.uint32
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions.  The rep/vma-check kwarg was
+    renamed (check_rep -> check_vma) independently of the API's
+    promotion out of jax.experimental, so discriminate on the actual
+    signature, not on where the function lives."""
+    import inspect
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    knob = ("check_vma" if "check_vma" in params else
+            "check_rep" if "check_rep" in params else None)
+    kw = {knob: False} if knob else {}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kw)
 
 
 def route(fps):
@@ -310,11 +328,10 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 one(out["dead"]))
 
     sp = P(axis)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(_shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 12,
-        check_vma=False))
+        out_specs=(sp,) * 12))
     return step
 
 
@@ -329,12 +346,17 @@ class ShardedBFS:
 
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=None, next_capacity=1 << 12,
-                 fpset_capacity=1 << 14, check_deadlock=False):
+                 fpset_capacity=1 << 14, check_deadlock=False,
+                 model_factory=None):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
+        # model_factory(spec, max_msgs=..) -> (codec, kernel); default
+        # is the hand-kernel registry (DeviceBFS parity — tests drive
+        # the driver with stub kernels through this hook)
+        self._model_factory = model_factory
         # bucket_cap=None: occupancy-calibrated — start minimal and let
         # R_BUCKET_GROW converge to the run's high-water mark (wire
         # volume is cap-bound; see module docstring)
@@ -351,14 +373,16 @@ class ShardedBFS:
         from ..models import registry
         registry.ensure_compile_cache()
         registry.ensure_debug_flags()
-        self.codec, self.kern = registry.make_model(self.spec,
-                                                    max_msgs=max_msgs)
+        factory = self._model_factory or registry.make_model
+        self.codec, self.kern = factory(self.spec, max_msgs=max_msgs)
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
                                         self.axis, self.tile,
                                         self.bucket_cap,
                                         check_deadlock=self._ckd)
+        self._fresh_jit = True   # first dispatch after a (re)jit is
+        #                          charged to the "compile" phase
         self._sh = NamedSharding(self.mesh, P(self.axis))
         self._rep_sh = NamedSharding(self.mesh, P())
         # multi-process: host pulls of globally-sharded arrays must
@@ -409,23 +433,34 @@ class ShardedBFS:
         out = np.concatenate([host, pad], axis=1)
         return self._put(out.reshape((D * new_cap,) + host.shape[2:]))
 
+    @closes_observer
     def run(self, max_depth=None, max_states=None, max_seconds=None,
             log=None, check_deadlock=None, checkpoint_path=None,
-            checkpoint_every=None, resume_from=None) -> "CheckResult":
+            checkpoint_every=None, resume_from=None,
+            progress_every=10.0, obs=None) -> "CheckResult":
         import time as _time
         from ..analysis import preflight
         from ..core.values import TLAError
         from ..engine.bfs import CheckResult
         from ..engine.fpset import grow as fp_grow
+        from ..obs import RunObserver
         preflight(self.spec, log=log)   # fail fast, before any dispatch
+        obs = RunObserver.ensure(obs, "sharded", self.spec, log=log,
+                                 progress_every=progress_every)
+        self._obs_active = obs          # closes_observer finalizes it
+        # multi-process: every rank collects, only host 0 writes the
+        # journal / metrics file / stats table (per-shard numbers are
+        # reduced host-side before they reach the collector)
+        if jax.process_index() != 0:
+            obs.primary = False
+            obs.journal.close()     # write() no-ops once closed
         spec, codec = self.spec, self.codec
         D = self.D
         res = CheckResult()
         t0 = _time.time()
-
-        def emit(msg):
-            if log:
-                log(msg)
+        obs.start(t0, backend=jax.default_backend(),
+                  resumed=resume_from is not None)
+        emit = obs.log
 
         if check_deadlock is not None and bool(check_deadlock) != self._ckd:
             self._ckd = bool(check_deadlock)
@@ -479,6 +514,7 @@ class ShardedBFS:
             fp_count = ck["fp_count"]
             res.states_generated = ck["states_generated"]
             t0 -= ck["elapsed"]
+            obs.set_epoch(t0)
             self._dev_distinct = np.asarray(ex["dev_distinct"], np.int64)
             xc = ex.get("exchange") or {}
             exch_rows_useful = xc.get("useful_rows", 0)
@@ -561,7 +597,7 @@ class ShardedBFS:
                     res.ok = False
                     res.violated_invariant = bad
                     res.trace = self._trace(i)
-                    return self._finish(res, t0, 0, fp_count)
+                    return self._finish(res, obs, fp_count)
             res.states_generated += len(dense)
 
         def _attach_exchange(r):
@@ -572,13 +608,14 @@ class ShardedBFS:
                 "wire_rows": exch_rows_wire,
                 "wire_bytes": exch_bytes_wire,
             }
+            for k, v in r.exchange.items():
+                obs.gauge(f"exchange_{k}", int(v))
             emit(f"exchange: {exch_rows_useful} useful rows "
                  f"({exch_bytes_useful / 1e6:.1f} MB) / "
                  f"{exch_rows_wire} wire rows "
                  f"({exch_bytes_wire / 1e6:.1f} MB)")
 
         depth = depth0
-        last_progress = t0
         last_checkpoint = _time.time()
 
         # multi-process SPMD discipline: any control decision based on
@@ -595,7 +632,11 @@ class ShardedBFS:
         else:
             def agree(flag):
                 return bool(flag)
-        while int(self._pull(n_front).sum()) > 0:
+        while True:
+            with obs.timer("host_sync"):
+                front_total = int(self._pull(n_front).sum())
+            if front_total <= 0:
+                break
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
                 break
@@ -605,12 +646,19 @@ class ShardedBFS:
             start_t = self._put(np.zeros(D, np.int32))
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
-                (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
-                 viol_out, gen_out, sent_out, dead_out) = self._step(
-                    tables, front, n_front, start_t,
-                    nb, nbp, nba, nbprm, nn, base_gid)
-                reason = int(self._pull(reason_out)[0])
-                sent = int(self._pull(sent_out).sum())
+                phase = "compile" if self._fresh_jit else "dispatch"
+                with obs.timer(phase), obs.annotate(
+                        f"level {depth} {phase}"):
+                    (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
+                     viol_out, gen_out, sent_out, dead_out) = self._step(
+                        tables, front, n_front, start_t,
+                        nb, nbp, nba, nbprm, nn, base_gid)
+                    reason_out.block_until_ready()
+                self._fresh_jit = False
+                obs.count("dispatches")
+                with obs.timer("host_sync"):
+                    reason = int(self._pull(reason_out)[0])
+                    sent = int(self._pull(sent_out).sum())
                 exch_rows_useful += sent
                 exch_bytes_useful += sent * _row_bytes()
                 start_t = t_out
@@ -632,7 +680,7 @@ class ShardedBFS:
                     res.violated_invariant = bad
                     res.diameter = depth
                     _attach_exchange(res)
-                    return self._finish(res, t0, depth, fp_count)
+                    return self._finish(res, obs, fp_count)
                 if reason == R_SLOT_ERR:
                     raise TLAError(
                         "dense-layout slot collision in sharded BFS "
@@ -650,7 +698,7 @@ class ShardedBFS:
                     res.trace = self._trace(gid)
                     res.diameter = depth
                     _attach_exchange(res)
-                    return self._finish(res, t0, depth, fp_count)
+                    return self._finish(res, obs, fp_count)
                 if reason == R_BAG_GROW:
                     old = self.codec.shape.MAX_MSGS
                     self._build(old * 2)
@@ -674,6 +722,7 @@ class ShardedBFS:
                         return out
                     front = pad_msgs_global(front, F)
                     nb = pad_msgs_global(nb, self.N)
+                    obs.grow("message_table", self.codec.shape.MAX_MSGS)
                     emit(f"message table grown to "
                          f"{self.codec.shape.MAX_MSGS} (recompiling)")
                 elif reason == R_BUCKET_GROW:
@@ -682,6 +731,8 @@ class ShardedBFS:
                         self.kern, self._inv, self.mesh, self.axis,
                         self.tile, self.bucket_cap,
                         check_deadlock=self._ckd)
+                    self._fresh_jit = True
+                    obs.grow("exchange_bucket", self.bucket_cap)
                     emit(f"exchange bucket grown to {self.bucket_cap} "
                          f"(recompiling)")
                 elif reason == R_NEXT_GROW:
@@ -692,6 +743,8 @@ class ShardedBFS:
                     nba = self._grow_global(nba, self.N, new_n)
                     nbprm = self._grow_global(nbprm, self.N, new_n)
                     self.N = new_n
+                    self._fresh_jit = True   # shape change: retrace
+                    obs.grow("next_buffer", new_n)
                     emit(f"next-frontier grown to {new_n}/device")
                 elif reason == R_FPSET_GROW:
                     slots = self._pull(tables["slots"])
@@ -700,24 +753,32 @@ class ShardedBFS:
                     self.fp_cap = int(grown[0].shape[0])
                     tables = {"slots": self._put(np.stack(
                         [np.asarray(g) for g in grown]))}
+                    self._fresh_jit = True   # shape change: retrace
+                    obs.grow("fpset", self.fp_cap)
                     emit(f"FPSet shards grown to {self.fp_cap}/device")
                 else:
                     raise TLAError(f"unknown sharded reason {reason}")
 
             # committed tiles this level x full static bucket volume
-            wire = int(self._pull(start_t).max()) * D * D * self.bucket_cap
-            exch_rows_wire += wire
-            exch_bytes_wire += wire * _row_bytes()
-            nn_h = self._pull(nn)
-            gen_h = int(self._pull(gen_out).sum())
+            with obs.timer("host_sync"):
+                wire = (int(self._pull(start_t).max())
+                        * D * D * self.bucket_cap)
+                exch_rows_wire += wire
+                exch_bytes_wire += wire * _row_bytes()
+                nn_h = self._pull(nn)
+                gen_h = int(self._pull(gen_out).sum())
             res.states_generated += gen_h
             n_next = int(nn_h.sum())
             fp_count += n_next
+            obs.level_done(depth, frontier=front_total,
+                           distinct=fp_count,
+                           generated=res.states_generated)
             if n_next:
-                self._h_parent.append(
-                    self._pull_rows(nbp, nn_h).astype(np.int64))
-                self._h_action.append(self._pull_rows(nba, nn_h))
-                self._h_param.append(self._pull_rows(nbprm, nn_h))
+                with obs.timer("host_sync"):
+                    self._h_parent.append(
+                        self._pull_rows(nbp, nn_h).astype(np.int64))
+                    self._h_action.append(self._pull_rows(nba, nn_h))
+                    self._h_param.append(self._pull_rows(nbprm, nn_h))
                 self.level_sizes.append(n_next)
                 self._dev_distinct += nn_h
             # gid bases of the new frontier (device-order concatenation)
@@ -767,16 +828,13 @@ class ShardedBFS:
                                    "useful_bytes": exch_bytes_useful,
                                    "wire_bytes": exch_bytes_wire}})
                 last_checkpoint = _time.time()
+                obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
 
-            now = _time.time()
-            if now - last_progress >= 10.0 and log:
-                last_progress = now
-                emit(f"depth {depth}: {fp_count} distinct, "
-                     f"{res.states_generated} generated, "
-                     f"{fp_count / (now - t0):.0f} distinct/s")
-            if max_seconds and agree(now - t0 > max_seconds):
+            obs.progress(depth=depth, distinct=fp_count,
+                         generated=res.states_generated)
+            if max_seconds and agree(_time.time() - t0 > max_seconds):
                 res.error = f"time budget {max_seconds}s reached"
                 break
             if max_states and fp_count >= max_states:
@@ -790,18 +848,27 @@ class ShardedBFS:
                 self.fp_cap = int(grown[0].shape[0])
                 tables = {"slots": self._put(np.stack(
                     [np.asarray(g) for g in grown]))}
+                self._fresh_jit = True       # shape change: retrace
+                obs.grow("fpset", self.fp_cap)
                 emit(f"FPSet shards grown to {self.fp_cap}/device")
 
         res.diameter = depth
         _attach_exchange(res)
-        return self._finish(res, t0, depth, fp_count)
+        return self._finish(res, obs, fp_count)
 
-    @staticmethod
-    def _finish(res, t0, depth, fp_count):
-        import time as _time
+    def _finish(self, res, obs, fp_count):
         res.distinct_states = fp_count
-        res.elapsed = _time.time() - t0
-        return res
+        cap_total = self.fp_cap * self.D
+        obs.gauge("fpset_capacity", cap_total)
+        obs.gauge("fpset_occupancy",
+                  fp_count / cap_total if cap_total else 0.0)
+        if hasattr(self, "_dev_distinct"):
+            # per-shard distinct counts, reduced on host 0 (the only
+            # rank that writes the metrics file / journal)
+            obs.gauge("shard_distinct",
+                      [int(x) for x in self._dev_distinct])
+        return obs.finish(res,
+                          levels=getattr(self, "level_sizes", None))
 
 
 def make_sharded_insert(mesh: Mesh, axis: str):
@@ -818,6 +885,6 @@ def make_sharded_insert(mesh: Mesh, axis: str):
         return ({k: v[None] for k, v in tables.items()},
                 jnp.asarray([fresh.sum()]), jnp.asarray([ovf]))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         ins, mesh=mesh, in_specs=(P(axis), P(), P()),
-        out_specs=(P(axis), P(axis), P(axis)), check_vma=False))
+        out_specs=(P(axis), P(axis), P(axis))))
