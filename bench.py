@@ -86,6 +86,79 @@ def _on_signal(signum, frame):
     emit(1)
 
 
+def _is_cpu_backend(b):
+    return str(b).startswith("cpu")
+
+
+def _perf_gate(result):
+    """Diff this run's metrics against the newest BENCH_r*.json via
+    scripts/compare_bench.py and embed the verdict (ISSUE 3 satellite:
+    the perf gate rides the round driver's own artifact instead of
+    needing a separate CI step).  Cross-backend comparisons (a
+    cpu-fallback run against a TPU round, or vice versa) are marked
+    advisory: ok=None."""
+    import contextlib
+    import glob
+    import io
+    import tempfile
+    try:
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import compare_bench
+        prev = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        if not prev:
+            return {"skipped": "no BENCH_r*.json baseline in the repo"}
+        # the round driver wraps the bench RESULT under "parsed" (and
+        # leaves it null when the last stdout line wasn't the metric
+        # JSON) — walk newest-first for a round with a usable number
+        baseline, base_doc = None, None
+        for cand_path in reversed(prev):
+            with open(cand_path) as f:
+                doc = json.load(f)
+            if isinstance(doc.get("parsed"), dict):
+                doc = doc["parsed"]
+            if compare_bench.throughput(doc, "distinct_per_s")[0] \
+                    is not None:
+                baseline, base_doc = cand_path, doc
+                break
+        if baseline is None:
+            return {"skipped": "no BENCH_r*.json round carries a "
+                               "usable distinct_per_s baseline"}
+        pct = float(os.environ.get("BENCH_MAX_REGRESSION_PCT", "15"))
+        cand = {k: result.get(k)
+                for k in ("value", "metrics", "backend")}
+        fd, cpath = tempfile.mkstemp(suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            json.dump(cand, f)
+        # the baseline may have been unwrapped from the driver's
+        # "parsed" field — hand compare_bench the unwrapped doc
+        fd, bpath = tempfile.mkstemp(suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            json.dump(base_doc, f)
+        buf = io.StringIO()
+        try:
+            with contextlib.redirect_stdout(buf), \
+                    contextlib.redirect_stderr(buf):
+                rc = compare_bench.main(
+                    [bpath, cpath, "--max-regression", str(pct)])
+        finally:
+            os.unlink(cpath)
+            os.unlink(bpath)
+        same = (_is_cpu_backend(base_doc.get("backend", ""))
+                == _is_cpu_backend(result.get("backend", "")))
+        return {
+            "baseline": os.path.basename(baseline),
+            "baseline_backend": base_doc.get("backend"),
+            "candidate_backend": result.get("backend"),
+            "max_regression_pct": pct,
+            "exit_code": rc,
+            "ok": (rc == 0) if same else None,
+            "advisory": not same,
+            "detail": buf.getvalue().strip().splitlines()[:8],
+        }
+    except Exception as e:  # noqa: BLE001 — the gate never kills bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _probe_default_backend(timeout=180):
     """Can the session's default JAX platform initialize?  Run the probe
     in a subprocess: a dead TPU tunnel hangs backend init forever."""
@@ -201,6 +274,11 @@ def main():
         res2 = runner(max_seconds=max(30.0, DEADLINE - time.time()))
         RESULT["run2_distinct_per_s"] = round(
             res2.distinct_states / res2.elapsed, 1)
+    RESULT["perf_gate"] = _perf_gate(RESULT)
+    if RESULT["perf_gate"].get("ok") is False:
+        print(f"bench: PERF GATE FAILED vs "
+              f"{RESULT['perf_gate']['baseline']}: "
+              f"{RESULT['perf_gate']['detail']}", file=sys.stderr)
     RESULT["regression_note"] = (
         "r2->r3 CPU headline dropped 8399->6564 distinct/s because r3 "
         "widened the shared message-header plane from 9 to 11 columns "

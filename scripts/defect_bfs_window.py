@@ -35,6 +35,7 @@ backend = ensure_backend(log=lambda m: print(f"[defect_window] {m}",
 
 from tpuvsr.engine.paged_bfs import PagedBFS          # noqa: E402
 from tpuvsr.engine.spec import load_spec              # noqa: E402
+from tpuvsr.obs import RunObserver                    # noqa: E402
 
 seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
 tile = int(sys.argv[2]) if len(sys.argv) > 2 else 256
@@ -42,6 +43,12 @@ chunk_tiles = int(sys.argv[3]) if len(sys.argv) > 3 else 16
 
 CKPT = os.path.join(REPO, "scripts", "defect_window_ckpt")
 OUT = os.path.join(REPO, "scripts", "defect_window.json")
+# round-artifact trajectories (ISSUE 3 satellite / ROADMAP follow-up):
+# the journal appends across resumed windows — one continuous event
+# stream for the whole checkpoint/recover chain — and the metrics file
+# carries the last window's per-level rows + phase timers
+JOURNAL = os.path.join(REPO, "scripts", "defect_window.jsonl")
+METRICS = os.path.join(REPO, "scripts", "defect_window_metrics.json")
 
 REFERENCE = os.environ.get(
     "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
@@ -60,6 +67,7 @@ if resume:
     print(f"[defect_window] resuming from {CKPT}", flush=True)
 res = eng.run(max_seconds=prev_elapsed + seconds, resume_from=resume,
               checkpoint_path=CKPT, checkpoint_every=120.0,
+              obs=RunObserver(journal_path=JOURNAL, metrics_path=METRICS),
               log=lambda m: print(f"[defect_window] {m}", flush=True))
 window_elapsed = time.time() - t0          # this window's wall clock
 elapsed = res.elapsed                      # cumulative across resumes
@@ -129,6 +137,10 @@ out = {
     "violated": res.violated_invariant,
     "error": res.error,
     "ok": res.ok,
+    "journal": "scripts/defect_window.jsonl",
+    "metrics_file": "scripts/defect_window_metrics.json",
+    "phases": (res.metrics or {}).get("phases"),
+    "counters": (res.metrics or {}).get("counters"),
 }
 with open(OUT, "w") as f:
     json.dump(out, f, indent=1)

@@ -1,0 +1,242 @@
+"""Fault-injection smoke matrix on the inline stub spec (ISSUE 3).
+
+Runs every resilience path end to end, in-process, through the REAL
+engine loops driven by the stub kernel (tpuvsr/testing.py) — no
+reference mount, no TPU, seconds on the CPU backend:
+
+  oom-degrade        injected RESOURCE_EXHAUSTED at a mid level ->
+                     supervisor halves the tile, retries from the
+                     snapshot, completes with the exact fixpoint
+  oom-paged-fallback repeated OOMs at the tile floor -> hbm -> paged
+                     engine fallback, still the exact fixpoint
+  kill-rescue        injected SIGTERM under a PreemptionGuard ->
+                     rescue checkpoint at the level boundary,
+                     Preempted raised; -recover reproduces the
+                     uninterrupted run's counts exactly
+  corrupt-ckpt       crash-corrupted snapshot write (payload truncated,
+                     .old kept) -> load_checkpoint falls back to .old
+                     and the resumed run still reaches the fixpoint
+  exchange-drop      transient sharded-exchange failure -> journaled
+                     retry, level step re-issued, exact fixpoint
+
+Prints one JSON object; exit 0 iff every scenario passed.  Run by
+tests/test_resilience.py under tier-1 and standalone:
+
+    python scripts/fault_matrix.py
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    # standalone: force the virtual-device CPU backend BEFORE any jax
+    # import (under pytest, tests/conftest.py already did this)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _fl = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _fl:
+        os.environ["XLA_FLAGS"] = (
+            _fl + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, REPO)
+
+
+def _oracle():
+    from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS
+    return {"distinct": STUB_DISTINCT, "levels": STUB_LEVELS}
+
+
+def _factory(spec):
+    from tpuvsr.testing import stub_engine_factory
+    return stub_engine_factory(spec)
+
+
+def _events(path):
+    from tpuvsr.obs import read_journal
+    return [e["event"] for e in read_journal(path)]
+
+
+def scenario_oom_degrade(tmp):
+    ORACLE = _oracle()
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import Supervisor
+    from tpuvsr.testing import counter_spec
+    spec = counter_spec()
+    jp = os.path.join(tmp, "oom.jsonl")
+    faults.install("oom@level=3")
+    try:
+        sup = Supervisor(spec, checkpoint_path=os.path.join(tmp, "ck"),
+                         journal_path=jp, engine_factory=_factory(spec),
+                         tile_size=4, min_tile=2, backoff_base=0.0,
+                         sleep=lambda s: None)
+        res = sup.run()
+    finally:
+        faults.clear()
+    ev = _events(jp)
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"] and sup.attempts == 2
+               and ("tile", 4, 2) in sup.degrades
+               and "fault" in ev and "retry" in ev and "degrade" in ev),
+        "attempts": sup.attempts, "degrades": sup.degrades,
+        "distinct": res.distinct_states,
+    }
+
+
+def scenario_oom_paged_fallback(tmp):
+    ORACLE = _oracle()
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import Supervisor
+    from tpuvsr.testing import counter_spec
+    spec = counter_spec()
+    jp = os.path.join(tmp, "paged.jsonl")
+    # tile 4 with floor 4: the first OOM exhausts the halving ladder
+    # and falls straight to the paged engine; later OOMs retry there
+    faults.install("oom@level=2,oom@level=3,oom@level=4")
+    try:
+        sup = Supervisor(spec, checkpoint_path=os.path.join(tmp, "ck"),
+                         journal_path=jp, engine_factory=_factory(spec),
+                         tile_size=4, min_tile=4, backoff_base=0.0,
+                         sleep=lambda s: None)
+        res = sup.run()
+    finally:
+        faults.clear()
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"]
+               and sup.kind == "paged"
+               and ("engine", "device", "paged") in sup.degrades),
+        "attempts": sup.attempts, "engine": sup.kind,
+        "distinct": res.distinct_states,
+    }
+
+
+def scenario_kill_rescue(tmp):
+    ORACLE = _oracle()
+    from tpuvsr.obs import RunObserver
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "kill-ck")
+    jp = os.path.join(tmp, "kill.jsonl")
+    faults.install("kill@level=3")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                stub_device_engine().run(
+                    checkpoint_path=ck,
+                    obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    res2 = stub_device_engine().run(resume_from=ck)
+    ev = _events(jp)
+    return {
+        "ok": (preempted.depth == 3 and res2.ok
+               and res2.distinct_states == ORACLE["distinct"]
+               and res2.levels == ORACLE["levels"]
+               and "rescue_checkpoint" in ev and "fault" in ev),
+        "rescue_depth": preempted.depth,
+        "distinct_after_recover": res2.distinct_states,
+    }
+
+
+def scenario_corrupt_ckpt(tmp):
+    ORACLE = _oracle()
+    from tpuvsr.resilience import faults
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "corrupt-ck")
+    # every-level checkpoints; the level-3 write is crash-corrupted
+    # (frontier.npz truncated, the level-2 snapshot kept as .old)
+    faults.install("corrupt-ckpt:frontier.npz@level=3")
+    try:
+        res1 = stub_device_engine().run(max_depth=3,
+                                        checkpoint_path=ck)
+    finally:
+        faults.clear()
+    old_ok = os.path.isdir(ck + ".old")
+    res2 = stub_device_engine().run(resume_from=ck)
+    return {
+        "ok": (bool(res1.error) and old_ok and res2.ok
+               and res2.distinct_states == ORACLE["distinct"]
+               and res2.levels == ORACLE["levels"]),
+        "old_present": old_ok,
+        "distinct_after_recover": res2.distinct_states,
+    }
+
+
+def scenario_exchange_drop(tmp):
+    ORACLE = _oracle()
+    import jax
+    if len(jax.devices()) < 2:
+        return {"ok": True, "skipped": "needs 2 virtual devices"}
+    import numpy as np
+    from jax.sharding import Mesh
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.parallel.sharded_bfs import ShardedBFS
+    from tpuvsr.resilience import faults
+    from tpuvsr.testing import counter_spec, stub_model_factory
+    jp = os.path.join(tmp, "exchange.jsonl")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+    faults.install("exchange-drop@shard=0@level=2")
+    try:
+        eng = ShardedBFS(counter_spec(), mesh, tile=4, bucket_cap=64,
+                         next_capacity=1 << 6, fpset_capacity=1 << 8,
+                         model_factory=stub_model_factory())
+        res = eng.run(obs=RunObserver(journal_path=jp))
+    finally:
+        faults.clear()
+    events = read_journal(jp)
+    kinds = [e["event"] for e in events]
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"]
+               and "fault" in kinds and "retry" in kinds),
+        "distinct": res.distinct_states,
+    }
+
+
+SCENARIOS = [
+    ("oom-degrade", scenario_oom_degrade),
+    ("oom-paged-fallback", scenario_oom_paged_fallback),
+    ("kill-rescue", scenario_kill_rescue),
+    ("corrupt-ckpt", scenario_corrupt_ckpt),
+    ("exchange-drop", scenario_exchange_drop),
+]
+
+
+def main(argv=None):
+    only = (argv or [None])[0] if argv else None
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="tpuvsr-fault-matrix-")
+    try:
+        for name, fn in SCENARIOS:
+            if only and only not in name:
+                continue
+            sdir = os.path.join(tmp, name)
+            os.makedirs(sdir, exist_ok=True)
+            try:
+                out[name] = fn(sdir)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                out[name] = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    ok = all(v.get("ok") for v in out.values()) and bool(out)
+    print(json.dumps({"ok": ok, "scenarios": out}, indent=1,
+                     default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

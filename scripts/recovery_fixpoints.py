@@ -69,14 +69,25 @@ if os.path.exists(OUT):
         results = json.load(f)
 
 
-def run_single(spec, max_states=None):
+def _obs(key, engine):
+    """Per-job observer (ISSUE 3 satellite / ROADMAP follow-up): round
+    artifacts carry the journal + metrics trajectory of every pinning
+    run, not just its headline counts."""
+    from tpuvsr.obs import RunObserver
+    stem = os.path.join(REPO, "scripts",
+                        f"recovery_{key.lower()}_{engine}")
+    return RunObserver(journal_path=stem + ".jsonl",
+                       metrics_path=stem + "_metrics.json")
+
+
+def run_single(spec, max_states=None, key=""):
     eng = DeviceBFS(spec, tile_size=512)
-    res = eng.run(max_states=max_states,
+    res = eng.run(max_states=max_states, obs=_obs(key, "single"),
                   log=lambda m: print(f"  [single] {m}", flush=True))
     return res, eng.level_sizes
 
 
-def run_sharded(spec, max_states=None):
+def run_sharded(spec, max_states=None, key=""):
     import numpy as np
     import jax
     from jax.sharding import Mesh
@@ -84,7 +95,7 @@ def run_sharded(spec, max_states=None):
     mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
     eng = ShardedBFS(spec, mesh, tile=64, bucket_cap=4096,
                      next_capacity=1 << 15, fpset_capacity=1 << 17)
-    res = eng.run(max_states=max_states,
+    res = eng.run(max_states=max_states, obs=_obs(key, "sharded"),
                   log=lambda m: print(f"  [sharded] {m}", flush=True))
     return res, eng.level_sizes
 
@@ -105,7 +116,7 @@ for stem, cfg_text, engines, cap in JOBS:
         spec = load(stem, cfg_text, None)
         t0 = time.time()
         try:
-            res, levels = RUNNERS[engine](spec, max_states=cap)
+            res, levels = RUNNERS[engine](spec, max_states=cap, key=key)
         except Exception as e:  # noqa: BLE001
             entry[engine] = {"error": f"{type(e).__name__}: {e}"}
             results[key] = entry
@@ -123,6 +134,10 @@ for stem, cfg_text, engines, cap in JOBS:
             "violated": res.violated_invariant,
             "error": res.error,
             "level_sizes": levels,
+            "journal": f"scripts/recovery_{key.lower()}_{engine}.jsonl",
+            "metrics_file": (f"scripts/recovery_{key.lower()}_{engine}"
+                             f"_metrics.json"),
+            "phases": (res.metrics or {}).get("phases"),
         }
         results[key] = entry
         with open(OUT, "w") as f:

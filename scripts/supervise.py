@@ -1,0 +1,89 @@
+"""Restartable supervision wrapper around the tpuvsr CLI.
+
+Runs ``python -m tpuvsr SPEC [flags...] -supervise`` and, while the
+child exits with the resumable code (75 — a SIGTERM/SIGINT was turned
+into a rescue checkpoint at a level boundary), re-runs it with
+``-recover CKPT`` so a preempted multi-day run continues from the
+snapshot with cumulative elapsed and one continuous journal.  In-run
+OOM retry/degrade (tile halving -> paged fallback) happens INSIDE the
+child's supervisor; this wrapper only restarts across process deaths.
+
+Signals sent to the wrapper are forwarded to the child — a SIGTERM to
+the wrapper lets the child rescue-checkpoint, and the wrapper then
+exits 75 itself instead of restarting (the outer scheduler decides).
+
+Usage:
+    python scripts/supervise.py SPEC.tla [tpuvsr flags ...]
+                                [--max-restarts N]
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpuvsr.resilience.supervisor import EXIT_RESUMABLE  # noqa: E402
+
+
+def main(argv=None):
+    args = list(sys.argv[1:] if argv is None else argv)
+    max_restarts = 20
+    if "--max-restarts" in args:
+        i = args.index("--max-restarts")
+        try:
+            max_restarts = int(args[i + 1])
+        except (IndexError, ValueError):
+            print("supervise: --max-restarts needs an integer",
+                  file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    if not args or args[0].startswith("-"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    if "-supervise" not in args:
+        args.append("-supervise")
+    if "-checkpointdir" in args:
+        ckpt = args[args.index("-checkpointdir") + 1]
+    else:
+        ckpt = os.path.splitext(args[0])[0] + ".ckpt"
+
+    state = {"child": None, "forwarded": False}
+
+    def forward(signum, frame):
+        state["forwarded"] = True
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, forward)
+
+    cmd = [sys.executable, "-m", "tpuvsr"] + args
+    restarts = 0
+    while True:
+        state["child"] = subprocess.Popen(cmd, cwd=REPO)
+        rc = state["child"].wait()
+        if rc != EXIT_RESUMABLE or state["forwarded"]:
+            return rc
+        restarts += 1
+        if restarts > max_restarts:
+            print(f"supervise: giving up after {max_restarts} "
+                  f"restarts (snapshot remains at {ckpt})",
+                  file=sys.stderr)
+            return rc
+        print(f"supervise: restart {restarts}/{max_restarts}: "
+              f"resuming from {ckpt}", file=sys.stderr)
+        # always resume from the supervised run's OWN checkpoint dir —
+        # a launch-time "-recover OLDDIR" must not pin every restart to
+        # the stale original snapshot
+        if "-recover" in cmd:
+            cmd[cmd.index("-recover") + 1] = ckpt
+        else:
+            cmd = cmd + ["-recover", ckpt]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

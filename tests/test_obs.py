@@ -30,36 +30,9 @@ from tpuvsr.frontend.cfg import parse_cfg_text
 from tpuvsr.frontend.parser import parse_module_text
 from tpuvsr.obs import (Metrics, RunObserver, read_journal,
                         validate_journal_line, validate_metrics)
-
-COUNTER = """---- MODULE ObsCounter ----
-EXTENDS Naturals
-CONSTANTS Limit
-VARIABLES x, y
-
-Init == x = 0 /\\ y = 0
-
-IncX ==
-    /\\ x < Limit
-    /\\ x' = x + 1
-    /\\ UNCHANGED y
-
-IncY ==
-    /\\ y < Limit
-    /\\ y' = y + 1
-    /\\ UNCHANGED x
-
-Next == IncX \\/ IncY
-
-Bound == x + y <= 2 * Limit
-====
-"""
-COUNTER_CFG = ("CONSTANTS\n    Limit = 3\n"
-               "INIT Init\nNEXT Next\nINVARIANT Bound\n")
-
-
-def counter_spec():
-    return SpecModel(parse_module_text(COUNTER),
-                     parse_cfg_text(COUNTER_CFG))
+# the inline counter spec + stub device kernel live in tpuvsr.testing
+# (shared with tests/test_resilience.py and scripts/fault_matrix.py)
+from tpuvsr.testing import COUNTER, COUNTER_CFG, counter_spec
 
 
 # ---------------------------------------------------------------------
@@ -288,97 +261,12 @@ def test_cli_metrics_journal_flags(tmp_path):
 # device engines driven through a stub kernel (no reference needed):
 # exercises the REAL DeviceBFS/PagedBFS loops — dispatch accounting,
 # journal events, checkpoint/recover continuity — on the inline
-# counter spec via the model_factory hook
+# counter spec via the model_factory hook (stubs: tpuvsr/testing.py)
 # ---------------------------------------------------------------------
 import numpy as np
 
-
-def _stub_factory(limit=3):
-    import jax
-    import jax.numpy as jnp
-
-    class _Shape:
-        MAX_MSGS = 4
-
-    class StubCodec:
-        MSG_KEYS = ()
-
-        def __init__(self):
-            self.shape = _Shape()
-
-        def zero_state(self):
-            # "status" is the plane the level kernel sizes buffers by
-            return {"status": 0, "x": 0, "y": 0, "err": 0}
-
-        def encode(self, st):
-            return {"status": np.int32(0), "x": np.int32(st["x"]),
-                    "y": np.int32(st["y"]), "err": np.int32(0)}
-
-        def decode(self, d):
-            return {"x": int(np.asarray(d["x"])),
-                    "y": int(np.asarray(d["y"]))}
-
-        def pad_msgs(self, batch, old):
-            return batch
-
-    class StubKern:
-        action_names = ["IncX", "IncY"]
-        n_lanes = 2
-
-        def _lane_count(self, name):
-            return 1
-
-        def _guard_fns(self):
-            return [lambda st, ln: st["x"] < limit,
-                    lambda st, ln: st["y"] < limit]
-
-        def _action_fns(self):
-            def incx(st, ln):
-                succ = {"status": st["status"], "x": st["x"] + 1,
-                        "y": st["y"], "err": jnp.int32(0)}
-                return succ, st["x"] < limit
-
-            def incy(st, ln):
-                succ = {"status": st["status"], "x": st["x"],
-                        "y": st["y"] + 1, "err": jnp.int32(0)}
-                return succ, st["y"] < limit
-            return [incx, incy]
-
-        lane_action = np.array([0, 1], np.int32)
-        lane_param = np.array([0, 0], np.int32)
-
-        def step_all(self, st):
-            succs, ens = [], []
-            for f in self._action_fns():
-                s, e = f(st, jnp.int32(0))
-                succs.append(s)
-                ens.append(e)
-            return ({k: jnp.stack([s[k] for s in succs])
-                     for k in succs[0]}, jnp.stack(ens))
-
-        def fingerprint(self, st):
-            x = jnp.uint32(st["x"])
-            y = jnp.uint32(st["y"])
-            return jnp.stack([x * jnp.uint32(7) + y + jnp.uint32(1),
-                              x + jnp.uint32(1), y + jnp.uint32(1),
-                              jnp.uint32(99)])
-
-        def fingerprint_batch(self, batch):
-            arr = {k: jnp.asarray(v) for k, v in batch.items()}
-            return jax.vmap(self.fingerprint)(arr)
-
-        def invariant_fn(self, names):
-            return lambda st: jnp.asarray(True)
-
-    return lambda spec, max_msgs=None: (StubCodec(), StubKern())
-
-
-def _stub_device_engine(cls=None, **kw):
-    from tpuvsr.engine.device_bfs import DeviceBFS
-    cls = cls or DeviceBFS
-    return cls(counter_spec(), model_factory=_stub_factory(),
-               hash_mode="full", tile_size=4, fpset_capacity=1 << 8,
-               next_capacity=1 << 6, **kw)
+from tpuvsr.testing import stub_device_engine as _stub_device_engine
+from tpuvsr.testing import stub_model_factory as _stub_factory
 
 
 def test_stub_device_bfs_journal_metrics(tmp_path):

@@ -1,0 +1,452 @@
+"""Resilience layer tests (ISSUE 3): fault injection, supervised
+retry/degrade, preemption-safe checkpoints, checkpoint hardening.
+
+Everything here runs tier-1 — no reference mount, no TPU: the real
+Device/Paged/Sharded engine loops are driven by the stub kernel
+(tpuvsr/testing.py) and failures are injected deterministically
+through tpuvsr/resilience/faults.py.
+
+Acceptance (ISSUE 3):
+* a SIGTERM'd supervised run writes a rescue snapshot at the next
+  level boundary, raises Preempted (CLI exit 75), and ``-recover``
+  from that snapshot reproduces the uninterrupted run's fp_count and
+  level_sizes exactly;
+* an injected OOM at a mid level degrades (tile halving -> paged
+  fallback) instead of aborting, with the fault/retry/degrade
+  sequence visible in the journal.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpuvsr.core.values import TLAError
+from tpuvsr.engine.checkpoint import (CheckpointCorrupt, PAYLOADS,
+                                      load_checkpoint)
+from tpuvsr.obs import RunObserver, read_journal, validate_journal_line
+from tpuvsr.resilience import faults
+from tpuvsr.resilience.faults import (FaultPlan, InjectedOOM,
+                                      parse_fault)
+from tpuvsr.resilience.supervisor import (EXIT_RESUMABLE, Preempted,
+                                          PreemptionGuard, Supervisor,
+                                          clear_preemption, is_oom,
+                                          preempt_signal)
+from tpuvsr.testing import (STUB_DISTINCT as ORACLE_DISTINCT,
+                            STUB_LEVELS as ORACLE_LEVELS,
+                            counter_spec, stub_device_engine,
+                            stub_engine_factory as _stub_factory_for,
+                            stub_model_factory)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    faults.clear()
+    clear_preemption()
+
+
+# ---------------------------------------------------------------------
+# fault spec grammar
+# ---------------------------------------------------------------------
+def test_fault_spec_grammar():
+    plan = FaultPlan.parse(
+        "oom@level=3, kill@level=5,"
+        "corrupt-ckpt:frontier.npz@level=2;exchange-drop@shard=1")
+    kinds = [f.kind for f in plan.faults]
+    assert kinds == ["oom", "kill", "corrupt-ckpt", "exchange-drop"]
+    assert plan.faults[0].site == "level" and plan.faults[0].level == 3
+    assert plan.faults[2].payload == "frontier.npz"
+    assert plan.faults[2].level == 2
+    assert plan.faults[3].site == "exchange"
+    assert plan.faults[3].shard == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@level=1",              # unknown kind
+    "oom@when=3",                   # unknown parameter
+    "corrupt-ckpt",                 # missing payload
+    "oom@level=x",                  # non-integer
+])
+def test_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_fault(bad)
+
+
+def test_faults_are_one_shot():
+    plan = FaultPlan.parse("oom@level=3")
+    with pytest.raises(InjectedOOM):
+        plan.fire("level", depth=3)
+    assert plan.fire("level", depth=3) is None      # consumed
+    assert not plan.pending()
+
+
+def test_level_pinned_fault_only_fires_at_its_level():
+    plan = FaultPlan.parse("oom@level=3")
+    assert plan.fire("level", depth=2) is None
+    assert plan.fire("checkpoint", depth=3) is None  # wrong site
+    with pytest.raises(InjectedOOM):
+        plan.fire("level", depth=3)
+
+
+def test_env_var_arms_a_plan(monkeypatch):
+    faults.clear()
+    monkeypatch.setenv("TPUVSR_FAULT", "oom@level=7")
+    plan = faults.active()
+    assert plan is not None and plan.faults[0].level == 7
+    faults.clear()
+    monkeypatch.delenv("TPUVSR_FAULT")
+    assert faults.active() is None
+
+
+def test_is_oom_classification():
+    assert is_oom(InjectedOOM("RESOURCE_EXHAUSTED: injected"))
+    assert is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"))
+    assert is_oom(MemoryError())
+    assert not is_oom(ValueError("nope"))
+
+
+def test_new_journal_events_validate():
+    base = {"ts": 0.0, "run_id": "r", "elapsed_s": 1.0}
+    validate_journal_line(dict(base, event="fault", what="oom",
+                               site="level"))
+    validate_journal_line(dict(base, event="retry", attempt=1,
+                               backoff_s=0.5))
+    validate_journal_line(dict(base, event="degrade", what="tile",
+                               **{"from": 128, "to": 64}))
+    validate_journal_line(dict(base, event="rescue_checkpoint",
+                               path="x", depth=3, distinct=9,
+                               signal="SIGTERM"))
+    with pytest.raises(ValueError):
+        validate_journal_line(dict(base, event="fault", what="oom"))
+
+
+# ---------------------------------------------------------------------
+# checkpoint hardening: CRCs recorded, corruption matrix, .old fallback
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def snapshot(tmp_path_factory):
+    """A depth-3 stub-engine snapshot (written with every-level
+    cadence) plus its pristine load."""
+    ck = str(tmp_path_factory.mktemp("resil") / "snap")
+    res = stub_device_engine().run(max_depth=3, checkpoint_path=ck)
+    assert res.error                       # depth-limited
+    pristine = load_checkpoint(ck)
+    return ck, pristine
+
+
+def _copy_snapshot(snapshot, tmp_path, with_old=False):
+    ck, _ = snapshot
+    dst = str(tmp_path / "snap")
+    shutil.copytree(ck, dst)
+    if with_old:
+        shutil.copytree(ck, dst + ".old")
+    return dst
+
+
+def test_manifest_records_payload_crcs(snapshot):
+    ck, pristine = snapshot
+    with open(os.path.join(ck, "manifest.json")) as f:
+        manifest = json.load(f)
+    crcs = manifest["payload_crc32"]
+    assert set(crcs) == set(PAYLOADS)
+    assert all(isinstance(v, int) for v in crcs.values())
+    assert pristine["depth"] == 3
+    assert pristine["restored_from"] == ck
+
+
+def _truncate(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+
+
+def _rewrite_valid_npz(path):
+    # a perfectly loadable npz with the WRONG content: only the CRC
+    # check can catch this one
+    np.savez_compressed(path, slots=np.zeros((4, 5), np.uint32))
+
+
+CORRUPTIONS = [
+    ("truncated-npz", lambda d: _truncate(
+        os.path.join(d, "frontier.npz"))),
+    ("bad-crc-loadable-npz", lambda d: _rewrite_valid_npz(
+        os.path.join(d, "fpset.npz"))),
+    ("missing-payload", lambda d: os.remove(
+        os.path.join(d, "trace.npz"))),
+    ("garbage-manifest", lambda d: open(
+        os.path.join(d, "manifest.json"), "w").write("{not json")),
+]
+
+
+@pytest.mark.parametrize("name,corrupt", CORRUPTIONS,
+                         ids=[c[0] for c in CORRUPTIONS])
+def test_corruption_falls_back_to_old(snapshot, tmp_path, name,
+                                      corrupt):
+    dst = _copy_snapshot(snapshot, tmp_path, with_old=True)
+    corrupt(dst)
+    logs = []
+    ck = load_checkpoint(dst, log=logs.append)
+    assert ck["restored_from"] == dst + ".old"
+    assert ck["fp_count"] == snapshot[1]["fp_count"]
+    assert ck["level_sizes"] == snapshot[1]["level_sizes"]
+    assert logs and "falling back" in logs[0]
+
+
+@pytest.mark.parametrize("name,corrupt", CORRUPTIONS,
+                         ids=[c[0] for c in CORRUPTIONS])
+def test_corruption_without_old_raises_clearly(snapshot, tmp_path,
+                                               name, corrupt):
+    dst = _copy_snapshot(snapshot, tmp_path)
+    corrupt(dst)
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(dst)
+
+
+def test_stale_old_is_not_preferred(snapshot, tmp_path):
+    # primary intact, .old corrupted: the primary must load
+    dst = _copy_snapshot(snapshot, tmp_path, with_old=True)
+    _truncate(os.path.join(dst + ".old", "frontier.npz"))
+    ck = load_checkpoint(dst)
+    assert ck["restored_from"] == dst
+    assert ck["fp_count"] == snapshot[1]["fp_count"]
+
+
+def test_digest_mismatch_never_falls_back(snapshot, tmp_path):
+    # policy errors must not be masked by the .old fallback
+    dst = _copy_snapshot(snapshot, tmp_path, with_old=True)
+    with pytest.raises(ValueError, match="different spec"):
+        load_checkpoint(dst, expect_digest="0123456789abcdef")
+
+
+def test_bad_crc_recovers_through_engine_resume(snapshot, tmp_path):
+    """The seed bug this hardening fixes: a corrupt payload with an
+    intact manifest used to raise deep inside np.load on -recover;
+    now the engine resumes from .old and still reaches the exact
+    fixpoint."""
+    dst = _copy_snapshot(snapshot, tmp_path, with_old=True)
+    _truncate(os.path.join(dst, "fpset.npz"))
+    res = stub_device_engine().run(resume_from=dst)
+    assert res.ok and res.distinct_states == ORACLE_DISTINCT
+    assert res.levels == ORACLE_LEVELS
+
+
+# ---------------------------------------------------------------------
+# preemption: SIGTERM -> rescue checkpoint -> resumable -> equivalence
+# ---------------------------------------------------------------------
+def test_preemption_guard_flag_and_restore():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard():
+        assert preempt_signal() is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preempt_signal() == "SIGTERM"
+    assert preempt_signal() is None
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_sigterm_rescue_and_recover_equivalence(tmp_path):
+    """ISSUE 3 acceptance: kill -TERM of a supervised checkpointed run
+    exits resumable (Preempted -> CLI exit 75) having written a rescue
+    snapshot at the next level boundary, and -recover reproduces the
+    uninterrupted run's fp_count and level_sizes exactly."""
+    assert EXIT_RESUMABLE == 75
+    spec = counter_spec()
+    ck = str(tmp_path / "ck")
+    jp = str(tmp_path / "run.jsonl")
+    faults.install("kill@level=3")      # SIGTERM mid-run, via injection
+    sup = Supervisor(spec, checkpoint_path=ck, journal_path=jp,
+                     engine_factory=_stub_factory_for(spec),
+                     tile_size=4)
+    with pytest.raises(Preempted) as pi:
+        sup.run()
+    p = pi.value
+    assert p.path == ck and p.depth == 3 and p.signal == "SIGTERM"
+    assert os.path.isdir(ck)
+
+    # the resume (-recover) continues the same journal
+    res2 = stub_device_engine().run(
+        resume_from=ck, obs=RunObserver(journal_path=jp))
+    oracle = stub_device_engine().run()
+    assert res2.ok
+    assert res2.distinct_states == oracle.distinct_states \
+        == ORACLE_DISTINCT
+    assert res2.levels == oracle.levels == ORACLE_LEVELS
+
+    events = read_journal(jp)
+    kinds = [e["event"] for e in events]
+    assert "fault" in kinds and "rescue_checkpoint" in kinds
+    rescue = next(e for e in events
+                  if e["event"] == "rescue_checkpoint")
+    assert rescue["signal"] == "SIGTERM" and rescue["depth"] == 3
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert [s["resumed"] for s in starts] == [False, True]
+    # cumulative elapsed across the rescue/recover seam
+    ends = [e for e in events if e["event"] == "run_end"]
+    assert ends and ends[-1]["distinct"] == ORACLE_DISTINCT
+
+
+# ---------------------------------------------------------------------
+# OOM: degrade ladder + journal visibility
+# ---------------------------------------------------------------------
+def test_oom_mid_level_degrades_and_journals(tmp_path):
+    """ISSUE 3 acceptance: an injected OOM at a mid level degrades
+    (tile halving) instead of aborting, resumes from the snapshot, and
+    the fault -> degrade -> retry sequence is visible in the journal."""
+    spec = counter_spec()
+    jp = str(tmp_path / "oom.jsonl")
+    faults.install("oom@level=3")
+    sup = Supervisor(spec, checkpoint_path=str(tmp_path / "ck"),
+                     journal_path=jp,
+                     engine_factory=_stub_factory_for(spec),
+                     tile_size=4, min_tile=2, backoff_base=0.0,
+                     sleep=lambda s: None)
+    res = sup.run()
+    assert res.ok and res.distinct_states == ORACLE_DISTINCT
+    assert res.levels == ORACLE_LEVELS
+    assert sup.attempts == 2
+    assert sup.degrades == [("tile", 4, 2)]
+    kinds = [e["event"] for e in read_journal(jp)]
+    assert kinds.index("fault") < kinds.index("degrade") \
+        < kinds.index("retry")
+    # the resumed attempt announces itself
+    events = read_journal(jp)
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert [s["resumed"] for s in starts] == [False, True]
+
+
+def test_oom_ladder_falls_back_to_paged(tmp_path):
+    spec = counter_spec()
+    jp = str(tmp_path / "paged.jsonl")
+    faults.install("oom@level=2,oom@level=4")
+    sup = Supervisor(spec, checkpoint_path=str(tmp_path / "ck"),
+                     journal_path=jp,
+                     engine_factory=_stub_factory_for(spec),
+                     tile_size=4, min_tile=4,     # floor: no halving room
+                     backoff_base=0.0, sleep=lambda s: None)
+    res = sup.run()
+    assert res.ok and res.distinct_states == ORACLE_DISTINCT
+    assert res.levels == ORACLE_LEVELS
+    assert sup.kind == "paged"
+    assert ("engine", "device", "paged") in sup.degrades
+    degr = [e for e in read_journal(jp) if e["event"] == "degrade"]
+    assert {"what": "engine", "from": "device", "to": "paged"}.items() \
+        <= degr[0].items()
+
+
+def test_non_oom_errors_propagate_unretried(tmp_path):
+    spec = counter_spec()
+    calls = []
+
+    def factory(kind, tile):
+        calls.append((kind, tile))
+
+        class Boom:
+            def run(self, **kw):
+                raise TLAError("not an OOM")
+        return Boom()
+
+    sup = Supervisor(spec, engine_factory=factory, tile_size=4,
+                     sleep=lambda s: None)
+    with pytest.raises(TLAError, match="not an OOM"):
+        sup.run()
+    assert len(calls) == 1              # no retry ladder for real bugs
+
+
+def test_oom_retries_are_bounded(tmp_path):
+    spec = counter_spec()
+
+    def factory(kind, tile):
+        class AlwaysOOM:
+            def run(self, **kw):
+                raise InjectedOOM("RESOURCE_EXHAUSTED: forever")
+        return AlwaysOOM()
+
+    sup = Supervisor(spec, engine_factory=factory, tile_size=4,
+                     max_retries=3, backoff_base=0.0,
+                     sleep=lambda s: None)
+    with pytest.raises(InjectedOOM):
+        sup.run()
+    assert sup.attempts == 4            # initial + 3 retries
+
+
+# ---------------------------------------------------------------------
+# sharded resume validation (satellite)
+# ---------------------------------------------------------------------
+def _sharded_engine(mesh):
+    from tpuvsr.parallel.sharded_bfs import ShardedBFS
+    return ShardedBFS(counter_spec(), mesh, tile=4, bucket_cap=64,
+                      next_capacity=1 << 6, fpset_capacity=1 << 8,
+                      model_factory=stub_model_factory())
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 4,
+                    reason="needs 4 virtual devices")
+def test_sharded_recover_rejects_mismatched_shard_layout(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+    ck = str(tmp_path / "shard-ck")
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("d",))
+    r1 = _sharded_engine(mesh2).run(max_depth=3, checkpoint_path=ck)
+    assert r1.error                     # depth-limited
+    pristine = str(tmp_path / "pristine")
+    shutil.copytree(ck, pristine)
+
+    # (a) same mesh, tampered per-shard counts: clear TLAError instead
+    # of an index error in the frontier re-scatter
+    mf_path = os.path.join(ck, "manifest.json")
+    with open(mf_path) as f:
+        mf = json.load(f)
+    mf["extra"]["shard_counts"][0] += 2
+    with open(mf_path, "w") as f:
+        json.dump(mf, f)
+    with pytest.raises(TLAError, match="shard layout"):
+        _sharded_engine(mesh2).run(resume_from=ck)
+
+    # (b) a 4-shard mesh refusing the pristine 2-shard snapshot
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
+    with pytest.raises(TLAError, match="this mesh has 4"):
+        _sharded_engine(mesh4).run(resume_from=pristine)
+
+
+# ---------------------------------------------------------------------
+# the full injection matrix (scripts/fault_matrix.py) under tier-1
+# ---------------------------------------------------------------------
+def test_fault_matrix_smoke(capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import fault_matrix
+    assert fault_matrix.main([]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and len(out["scenarios"]) == 5
+
+
+# ---------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------
+def _cli(args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpuvsr"] + args,
+        capture_output=True, text=True, timeout=120,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))),
+             "HOME": "/root"})
+
+
+@pytest.mark.parametrize("bad", [
+    ["-supervise", "-fused"],
+    ["-supervise", "-simulate"],
+    ["-supervise", "-engine", "interp"],
+    ["-supervise", "-fpset", "host"],
+    ["-inject", "explode@level=1"],
+], ids=["fused", "simulate", "interp", "host-fpset", "bad-inject"])
+def test_cli_supervise_and_inject_flag_validation(bad):
+    r = _cli(["X.tla"] + bad)
+    assert r.returncode == 2, r.stderr
+    assert "usage" in r.stderr or "error" in r.stderr

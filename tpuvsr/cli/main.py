@@ -48,17 +48,40 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    FILE as JSON, and render a final stats table on
                    stderr (schema: tpuvsr/obs/SCHEMA.md)
   -journal FILE    append a JSONL run journal (run_start/level_done/
-                   checkpoint/spill/grow/violation/run_end events) to
-                   FILE; a -recover resume pointed at the same FILE
-                   continues the same journal with cumulative elapsed
+                   checkpoint/spill/grow/violation/run_end plus the
+                   resilience events fault/retry/degrade/
+                   rescue_checkpoint) to FILE; a -recover resume
+                   pointed at the same FILE continues the same journal
+                   with cumulative elapsed
+  -supervise       run the BFS under the resilience supervisor
+                   (tpuvsr/resilience): RESOURCE_EXHAUSTED degrades
+                   (tile halving, then hbm -> paged fallback) with
+                   bounded exponential-backoff retries resuming from
+                   the latest snapshot, and SIGTERM/SIGINT checkpoint
+                   at the next level boundary and exit with the
+                   resumable code 75 (rerun with -recover, or drive
+                   the loop with scripts/supervise.py).  Device/paged
+                   BFS only; implies level-boundary checkpointing to
+                   -checkpointdir when -checkpoint is not given.
+  -inject SPEC     arm the deterministic fault-injection plan
+                   (tpuvsr/resilience/faults.py grammar, e.g.
+                   "oom@level=3,corrupt-ckpt:frontier.npz"); the
+                   TPUVSR_FAULT env var arms the same plan
 
 Environment: TPUVSR_PROFILE=DIR wraps the engine fixpoint loop in
 jax.profiler.trace(DIR) with per-level/per-phase TraceAnnotation
-spans (view with TensorBoard / Perfetto).
+spans (view with TensorBoard / Perfetto).  TPUVSR_FAULT=SPEC arms
+fault injection (same grammar as -inject).
 
 Mutually exclusive flags (argparse errors, exit code 2, before any
 spec is loaded): -fused with -checkpoint/-recover; -fpset host with
--engine device; -fpset hbm/paged with -engine interp.
+-engine device; -fpset hbm/paged with -engine interp; -supervise with
+-fused/-simulate/-engine interp/-fpset host.
+
+Exit codes: 0 ok; 1 speclint errors (-lint); 2 bad flags; 12 safety/
+temporal violation (TLC's code); 75 preempted-but-resumable (a
+-supervise run caught SIGTERM/SIGINT and wrote a rescue snapshot —
+rerun with -recover to continue).
 """
 
 from __future__ import annotations
@@ -115,6 +138,17 @@ def build_parser():
     p.add_argument("-journal", default=None, metavar="FILE",
                    help="append the JSONL run journal to FILE "
                         "(continues across -recover)")
+    p.add_argument("-supervise", action="store_true",
+                   help="run the BFS under the resilience supervisor: "
+                        "OOM degrades (tile halving -> paged fallback) "
+                        "with backoff retries; SIGTERM/SIGINT "
+                        "checkpoints at the next level boundary and "
+                        "exits with the resumable code 75")
+    p.add_argument("-inject", default=None, metavar="SPEC",
+                   help="arm deterministic fault injection (grammar: "
+                        "oom@level=N, kill@level=N, "
+                        "corrupt-ckpt:FILE[@level=N], "
+                        "exchange-drop@shard=S; comma-separated)")
     return p
 
 
@@ -131,6 +165,22 @@ def validate_args(parser, args):
                      "fingerprint set only exists in the interpreter)")
     if args.fpset in ("hbm", "paged") and args.engine == "interp":
         parser.error(f"-fpset {args.fpset} requires the device engine")
+    if args.supervise and args.fused:
+        parser.error("-supervise cannot be combined with -fused (the "
+                     "fused fixpoint never syncs at a level boundary "
+                     "to snapshot or degrade)")
+    if args.supervise and args.simulate:
+        parser.error("-supervise supervises BFS runs, not simulation")
+    if args.supervise and (args.engine == "interp"
+                           or args.fpset == "host"):
+        parser.error("-supervise needs the device/paged engine (the "
+                     "interpreter has no checkpoint/degrade ladder)")
+    if args.inject:
+        from ..resilience.faults import FaultPlan
+        try:
+            FaultPlan.parse(args.inject)
+        except ValueError as e:
+            parser.error(f"-inject: {e}")
 
 
 def _pick_engine(requested, fpset, spec):
@@ -161,6 +211,9 @@ def main(argv=None):
         os.environ["TPUVSR_COMPILED"] = "1"
     if args.lint == "off":
         os.environ["TPUVSR_LINT"] = "off"
+    if args.inject:
+        from ..resilience import faults
+        faults.install(args.inject)
     from ..engine.spec import load_spec
     from ..engine.trace import format_trace
     from ..platform_select import ensure_backend
@@ -176,10 +229,14 @@ def main(argv=None):
         return report.exit_code
 
     engine = _pick_engine(args.engine, args.fpset, spec)
-    t0 = time.time()
 
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
+
+    if args.supervise and engine == "interp":
+        log("-supervise needs the device/paged engine; this spec "
+            "resolved to the interpreter — running unsupervised")
+        args.supervise = False
 
     if engine in ("device", "paged"):
         backend = ensure_backend(log)
@@ -198,10 +255,12 @@ def main(argv=None):
         return 1
 
     # observability: one RunObserver rides the whole engine run —
-    # journal (JSONL event stream), metrics collector, profiler hooks
+    # journal (JSONL event stream), metrics collector, profiler hooks.
+    # Supervised runs get per-attempt observers from the supervisor
+    # instead (same journal file, fresh run_id per attempt).
     from ..obs import RunObserver
-    obs = RunObserver(journal_path=args.journal,
-                      metrics_path=args.metrics, log=log)
+    obs = None if args.supervise else RunObserver(
+        journal_path=args.journal, metrics_path=args.metrics, log=log)
 
     def summary_metrics(m):
         """The -json merge: collector output minus the per-level rows
@@ -236,46 +295,77 @@ def main(argv=None):
             from ..engine.paged_bfs import PagedBFS
             ckpt_dir = args.checkpointdir or (
                 os.path.splitext(args.spec)[0] + ".ckpt")
-            # temporal properties need the behavior graph: run the
-            # safety BFS through the paged engine with level retention
-            # so the device graph builder reuses the enumeration
-            # instead of re-running it
-            want_graph = bool(spec.temporal_props) and \
-                not spec.symmetry_perms
-            if want_graph:
-                eng = PagedBFS(spec, retain_levels=True)
-            else:
-                eng = (PagedBFS if engine == "paged" else DeviceBFS)(spec)
-            use_fused = (args.fused and isinstance(eng, DeviceBFS)
-                         and not isinstance(eng, PagedBFS))
-            if args.fused and not use_fused:
-                log("-fused needs the plain device engine (no temporal "
-                    "properties / -fpset paged); using chunked run")
-            if use_fused and (args.checkpoint or args.recover):
-                log("-fused excludes -checkpoint/-recover; "
-                    "using chunked run")
-                use_fused = False
-            if use_fused:
-                res = eng.run_fused(
-                    max_states=args.maxstates,
-                    max_seconds=args.maxseconds,
-                    check_deadlock=args.deadlock, log=log, obs=obs)
-            else:
-                res = eng.run(
-                    max_states=args.maxstates,
-                    max_seconds=args.maxseconds,
-                    check_deadlock=args.deadlock, log=log, obs=obs,
-                    checkpoint_path=(ckpt_dir if args.checkpoint or
-                                     args.recover else None),
-                    # checkpoint_every=None means "every level
-                    # boundary"; a resumed run without an explicit
-                    # -checkpoint gets TLC's default 30-minute cadence
-                    # instead of an unrequested full snapshot per level
+            if args.supervise:
+                # resilience supervisor: OOM retry/degrade ladder +
+                # SIGTERM/SIGINT -> rescue checkpoint + resumable exit
+                from ..resilience.supervisor import (EXIT_RESUMABLE,
+                                                     Preempted,
+                                                     Supervisor)
+                sup = Supervisor(
+                    spec, engine=engine,
+                    checkpoint_path=ckpt_dir,
+                    # no explicit -checkpoint: snapshot every level
+                    # boundary so a degrade/rescue never loses more
+                    # than the in-flight level
                     checkpoint_every=(args.checkpoint * 60.0
-                                      if args.checkpoint else
-                                      30 * 60.0 if args.recover
-                                      else None),
-                    resume_from=args.recover)
+                                      if args.checkpoint else None),
+                    journal_path=args.journal,
+                    metrics_path=args.metrics, log=log)
+                try:
+                    res = sup.run(max_states=args.maxstates,
+                                  max_seconds=args.maxseconds,
+                                  check_deadlock=args.deadlock,
+                                  resume_from=args.recover)
+                except Preempted as p:
+                    log(f"{p}; rerun with -recover {p.path} to "
+                        f"continue (exit {EXIT_RESUMABLE})")
+                    return EXIT_RESUMABLE
+                eng = sup.engine
+                log(f"supervised run done: {sup.summary()}")
+            else:
+                # temporal properties need the behavior graph: run the
+                # safety BFS through the paged engine with level
+                # retention so the device graph builder reuses the
+                # enumeration instead of re-running it
+                want_graph = bool(spec.temporal_props) and \
+                    not spec.symmetry_perms
+                if want_graph:
+                    eng = PagedBFS(spec, retain_levels=True)
+                else:
+                    eng = (PagedBFS if engine == "paged"
+                           else DeviceBFS)(spec)
+                use_fused = (args.fused and isinstance(eng, DeviceBFS)
+                             and not isinstance(eng, PagedBFS))
+                if args.fused and not use_fused:
+                    log("-fused needs the plain device engine (no "
+                        "temporal properties / -fpset paged); using "
+                        "chunked run")
+                if use_fused and (args.checkpoint or args.recover):
+                    log("-fused excludes -checkpoint/-recover; "
+                        "using chunked run")
+                    use_fused = False
+                if use_fused:
+                    res = eng.run_fused(
+                        max_states=args.maxstates,
+                        max_seconds=args.maxseconds,
+                        check_deadlock=args.deadlock, log=log, obs=obs)
+                else:
+                    res = eng.run(
+                        max_states=args.maxstates,
+                        max_seconds=args.maxseconds,
+                        check_deadlock=args.deadlock, log=log, obs=obs,
+                        checkpoint_path=(ckpt_dir if args.checkpoint or
+                                         args.recover else None),
+                        # checkpoint_every=None means "every level
+                        # boundary"; a resumed run without an explicit
+                        # -checkpoint gets TLC's default 30-minute
+                        # cadence instead of an unrequested full
+                        # snapshot per level
+                        checkpoint_every=(args.checkpoint * 60.0
+                                          if args.checkpoint else
+                                          30 * 60.0 if args.recover
+                                          else None),
+                        resume_from=args.recover)
         else:
             if args.checkpoint or args.recover:
                 log("checkpoint/recover is a device-engine feature; "
@@ -292,6 +382,8 @@ def main(argv=None):
                    "error": res.error,
                    "elapsed_s": round(res.elapsed, 3),
                    "metrics": summary_metrics(res.metrics)}
+        if args.supervise:
+            summary["supervisor"] = sup.summary()
         if res.ok and not res.error and spec.temporal_props:
             from ..engine.liveness import liveness_check
             log(f"checking temporal properties: "
@@ -305,7 +397,9 @@ def main(argv=None):
                 # run's blocks only cover post-resume levels, so the
                 # graph re-enumerates from scratch in that case.
                 from ..engine.device_liveness import DeviceGraph
-                if args.recover:
+                if args.recover or args.supervise:
+                    # resumed/supervised runs don't retain level
+                    # blocks; re-enumerate for the behavior graph
                     graph = DeviceGraph(spec, log=log)
                 else:
                     graph = DeviceGraph(spec, engine=eng, result=res,

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..core.values import TLAError
+from ..resilience.faults import fault_point
 from .spec import SpecModel
 from .trace import TraceEntry, reconstruct_trace
 
@@ -89,6 +90,7 @@ def bfs_check(spec: SpecModel, check_deadlock: bool = False,
 
         while frontier:
             depth += 1
+            fault_point("level", depth=depth, obs=obs)
             next_frontier = []
             with obs.annotate(f"level {depth}"):
                 for sid in frontier:

@@ -5,17 +5,24 @@ preemption, the analog of TLC's queue/FPSet checkpointing implied by
 the reference's 500 GB multi-day guidance (README:20).
 
 A checkpoint is one directory holding .npz payloads plus a JSON
-manifest, written atomically: the new snapshot is staged in a tmp dir,
-the previous checkpoint is renamed aside to ``<path>.old`` (rename is
-instant, unlike the rmtree of a multi-GB snapshot), the tmp dir is
-renamed into place, and only then is ``.old`` deleted — so a crash or
-preemption at any point leaves either the previous or the new snapshot
-loadable (``load_checkpoint`` falls back to ``.old``).  Level
-boundaries are the one clean point of the device engine: the
-next-frontier buffers are empty, so the snapshot is exactly (FPSet,
-frontier, trace pointers, counters).
+manifest, written atomically and durably: the payloads are staged in a
+tmp dir, fsynced (files, then the staged dir), the previous checkpoint
+is renamed aside to ``<path>.old`` (rename is instant, unlike the
+rmtree of a multi-GB snapshot), the tmp dir is renamed into place, the
+parent directory is fsynced so the renames survive power loss, and
+only then is ``.old`` deleted — so a crash or preemption at any point
+leaves either the previous or the new snapshot loadable.
 
-The manifest records a digest of the spec identity (module name,
+The manifest records a CRC32 per payload file; ``load_checkpoint``
+verifies them (plus np.load-ability and frontier row counts) and falls
+back to ``<path>.old`` on ANY payload-level corruption — a truncated
+``fpset.npz`` with an intact manifest recovers the previous snapshot
+instead of raising deep inside ``np.load`` (ISSUE 3 hardening).
+Policy errors (format version, spec-digest mismatch) never fall back:
+``.old`` would carry the same spec identity, and masking them behind a
+silent downgrade would resume the wrong model.
+
+The manifest also records a digest of the spec identity (module name,
 constants, invariants, view/symmetry) so ``-recover`` with a mismatched
 spec or .cfg is rejected instead of silently resuming with
 incompatible fingerprints (TLC likewise errors on recover mismatch).
@@ -27,10 +34,20 @@ import hashlib
 import json
 import os
 import shutil
+import zlib
 
 import numpy as np
 
 FORMAT_VERSION = 3
+
+#: the payload files of one snapshot directory, in write order
+PAYLOADS = ("fpset.npz", "frontier.npz", "trace.npz", "init.npz")
+
+
+class CheckpointCorrupt(ValueError):
+    """A snapshot failed integrity verification (unreadable manifest,
+    missing payload, CRC mismatch, undecodable npz, inconsistent row
+    counts).  ``load_checkpoint`` falls back to ``.old`` on this."""
 
 
 def spec_digest(spec) -> str:
@@ -53,15 +70,34 @@ def spec_digest(spec) -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
+def _crc32_file(path):
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_path(path):
+    """fsync a file or directory by path (directory fsync is what makes
+    a rename durable on POSIX)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
-                    elapsed, digest=None, extra=None):
-    """Write a complete engine snapshot to `path` (atomic).
+                    elapsed, digest=None, extra=None, obs=None):
+    """Write a complete engine snapshot to `path` (atomic + durable).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
     concatenated host trace-pointer arrays; `init_dense` is the dense
     encoding of the (deduped) initial states, in gid order."""
+    from ..resilience.faults import fault_point
     tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
@@ -77,6 +113,11 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         os.path.join(tmp, "init.npz"),
         **{k: np.stack([np.asarray(d[k]) for d in init_dense])
            for k in init_dense[0]})
+    # CRCs are computed over the INTENDED payload bytes, before the
+    # corrupt-ckpt fault hook below mangles anything — a fault-injected
+    # torn write is therefore CRC-detectable, like a real one
+    crcs = {name: _crc32_file(os.path.join(tmp, name))
+            for name in PAYLOADS}
     manifest = {
         "format": FORMAT_VERSION,
         "n_front": int(n_front),
@@ -89,20 +130,38 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         "expand_mults": [int(x) for x in expand_mults],
         "elapsed": float(elapsed),
         "spec_digest": digest,
+        "payload_crc32": crcs,
         # engine-specific payload (e.g. the sharded driver's per-shard
         # frontier counts and exchange capacities)
         "extra": extra,
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # fault hook: emulate a crash-corrupted write — truncate the named
+    # payload AND leave the previous snapshot as .old (the crash window
+    # between rename-into-place and .old cleanup)
+    corrupt = fault_point("checkpoint", depth=depth, path=path, obs=obs)
+    if corrupt:
+        victim = os.path.join(tmp, corrupt)
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    for name in PAYLOADS:
+        _fsync_path(os.path.join(tmp, name))
+    _fsync_path(tmp)
     old = path + ".old"
     if os.path.isdir(old):
         shutil.rmtree(old)
     if os.path.isdir(path):
         os.rename(path, old)
     os.rename(tmp, path)
-    if os.path.isdir(old):
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    _fsync_path(parent)
+    if os.path.isdir(old) and not corrupt:
         shutil.rmtree(old)
+        _fsync_path(parent)
 
 
 def prior_elapsed(path) -> float:
@@ -117,25 +176,23 @@ def prior_elapsed(path) -> float:
         return 0.0
 
 
-def load_checkpoint(path, expect_digest=None):
-    """Read a snapshot; returns a dict mirroring save_checkpoint.
-
-    Falls back to ``<path>.old`` when the primary is missing or
-    unreadable (a crash between the rename-aside and rename-into-place
-    of ``save_checkpoint``)."""
+def _read_snapshot(path, expect_digest):
+    """Read + verify one snapshot directory.  Raises CheckpointCorrupt
+    on any integrity failure (fallback-eligible) and plain ValueError
+    on policy mismatches (format version, spec digest — never masked
+    by the .old fallback)."""
+    mf = os.path.join(path, "manifest.json")
     try:
-        with open(os.path.join(path, "manifest.json")) as f:
+        with open(mf) as f:
             manifest = json.load(f)
-    except (OSError, ValueError):
-        old = path + ".old"
-        if not os.path.isdir(old):
-            raise
-        path = old
-        with open(os.path.join(path, "manifest.json")) as f:
-            manifest = json.load(f)
-    if manifest["format"] != FORMAT_VERSION:
+    except OSError as e:
+        raise CheckpointCorrupt(f"{mf}: unreadable manifest ({e})")
+    except ValueError as e:
+        raise CheckpointCorrupt(f"{mf}: manifest is not valid JSON "
+                                f"({e})")
+    if manifest.get("format") != FORMAT_VERSION:
         raise ValueError(
-            f"checkpoint format {manifest['format']} unsupported "
+            f"checkpoint format {manifest.get('format')} unsupported "
             f"(want {FORMAT_VERSION})")
     if expect_digest is not None and manifest.get("spec_digest") and \
             manifest["spec_digest"] != expect_digest:
@@ -143,16 +200,64 @@ def load_checkpoint(path, expect_digest=None):
             "checkpoint was written by a different spec/.cfg "
             f"(digest {manifest['spec_digest']}, this run "
             f"{expect_digest}); refusing to resume")
-    fp = np.load(os.path.join(path, "fpset.npz"))
-    fr = np.load(os.path.join(path, "frontier.npz"))
-    tr = np.load(os.path.join(path, "trace.npz"))
-    ini = np.load(os.path.join(path, "init.npz"))
+    crcs = manifest.get("payload_crc32") or {}
+    arrs = {}
+    for name in PAYLOADS:
+        p = os.path.join(path, name)
+        try:
+            want = crcs.get(name)
+            if want is not None and _crc32_file(p) != int(want):
+                raise CheckpointCorrupt(
+                    f"{p}: CRC32 mismatch (payload corrupted after "
+                    f"write)")
+            with np.load(p) as z:
+                arrs[name] = {k: z[k] for k in z.files}
+        except CheckpointCorrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — np.load raises a zoo
+            raise CheckpointCorrupt(
+                f"{p}: unreadable payload "
+                f"({type(e).__name__}: {e})")
+    n_front = int(manifest["n_front"])
+    for k, v in arrs["frontier.npz"].items():
+        if v.shape[0] != n_front:
+            raise CheckpointCorrupt(
+                f"{path}: frontier plane {k!r} has {v.shape[0]} rows, "
+                f"manifest says n_front={n_front}")
+    return manifest, arrs
+
+
+def load_checkpoint(path, expect_digest=None, log=None):
+    """Read a snapshot; returns a dict mirroring save_checkpoint.
+
+    Falls back to ``<path>.old`` when the primary is missing or fails
+    integrity verification at ANY level — absent/garbled manifest, bad
+    payload CRC, truncated/missing .npz, inconsistent frontier rows
+    (a crash anywhere inside ``save_checkpoint``'s write/rename
+    sequence).  The returned dict records which directory actually
+    loaded under ``restored_from``."""
+    used = path
+    try:
+        manifest, arrs = _read_snapshot(path, expect_digest)
+    except CheckpointCorrupt as e:
+        old = path + ".old"
+        if not os.path.isdir(old):
+            raise
+        if log:
+            log(f"checkpoint {path} unusable ({e}); "
+                f"falling back to {old}")
+        manifest, arrs = _read_snapshot(old, expect_digest)
+        used = old
+    fp = arrs["fpset.npz"]
+    fr = arrs["frontier.npz"]
+    tr = arrs["trace.npz"]
+    ini = arrs["init.npz"]
     n_init = manifest["n_init"]
-    init_dense = [{k: ini[k][i] for k in ini.files}
+    init_dense = [{k: ini[k][i] for k in ini}
                   for i in range(n_init)]
     return {
         "slots": fp["slots"],
-        "frontier": {k: fr[k] for k in fr.files},
+        "frontier": dict(fr),
         "n_front": manifest["n_front"],
         "h_parent": tr["parent"],
         "h_action": tr["action"],
@@ -166,4 +271,5 @@ def load_checkpoint(path, expect_digest=None):
         "expand_mults": manifest["expand_mults"],
         "elapsed": manifest["elapsed"],
         "extra": manifest.get("extra"),
+        "restored_from": used,
     }

@@ -51,6 +51,8 @@ from ..core.values import TLAError
 from ..models import registry
 from ..models.vsr import ERR_BAG_OVERFLOW
 from ..obs import RunObserver, closes_observer
+from ..resilience.faults import fault_point
+from ..resilience.supervisor import Preempted, preempt_signal
 from .bfs import CheckResult
 from .fpset import empty_table, grow, insert_batch, insert_core
 from .spec import SpecModel
@@ -571,7 +573,8 @@ class DeviceBFS:
             # --- resume from a level-boundary snapshot ----------------
             from .checkpoint import load_checkpoint, spec_digest
             ck = load_checkpoint(resume_from,
-                                 expect_digest=spec_digest(spec))
+                                 expect_digest=spec_digest(spec),
+                                 log=emit)
             if (ck.get("extra") or {}).get("sharded"):
                 raise TLAError("checkpoint was written by the sharded "
                                "engine; resume it there")
@@ -652,6 +655,7 @@ class DeviceBFS:
                 res.error = f"depth limit {max_depth} reached"
                 break
             depth += 1
+            fault_point("level", depth=depth, obs=obs)
             start_t = 0
             n_next = 0
             n_tiles = (n_front + self.tile - 1) // self.tile
@@ -785,8 +789,13 @@ class DeviceBFS:
             n_front = n_next
             if self.debug_checks and n_next:
                 self._debug_assert_widths(front, n_next, depth)
+            # a pending SIGTERM/SIGINT (supervisor's PreemptionGuard)
+            # forces a rescue snapshot at this boundary regardless of
+            # cadence; at fixpoint (n_next == 0) the run finishes anyway
+            rescue = preempt_signal() if n_next else None
             if checkpoint_path and n_next and (
-                    checkpoint_every is None
+                    rescue is not None
+                    or checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
                 with obs.timer("checkpoint"):
@@ -805,11 +814,18 @@ class DeviceBFS:
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
-                        digest=spec_digest(spec))
+                        digest=spec_digest(spec), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
+            if rescue is not None:
+                obs.rescue(checkpoint_path or "", depth, fp_count,
+                           rescue)
+                emit(f"preempted by {rescue}: rescue snapshot at depth "
+                     f"{depth} ({checkpoint_path}); exiting resumable")
+                raise Preempted(checkpoint_path, depth, fp_count,
+                                rescue)
             if stop:
                 res.error = stop
                 break

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 
 from ..core.values import TLAError
 from ..obs import RunObserver, closes_observer
+from ..resilience.faults import fault_point
+from ..resilience.supervisor import Preempted, preempt_signal
 from .bfs import CheckResult
 from .device_bfs import (DeviceBFS, I32, R_BAG_GROW, R_DEADLOCK,
                          R_EXPAND_GROW, R_FPSET_GROW, R_NEXT_GROW,
@@ -117,7 +119,8 @@ class PagedBFS(DeviceBFS):
         if resume_from is not None:
             from .checkpoint import load_checkpoint, spec_digest
             ck = load_checkpoint(resume_from,
-                                 expect_digest=spec_digest(spec))
+                                 expect_digest=spec_digest(spec),
+                                 log=emit)
             if (ck.get("extra") or {}).get("sharded"):
                 raise TLAError("checkpoint was written by the sharded "
                                "engine; resume it there")
@@ -181,6 +184,7 @@ class PagedBFS(DeviceBFS):
             if self.retain_levels:
                 self.level_blocks.append(host_front)
             depth += 1
+            fault_point("level", depth=depth, obs=obs)
             # per-level host accumulators for drained next states and
             # their (level-relative) trace pointers
             drained = []
@@ -379,8 +383,12 @@ class PagedBFS(DeviceBFS):
             if stop:
                 res.error = stop
                 break
+            # pending preemption forces a rescue snapshot at this
+            # boundary regardless of cadence (see device_bfs)
+            rescue = preempt_signal() if n_front else None
             if checkpoint_path and n_front and (
-                    checkpoint_every is None
+                    rescue is not None
+                    or checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
                 with obs.timer("checkpoint"):
@@ -398,11 +406,18 @@ class PagedBFS(DeviceBFS):
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
-                        digest=spec_digest(spec))
+                        digest=spec_digest(spec), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
+            if rescue is not None:
+                obs.rescue(checkpoint_path or "", depth, fp_count,
+                           rescue)
+                emit(f"preempted by {rescue}: rescue snapshot at depth "
+                     f"{depth} ({checkpoint_path}); exiting resumable")
+                raise Preempted(checkpoint_path, depth, fp_count,
+                                rescue)
             if n_front == 0:
                 break
             if max_states and fp_count >= max_states:

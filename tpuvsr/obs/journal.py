@@ -39,6 +39,13 @@ EVENT_REQUIRED = {
     "grow": ("what", "to", "elapsed_s"),
     "violation": ("kind", "name", "elapsed_s"),
     "run_end": ("ok", "elapsed_s"),
+    # resilience events (ISSUE 3): injected/real faults, supervised
+    # retry/degrade steps, and preemption rescue snapshots
+    "fault": ("what", "site", "elapsed_s"),
+    "retry": ("attempt", "backoff_s", "elapsed_s"),
+    "degrade": ("what", "from", "to", "elapsed_s"),
+    "rescue_checkpoint": ("path", "depth", "distinct", "signal",
+                          "elapsed_s"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

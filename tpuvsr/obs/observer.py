@@ -219,6 +219,36 @@ class RunObserver:
         self.journal.write("grow", what=what, to=int(to),
                            elapsed_s=round(self.elapsed(), 3))
 
+    # -- resilience events (ISSUE 3) -----------------------------------
+    def fault(self, what, site, **extra):
+        """An injected (or detected) fault, journaled BEFORE it acts so
+        the journal always records why a run died or degraded."""
+        self.count("faults")
+        self.count(f"fault_{what.replace('-', '_')}")
+        self.journal.write("fault", what=what, site=site,
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def retry(self, attempt, backoff_s, **extra):
+        self.count("retries")
+        self.journal.write("retry", attempt=int(attempt),
+                           backoff_s=round(float(backoff_s), 3),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def degrade(self, what, from_, to):
+        self.count("degrades")
+        self.journal.write("degrade", what=what,
+                           elapsed_s=round(self.elapsed(), 3),
+                           **{"from": from_, "to": to})
+
+    def rescue(self, path, depth, distinct, signal_name):
+        """A preemption rescue snapshot written at a level boundary
+        (the run exits with the resumable code right after)."""
+        self.count("rescue_checkpoints")
+        self.journal.write("rescue_checkpoint", path=str(path),
+                           depth=int(depth), distinct=int(distinct),
+                           signal=str(signal_name),
+                           elapsed_s=round(self.elapsed(), 3))
+
     # -- the one progress formatter (drift-proof across engines) -------
     def progress(self, depth=None, distinct=None, generated=None,
                  frontier=None, walks=None, steps=None, extra=None,

@@ -49,6 +49,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.fpset import dedup_batch, insert_core
 from ..obs import closes_observer
+from ..resilience.faults import InjectedExchangeDrop, fault_point
+from ..resilience.supervisor import Preempted, preempt_signal
 from .multihost import make_replicator, put_sharded
 
 U32 = jnp.uint32
@@ -485,7 +487,8 @@ class ShardedBFS:
             # --- resume from a level-boundary snapshot ----------------
             from ..engine.checkpoint import load_checkpoint, spec_digest
             ck = load_checkpoint(resume_from,
-                                 expect_digest=spec_digest(spec))
+                                 expect_digest=spec_digest(spec),
+                                 log=emit)
             ex = ck["extra"] or {}
             if not ex.get("sharded"):
                 raise TLAError("checkpoint was written by the "
@@ -494,6 +497,24 @@ class ShardedBFS:
                 raise TLAError(
                     f"checkpoint has {len(ex['shard_counts'])} FPSet "
                     f"shards, this mesh has {D}; refusing to resume")
+            # the per-shard counts drive the frontier re-scatter below:
+            # verify them against the actual snapshot arrays so a
+            # snapshot written under a different shard layout fails
+            # here with a clear message instead of an index error
+            _counts = [int(x) for x in ex["shard_counts"]]
+            if min(_counts, default=0) < 0 or \
+                    sum(_counts) != int(ck["n_front"]):
+                raise TLAError(
+                    f"checkpoint extra.shard_counts {_counts} (sum "
+                    f"{sum(_counts)}) does not match the manifest "
+                    f"frontier count {ck['n_front']}: snapshot was "
+                    f"written under a different shard layout; "
+                    f"refusing to resume")
+            if len(ex.get("dev_distinct", [])) != D:
+                raise TLAError(
+                    f"checkpoint extra.dev_distinct has "
+                    f"{len(ex.get('dev_distinct', []))} entries, this "
+                    f"mesh has {D} shards; refusing to resume")
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
                     ex["bucket_cap"] != self.bucket_cap:
                 self.bucket_cap = int(ex["bucket_cap"])
@@ -641,11 +662,28 @@ class ShardedBFS:
                 res.error = f"depth limit {max_depth} reached"
                 break
             depth += 1
+            fault_point("level", depth=depth, obs=obs)
             nb, nbp, nba, nbprm = self._alloc_frontier(self.N)
             nn = self._put(np.zeros(D, np.int32))
             start_t = self._put(np.zeros(D, np.int32))
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
+                # injected transient exchange failure: journal it and
+                # re-issue the level step — the pause/re-enter protocol
+                # makes the retry lossless (committed lanes just dedup).
+                # shard matching is per HOST process: single-process
+                # meshes drive every shard, so any armed shard fires
+                # (shard=None context matches all)
+                try:
+                    fault_point("exchange", depth=depth,
+                                shard=(jax.process_index()
+                                       if jax.process_count() > 1
+                                       else None), obs=obs)
+                except InjectedExchangeDrop:
+                    obs.retry(attempt=1, backoff_s=0.0, what="exchange")
+                    emit(f"exchange drop at level {depth}: "
+                         f"re-issuing the level step")
+                    continue
                 phase = "compile" if self._fresh_jit else "dispatch"
                 with obs.timer(phase), obs.annotate(
                         f"level {depth} {phase}"):
@@ -788,9 +826,15 @@ class ShardedBFS:
             F = self.N
             n_front = nn
 
-            if checkpoint_path and n_next and agree(
+            # pending preemption (supervisor's PreemptionGuard) forces
+            # a rescue snapshot at this boundary; the decision is
+            # rank-agreed like every wall-clock one (n_next is a global
+            # sum, so the agree() call pattern matches across ranks)
+            rescue = preempt_signal()
+            want_rescue = bool(n_next) and agree(rescue is not None)
+            if checkpoint_path and n_next and (want_rescue or agree(
                     checkpoint_every is None or
-                    _time.time() - last_checkpoint >= checkpoint_every):
+                    _time.time() - last_checkpoint >= checkpoint_every)):
                 from ..engine.checkpoint import (save_checkpoint,
                                                  spec_digest)
                 # the pulls are collectives in multi-process mode —
@@ -815,7 +859,7 @@ class ShardedBFS:
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=[],
                         elapsed=_time.time() - t0,
-                        digest=spec_digest(spec),
+                        digest=spec_digest(spec), obs=obs,
                         extra={"sharded": True,
                                "shard_counts": [int(x) for x in nn_h],
                                "bucket_cap": self.bucket_cap,
@@ -831,6 +875,13 @@ class ShardedBFS:
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
                      f"(depth {depth}, {fp_count} distinct)")
+            if want_rescue:
+                sig = rescue or "SIGTERM"
+                obs.rescue(checkpoint_path or "", depth, fp_count, sig)
+                emit(f"preempted by {sig}: rescue snapshot at depth "
+                     f"{depth} ({checkpoint_path}); exiting resumable")
+                _attach_exchange(res)
+                raise Preempted(checkpoint_path, depth, fp_count, sig)
 
             obs.progress(depth=depth, distinct=fp_count,
                          generated=res.states_generated)

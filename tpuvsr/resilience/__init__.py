@@ -1,0 +1,41 @@
+"""tpuvsr.resilience — survival machinery for long checking runs.
+
+Three pieces (ISSUE 3 tentpole):
+
+* **fault injection** (``faults.py``) — ``TPUVSR_FAULT`` / CLI
+  ``-inject`` specs (``oom@level=3``, ``kill@level=5``,
+  ``corrupt-ckpt:frontier.npz``, ``exchange-drop@shard=0``) fire
+  deterministically inside the real engine loops and the checkpoint
+  writer, so every recovery path below is tier-1-testable;
+* **supervised run loop** (``supervisor.py``) — catches
+  RESOURCE_EXHAUSTED, degrades (tile halving -> paged fallback) with
+  bounded exponential-backoff retries resuming from the latest
+  snapshot, and turns SIGTERM/SIGINT into checkpoint-at-next-level-
+  boundary + the resumable exit code ``EXIT_RESUMABLE`` (75);
+* **checkpoint hardening** lives in ``engine/checkpoint.py``
+  (per-payload CRC32, fsync around the rename dance, ``.old``
+  fallback on payload-level corruption) and is exercised through the
+  ``corrupt-ckpt`` fault.
+
+Every fault, retry, degrade and rescue checkpoint is journaled as a
+``tpuvsr-journal/1`` event (``fault`` / ``retry`` / ``degrade`` /
+``rescue_checkpoint`` — see ``tpuvsr/obs/SCHEMA.md``).
+"""
+
+from __future__ import annotations
+
+from .faults import (FaultPlan, InjectedExchangeDrop, InjectedFault,
+                     InjectedOOM, fault_point)
+from .faults import clear as clear_faults
+from .faults import install as install_faults
+from .supervisor import (DEFAULT_MIN_TILE, EXIT_RESUMABLE, Preempted,
+                         PreemptionGuard, Supervisor, clear_preemption,
+                         is_oom, preempt_signal, request_preemption)
+
+__all__ = [
+    "FaultPlan", "InjectedFault", "InjectedOOM", "InjectedExchangeDrop",
+    "fault_point", "install_faults", "clear_faults",
+    "Supervisor", "PreemptionGuard", "Preempted", "EXIT_RESUMABLE",
+    "DEFAULT_MIN_TILE", "is_oom", "preempt_signal",
+    "request_preemption", "clear_preemption",
+]

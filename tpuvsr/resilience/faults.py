@@ -1,0 +1,240 @@
+"""Deterministic fault injection for the checking engines (ISSUE 3).
+
+The reference workload is a multi-day, 500 GB TLC run; the survival
+machinery built around it (supervised retry/degrade, preemption-safe
+checkpoints, payload-level ``.old`` fallback) is exactly the code that
+never runs in a clean test.  This module makes every failure mode a
+one-line spec that fires at a deterministic point inside the REAL
+engine loops, so the recovery paths are tier-1-testable without a TPU,
+a preemption, or a real out-of-memory.
+
+Fault spec grammar (``TPUVSR_FAULT`` env var / CLI ``-inject``; entries
+comma-separated, parameters attached with ``@key=value``):
+
+    oom@level=3                    raise an injected RESOURCE_EXHAUSTED
+                                   at the start of BFS level 3
+    kill@level=5                   SIGTERM this process at the start of
+                                   level 5 (simulated preemption; with
+                                   the supervisor's PreemptionGuard the
+                                   run checkpoints at the next level
+                                   boundary and exits resumable)
+    corrupt-ckpt:frontier.npz      emulate a crash-corrupted snapshot
+                                   write: the named payload of the next
+                                   checkpoint is truncated and the
+                                   previous snapshot is left as ``.old``
+                                   (the crash window the fallback path
+                                   exists for); ``@level=N`` pins it to
+                                   the level-N snapshot
+    exchange-drop@shard=0          one transient exchange failure in the
+                                   sharded engine (journaled, step
+                                   re-issued); ``@level=N`` pins a
+                                   level.  ``shard`` selects the HOST
+                                   process in multi-process runs; a
+                                   single-process mesh drives every
+                                   shard, so any armed shard fires
+
+Each entry fires AT MOST ONCE (arm the same spec twice for a repeat).
+Faults are journaled as ``fault`` events through the run's observer
+before they act, so a journal always records *why* a run died or
+degraded.  With no plan installed every hook is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+
+# fault kind -> the engine hook site it fires at
+KIND_SITE = {
+    "oom": "level",
+    "kill": "level",
+    "corrupt-ckpt": "checkpoint",
+    "exchange-drop": "exchange",
+}
+
+_ENTRY_RE = re.compile(
+    r"^(?P<kind>[a-z][a-z-]*)"
+    r"(?::(?P<arg>[^@]+))?"
+    r"(?P<params>(?:@[a-z]+=[\w.]+)*)$")
+
+
+class InjectedFault(Exception):
+    """Base class for deterministically injected faults."""
+
+
+class InjectedOOM(InjectedFault):
+    """Mimics an XLA allocation failure; the message carries
+    RESOURCE_EXHAUSTED so ``supervisor.is_oom`` treats injected and
+    real OOMs identically."""
+
+
+class InjectedExchangeDrop(InjectedFault):
+    """One transient sharded-exchange failure (the step is re-issued
+    by the driver; the pause/re-enter protocol makes that safe)."""
+
+
+class Fault:
+    """One armed fault: kind + optional (level, shard, payload)."""
+
+    __slots__ = ("kind", "site", "level", "shard", "payload", "fired")
+
+    def __init__(self, kind, *, level=None, shard=None, payload=None):
+        if kind not in KIND_SITE:
+            raise ValueError(
+                f"unknown fault kind {kind!r} "
+                f"(want one of {sorted(KIND_SITE)})")
+        self.kind = kind
+        self.site = KIND_SITE[kind]
+        self.level = level
+        self.shard = shard
+        self.payload = payload
+        self.fired = False
+
+    def matches(self, site, depth=None, shard=None):
+        if self.fired or site != self.site:
+            return False
+        if self.level is not None and depth is not None \
+                and depth != self.level:
+            return False
+        if self.level is not None and depth is None:
+            return False
+        if self.shard is not None and shard is not None \
+                and shard != self.shard:
+            return False
+        return True
+
+    def __repr__(self):
+        parts = [self.kind]
+        if self.payload:
+            parts.append(f":{self.payload}")
+        for k in ("level", "shard"):
+            v = getattr(self, k)
+            if v is not None:
+                parts.append(f"@{k}={v}")
+        return "".join(parts)
+
+
+def parse_fault(entry):
+    m = _ENTRY_RE.match(entry.strip())
+    if not m:
+        raise ValueError(f"unparsable fault spec {entry!r} "
+                         f"(grammar: KIND[:ARG][@key=value ...])")
+    kind = m.group("kind")
+    kw = {}
+    for p in re.findall(r"@([a-z]+)=([\w.]+)", m.group("params") or ""):
+        key, val = p
+        if key not in ("level", "shard"):
+            raise ValueError(f"unknown fault parameter {key!r} "
+                             f"in {entry!r} (want level/shard)")
+        kw[key] = int(val)
+    if m.group("arg"):
+        kw["payload"] = m.group("arg")
+    if kind == "corrupt-ckpt" and "payload" not in kw:
+        raise ValueError(
+            f"{entry!r}: corrupt-ckpt needs a payload file name "
+            f"(e.g. corrupt-ckpt:frontier.npz)")
+    return Fault(kind, **kw)
+
+
+class FaultPlan:
+    """An ordered set of one-shot faults; ``fire`` consumes the first
+    match for a site."""
+
+    def __init__(self, faults):
+        self.faults = list(faults)
+
+    @classmethod
+    def parse(cls, text):
+        entries = [e for e in re.split(r"[,;]", text or "") if e.strip()]
+        return cls(parse_fault(e) for e in entries)
+
+    def pending(self):
+        return [f for f in self.faults if not f.fired]
+
+    def fire(self, site, *, depth=None, shard=None, obs=None, path=None):
+        """Fire the first unfired fault matching `site` (and the
+        optional depth/shard context).  Journals the fault through
+        `obs`, then acts:
+
+        * ``oom``            raises InjectedOOM
+        * ``kill``           SIGTERMs this process (a PreemptionGuard
+                             turns that into checkpoint-and-exit; with
+                             no handler installed the process dies —
+                             raw preemption)
+        * ``corrupt-ckpt``   returns the payload name for the caller
+                             (the checkpoint writer) to corrupt
+        * ``exchange-drop``  raises InjectedExchangeDrop
+
+        Returns None when nothing fired."""
+        for f in self.faults:
+            if not f.matches(site, depth=depth, shard=shard):
+                continue
+            f.fired = True
+            if obs is not None:
+                extra = {}
+                if depth is not None:
+                    extra["depth"] = int(depth)
+                if f.shard is not None:
+                    extra["shard"] = int(f.shard)
+                if f.payload is not None:
+                    extra["payload"] = f.payload
+                obs.fault(f.kind, site, **extra)
+            if f.kind == "oom":
+                raise InjectedOOM(
+                    f"RESOURCE_EXHAUSTED: injected out-of-memory at "
+                    f"level {depth} (fault {f!r})")
+            if f.kind == "kill":
+                os.kill(os.getpid(), signal.SIGTERM)
+                return f.kind
+            if f.kind == "corrupt-ckpt":
+                return f.payload
+            if f.kind == "exchange-drop":
+                raise InjectedExchangeDrop(
+                    f"injected exchange drop at level {depth} "
+                    f"(fault {f!r})")
+        return None
+
+
+# ---------------------------------------------------------------------
+# process-wide plan (engines call the module-level hook; tests and the
+# CLI -inject flag install a plan, TPUVSR_FAULT arms one lazily)
+# ---------------------------------------------------------------------
+_PLAN = None
+_ENV_ARMED = False
+
+
+def install(spec_or_plan):
+    """Install a fault plan for this process (a spec string or a
+    FaultPlan).  Returns the plan."""
+    global _PLAN, _ENV_ARMED
+    _PLAN = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
+             else FaultPlan.parse(spec_or_plan))
+    _ENV_ARMED = True          # an explicit plan overrides the env var
+    return _PLAN
+
+
+def clear():
+    global _PLAN, _ENV_ARMED
+    _PLAN = None
+    _ENV_ARMED = False
+
+
+def active():
+    """The installed plan, arming one from TPUVSR_FAULT on first use."""
+    global _PLAN, _ENV_ARMED
+    if _PLAN is None and not _ENV_ARMED:
+        env = os.environ.get("TPUVSR_FAULT")
+        if env:
+            _PLAN = FaultPlan.parse(env)
+        _ENV_ARMED = True      # parse the env var once per process
+    return _PLAN
+
+
+def fault_point(site, *, depth=None, shard=None, obs=None, path=None):
+    """Engine hook: no-op unless a plan with a matching unfired fault
+    is armed (see FaultPlan.fire for the per-kind behavior)."""
+    plan = active()
+    if plan is None:
+        return None
+    return plan.fire(site, depth=depth, shard=shard, obs=obs, path=path)

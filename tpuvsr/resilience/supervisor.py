@@ -1,0 +1,278 @@
+"""Supervised run loop: retry/degrade on OOM, preemption-safe exits.
+
+The survival machinery for multi-day checking runs (ISSUE 3 tentpole):
+
+* **OOM retry/degrade** — ``Supervisor.run`` catches XLA
+  ``RESOURCE_EXHAUSTED`` (and the injected ``faults.InjectedOOM``) and
+  degrades instead of dying: halve the expansion tile and retry with
+  exponential backoff (bounded attempts), resuming from the latest
+  level-boundary snapshot; once the tile floor is reached, fall back
+  from the HBM-resident device engine to the host-paged frontier
+  (``hbm -> paged``).  Every step is journaled (``fault`` / ``retry`` /
+  ``degrade`` events) so the journal shows *why* a run slowed.
+* **Preemption** — ``PreemptionGuard`` installs SIGTERM/SIGINT
+  handlers that request a checkpoint at the next level boundary; the
+  engines write the rescue snapshot, journal a ``rescue_checkpoint``
+  event, and raise ``Preempted``, which the CLI maps to the distinct
+  resumable exit code ``EXIT_RESUMABLE`` (75, BSD EX_TEMPFAIL).  A
+  second signal while a rescue is pending aborts immediately.
+* **Resume contract** — exit code 75 means "a resumable snapshot
+  exists at the checkpoint dir": rerun with ``-recover DIR`` (or let
+  ``scripts/supervise.py`` loop on the exit code) to continue the run
+  with cumulative elapsed and one continuous journal.
+
+The guard's pending flag is module state checked by the engines at
+level boundaries (``preempt_signal()``); without a guard installed the
+flag is never set and the checks are free.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from ..obs import Journal, RunObserver
+from .faults import InjectedFault, InjectedOOM
+
+#: exit code of a preempted-but-resumable supervised run (EX_TEMPFAIL:
+#: rerun with -recover to continue).  Distinct from 0 (ok), 12 (TLC
+#: safety violation), 1 (lint errors), 2 (bad flags).
+EXIT_RESUMABLE = 75
+
+#: smallest tile the degrade ladder will retry before falling back to
+#: the paged engine
+DEFAULT_MIN_TILE = 16
+
+
+class Preempted(RuntimeError):
+    """A run stopped at a level boundary because a PreemptionGuard
+    caught SIGTERM/SIGINT; a resumable snapshot was written."""
+
+    def __init__(self, path, depth, distinct, signal_name):
+        self.path = path
+        self.depth = int(depth)
+        self.distinct = int(distinct)
+        self.signal = signal_name
+        where = (f"resumable snapshot at {path}" if path else
+                 "NO snapshot was configured (-checkpoint/"
+                 "-checkpointdir) — a restart re-explores from the "
+                 "initial states")
+        super().__init__(
+            f"preempted by {signal_name} at level {depth} "
+            f"({distinct} distinct); {where}")
+
+
+# ---------------------------------------------------------------------
+# preemption flag (module state; engines poll at level boundaries)
+# ---------------------------------------------------------------------
+_PENDING = [None]
+
+
+def preempt_signal():
+    """Name of the pending preemption signal, or None."""
+    return _PENDING[0]
+
+
+def request_preemption(name="SIGTERM"):
+    _PENDING[0] = name
+
+
+def clear_preemption():
+    _PENDING[0] = None
+
+
+class PreemptionGuard:
+    """Context manager: SIGTERM/SIGINT -> checkpoint at the next level
+    boundary and exit resumable, instead of dying mid-level.  A second
+    signal while one is pending escalates to an immediate
+    KeyboardInterrupt (impatient-operator escape hatch).  Installing
+    handlers outside the main thread is a documented no-op."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, log=None):
+        self._log = log
+        self._old = {}
+
+    def _handler(self, signum, frame):
+        name = signal.Signals(signum).name
+        if preempt_signal() is not None:
+            raise KeyboardInterrupt(
+                f"second {name} while a rescue checkpoint was pending")
+        request_preemption(name)
+        if self._log:
+            self._log(f"{name} received: checkpointing at the next "
+                      f"level boundary, then exiting resumable "
+                      f"(exit {EXIT_RESUMABLE})")
+
+    def __enter__(self):
+        clear_preemption()
+        for sig in self.SIGNALS:
+            try:
+                self._old[sig] = signal.signal(sig, self._handler)
+            except ValueError:      # not the main thread
+                break
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        for sig, old in self._old.items():
+            signal.signal(sig, old)
+        self._old = {}
+        clear_preemption()
+        return False
+
+
+# ---------------------------------------------------------------------
+# OOM classification
+# ---------------------------------------------------------------------
+def is_oom(exc):
+    """True for allocation-failure exceptions worth a degrade/retry:
+    the injected OOM, XLA RESOURCE_EXHAUSTED, or a host MemoryError."""
+    if isinstance(exc, (InjectedOOM, MemoryError)):
+        return True
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg \
+        or "out of memory" in msg
+
+
+class Supervisor:
+    """Run a BFS engine to completion through the retry/degrade ladder.
+
+    ``engine_factory(kind, tile_size)`` builds a fresh engine per
+    attempt (kind is ``"device"`` or ``"paged"``); the default factory
+    builds DeviceBFS/PagedBFS on the supervisor's spec with
+    ``engine_kwargs``.  The ladder on OOM:
+
+        tile -> tile/2 -> ... -> min_tile -> paged engine -> plain retry
+
+    with exponential backoff between attempts and auto-resume from the
+    supervisor's checkpoint dir whenever a snapshot exists.  Violations,
+    deadlocks and non-OOM errors propagate unchanged; ``Preempted``
+    propagates for the caller to map to EXIT_RESUMABLE."""
+
+    def __init__(self, spec, engine="device", *, checkpoint_path=None,
+                 checkpoint_every=None, journal_path=None,
+                 metrics_path=None, log=None, tile_size=128,
+                 min_tile=DEFAULT_MIN_TILE, max_retries=6,
+                 backoff_base=0.5, backoff_cap=30.0,
+                 engine_kwargs=None, engine_factory=None,
+                 sleep=time.sleep):
+        if engine not in ("device", "paged"):
+            raise ValueError(f"Supervisor supervises the device/paged "
+                             f"engines, not {engine!r}")
+        self.spec = spec
+        self.kind = engine
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.journal_path = journal_path
+        self.metrics_path = metrics_path
+        self.tile = int(tile_size)
+        self.min_tile = int(min_tile)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._factory = engine_factory
+        self._sleep = sleep
+        self._log = log
+        self.engine = None          # last engine instance (CLI liveness)
+        self.attempts = 0           # engine runs started
+        self.degrades = []          # [(what, from, to), ...]
+        self._journal = Journal(journal_path)
+        self._t0 = time.time()
+
+    def log(self, msg):
+        if self._log:
+            self._log(f"supervisor: {msg}")
+
+    def _jwrite(self, event, **fields):
+        self._journal.write(
+            event, elapsed_s=round(time.time() - self._t0, 3), **fields)
+
+    def _make_engine(self):
+        if self._factory is not None:
+            return self._factory(self.kind, self.tile)
+        from ..engine.device_bfs import DeviceBFS
+        from ..engine.paged_bfs import PagedBFS
+        kw = dict(self._engine_kwargs)
+        kw["tile_size"] = self.tile
+        cls = PagedBFS if self.kind == "paged" else DeviceBFS
+        return cls(self.spec, **kw)
+
+    def summary(self):
+        return {"attempts": self.attempts, "engine": self.kind,
+                "tile": self.tile,
+                "degrades": [list(d) for d in self.degrades]}
+
+    # ------------------------------------------------------------------
+    def run(self, *, max_states=None, max_depth=None, max_seconds=None,
+            check_deadlock=False, resume_from=None, **run_kwargs):
+        resume = resume_from
+        try:
+            with PreemptionGuard(log=self._log):
+                while True:
+                    self.attempts += 1
+                    self.engine = self._make_engine()
+                    obs = RunObserver(journal_path=self.journal_path,
+                                      metrics_path=self.metrics_path,
+                                      log=self._log)
+                    try:
+                        return self.engine.run(
+                            max_states=max_states, max_depth=max_depth,
+                            max_seconds=max_seconds,
+                            check_deadlock=check_deadlock,
+                            checkpoint_path=self.checkpoint_path,
+                            checkpoint_every=self.checkpoint_every,
+                            resume_from=resume, obs=obs, log=self._log,
+                            **run_kwargs)
+                    except Preempted:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — filtered below
+                        if not is_oom(e) \
+                                or self.attempts > self.max_retries:
+                            raise
+                        self._handle_oom(e)
+                        if self.checkpoint_path and \
+                                os.path.isdir(self.checkpoint_path):
+                            resume = self.checkpoint_path
+                        # else: keep the caller's resume_from (the OOM
+                        # hit before the first snapshot landed) — never
+                        # silently abandon a snapshot we were asked to
+                        # recover from
+                        if resume is None:
+                            self.log("no snapshot yet; restarting the "
+                                     "run from the initial states")
+        finally:
+            self._journal.close()
+
+    def _handle_oom(self, exc):
+        # injected OOMs were journaled as `fault` events by the engine's
+        # observer at fire time; journal real ones here so the journal
+        # always explains the retry that follows
+        if not isinstance(exc, InjectedFault):
+            self._jwrite("fault", what="oom", site="run")
+        if self.kind != "paged" and self.tile // 2 >= self.min_tile:
+            old, self.tile = self.tile, self.tile // 2
+            self.degrades.append(("tile", old, self.tile))
+            self._jwrite("degrade", what="tile",
+                         **{"from": old, "to": self.tile})
+            self.log(f"OOM ({exc}): degrading tile {old} -> {self.tile}")
+        elif self.kind != "paged":
+            self.degrades.append(("engine", "device", "paged"))
+            self._jwrite("degrade", what="engine",
+                         **{"from": "device", "to": "paged"})
+            self.kind = "paged"
+            self.log(f"OOM ({exc}): tile floor {self.min_tile} reached; "
+                     f"falling back to the host-paged engine")
+        else:
+            self.log(f"OOM ({exc}): already on the paged engine; "
+                     f"plain retry")
+        backoff = min(self.backoff_cap,
+                      self.backoff_base * (2 ** (self.attempts - 1)))
+        self._jwrite("retry", attempt=self.attempts,
+                     backoff_s=round(backoff, 3))
+        self.log(f"retry {self.attempts}/{self.max_retries} "
+                 f"in {backoff:.1f}s")
+        if backoff > 0:
+            self._sleep(backoff)

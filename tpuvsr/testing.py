@@ -1,0 +1,167 @@
+"""Shared test/smoke harness pieces: the inline counter spec and the
+stub device kernel that drives the REAL DeviceBFS/PagedBFS/ShardedBFS/
+DeviceSimulator loops without the reference corpus mount (ISSUE 2
+introduced the hook; ISSUE 3 promotes the stubs here so
+``tests/test_obs.py``, ``tests/test_resilience.py`` and
+``scripts/fault_matrix.py`` share one copy).
+
+The stub kernel implements exactly the attribute contract the engines
+consume (``action_names`` / ``n_lanes`` / ``_guard_fns`` /
+``_action_fns`` / ``step_all`` / ``fingerprint`` / ``invariant_fn``),
+over a two-counter state space with 16 reachable states and level
+sizes [1, 2, 3, 4, 3, 2, 1] — small enough that every engine path
+(growth, spill, checkpoint, fault, rescue) completes in seconds on the
+CPU backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .engine.spec import SpecModel
+from .frontend.cfg import parse_cfg_text
+from .frontend.parser import parse_module_text
+
+COUNTER = """---- MODULE ObsCounter ----
+EXTENDS Naturals
+CONSTANTS Limit
+VARIABLES x, y
+
+Init == x = 0 /\\ y = 0
+
+IncX ==
+    /\\ x < Limit
+    /\\ x' = x + 1
+    /\\ UNCHANGED y
+
+IncY ==
+    /\\ y < Limit
+    /\\ y' = y + 1
+    /\\ UNCHANGED x
+
+Next == IncX \\/ IncY
+
+Bound == x + y <= 2 * Limit
+====
+"""
+COUNTER_CFG = ("CONSTANTS\n    Limit = 3\n"
+               "INIT Init\nNEXT Next\nINVARIANT Bound\n")
+
+#: the counter spec's exact fixpoint — the oracle every engine/fault
+#: path is checked against
+STUB_DISTINCT = 16
+STUB_LEVELS = [1, 2, 3, 4, 3, 2, 1]
+
+
+def counter_spec():
+    """The inline two-counter spec (16 states, diameter 6)."""
+    return SpecModel(parse_module_text(COUNTER),
+                     parse_cfg_text(COUNTER_CFG))
+
+
+def stub_model_factory(limit=3):
+    """A ``model_factory`` producing a (codec, kernel) pair for the
+    counter spec — drives the real device engines with no reference
+    kernel registered."""
+    import jax
+    import jax.numpy as jnp
+
+    class _Shape:
+        MAX_MSGS = 4
+
+    class StubCodec:
+        MSG_KEYS = ()
+
+        def __init__(self):
+            self.shape = _Shape()
+
+        def zero_state(self):
+            # "status" is the plane the level kernel sizes buffers by
+            return {"status": 0, "x": 0, "y": 0, "err": 0}
+
+        def encode(self, st):
+            return {"status": np.int32(0), "x": np.int32(st["x"]),
+                    "y": np.int32(st["y"]), "err": np.int32(0)}
+
+        def decode(self, d):
+            return {"x": int(np.asarray(d["x"])),
+                    "y": int(np.asarray(d["y"]))}
+
+        def pad_msgs(self, batch, old):
+            return batch
+
+    class StubKern:
+        action_names = ["IncX", "IncY"]
+        n_lanes = 2
+
+        def _lane_count(self, name):
+            return 1
+
+        def _guard_fns(self):
+            return [lambda st, ln: st["x"] < limit,
+                    lambda st, ln: st["y"] < limit]
+
+        def _action_fns(self):
+            def incx(st, ln):
+                succ = {"status": st["status"], "x": st["x"] + 1,
+                        "y": st["y"], "err": jnp.int32(0)}
+                return succ, st["x"] < limit
+
+            def incy(st, ln):
+                succ = {"status": st["status"], "x": st["x"],
+                        "y": st["y"] + 1, "err": jnp.int32(0)}
+                return succ, st["y"] < limit
+            return [incx, incy]
+
+        lane_action = np.array([0, 1], np.int32)
+        lane_param = np.array([0, 0], np.int32)
+
+        def step_all(self, st):
+            succs, ens = [], []
+            for f in self._action_fns():
+                s, e = f(st, jnp.int32(0))
+                succs.append(s)
+                ens.append(e)
+            return ({k: jnp.stack([s[k] for s in succs])
+                     for k in succs[0]}, jnp.stack(ens))
+
+        def fingerprint(self, st):
+            x = jnp.uint32(st["x"])
+            y = jnp.uint32(st["y"])
+            return jnp.stack([x * jnp.uint32(7) + y + jnp.uint32(1),
+                              x + jnp.uint32(1), y + jnp.uint32(1),
+                              jnp.uint32(99)])
+
+        def fingerprint_batch(self, batch):
+            arr = {k: jnp.asarray(v) for k, v in batch.items()}
+            return jax.vmap(self.fingerprint)(arr)
+
+        def invariant_fn(self, names):
+            return lambda st: jnp.asarray(True)
+
+    return lambda spec, max_msgs=None: (StubCodec(), StubKern())
+
+
+def stub_device_engine(cls=None, spec=None, **kw):
+    """A small DeviceBFS (or `cls`) instance over the counter spec and
+    the stub kernel — the standard harness for engine-loop tests."""
+    from .engine.device_bfs import DeviceBFS
+    cls = cls or DeviceBFS
+    return cls(spec or counter_spec(), model_factory=stub_model_factory(),
+               hash_mode="full", tile_size=kw.pop("tile_size", 4),
+               fpset_capacity=1 << 8, next_capacity=1 << 6, **kw)
+
+
+def stub_engine_factory(spec):
+    """A ``Supervisor`` engine factory over the stub kernel: builds the
+    device or paged engine at the requested tile (the degrade ladder's
+    knob) on `spec`."""
+    from .engine.device_bfs import DeviceBFS
+    from .engine.paged_bfs import PagedBFS
+
+    def make(kind, tile):
+        cls = PagedBFS if kind == "paged" else DeviceBFS
+        return cls(spec, model_factory=stub_model_factory(),
+                   hash_mode="full", tile_size=tile,
+                   fpset_capacity=1 << 8, next_capacity=1 << 6)
+    return make
