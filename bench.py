@@ -274,6 +274,59 @@ def main():
         res2 = runner(max_seconds=max(30.0, DEADLINE - time.time()))
         RESULT["run2_distinct_per_s"] = round(
             res2.distinct_states / res2.elapsed, 1)
+    # the headline run's dispatch window (fused = 1 dispatch, chunked
+    # = the engine default); compare_bench treats depth mismatches
+    # between rounds as advisory
+    RESULT["pipeline_depth"] = (res.metrics or {}).get(
+        "gauges", {}).get("pipeline_depth")
+    # A/B the chunked engine's dispatch window on the same probe
+    # (ISSUE 4 acceptance): -pipeline 1 vs -pipeline 2 must explore
+    # the identical space; the throughput delta is the window's win
+    if time.time() < DEADLINE - 240 and res.error is None:
+        RESULT["phase"] = "pipeline-ab"
+        try:
+            ab = {}
+            for K in (1, 2):
+                e = DeviceBFS(spec, tile_size=tile,
+                              fpset_capacity=1 << 21,
+                              next_capacity=1 << 15, expand_mult=2,
+                              expand_mults={"ReceiveMatchingSVC": 4,
+                                            "SendDVC": 4},
+                              pipeline=K)
+                e.run(max_depth=6)      # compile + warm
+                r = e.run(max_seconds=max(30.0,
+                                          DEADLINE - time.time()))
+                ab[f"pipeline{K}"] = {
+                    "distinct": r.distinct_states,
+                    "generated": r.states_generated,
+                    "distinct_per_s": round(
+                        r.distinct_states / r.elapsed, 1),
+                    "elapsed_s": round(r.elapsed, 2),
+                    "reached_fixpoint": r.error is None,
+                    "overlap_saved_s": r.metrics["gauges"].get(
+                        "overlap_saved_s"),
+                }
+            # counts are only comparable when neither run was cut by
+            # the time budget (the K=2 run starts later and gets a
+            # strictly smaller budget; truncation differences are not
+            # a semantics violation) — None = not comparable
+            ab["counts_identical"] = (
+                ab["pipeline1"]["distinct"]
+                == ab["pipeline2"]["distinct"]
+                and ab["pipeline1"]["generated"]
+                == ab["pipeline2"]["generated"]
+            ) if (ab["pipeline1"]["reached_fixpoint"]
+                  and ab["pipeline2"]["reached_fixpoint"]) else None
+            RESULT["pipeline_ab"] = ab
+            print(f"bench: pipeline A/B "
+                  f"{ab['pipeline1']['distinct_per_s']} -> "
+                  f"{ab['pipeline2']['distinct_per_s']} distinct/s, "
+                  f"counts_identical={ab['counts_identical']}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — A/B never kills bench
+            RESULT["pipeline_ab"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        RESULT["phase"] = "done"
     RESULT["perf_gate"] = _perf_gate(RESULT)
     if RESULT["perf_gate"].get("ok") is False:
         print(f"bench: PERF GATE FAILED vs "

@@ -97,8 +97,20 @@ def main(argv=None):
     print(f"{args.metric}: baseline {base:.1f} ({bsrc}) -> "
           f"candidate {cand:.1f} ({csrc})  [{fmt_delta(base, cand)}]")
 
-    # context: phase-timer and counter drift between the documents
+    # pipeline-depth mismatch (ISSUE 4): a -pipeline 1 doc vs a
+    # -pipeline 2 doc measures a different dispatch regime, not a
+    # regression — downgrade any verdict to advisory
     bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
+    pipe_mismatch = False
+    if bm and cm:
+        bp = bm.get("gauges", {}).get("pipeline_depth")
+        cp = cm.get("gauges", {}).get("pipeline_depth")
+        if bp is not None and cp is not None and bp != cp:
+            pipe_mismatch = True
+            print(f"  pipeline_depth: {bp} -> {cp} (different dispatch"
+                  f" windows — comparison is advisory)")
+
+    # context: phase-timer and counter drift between the documents
     if bm and cm:
         for section in ("phases", "counters"):
             keys = sorted(set(bm.get(section, {}))
@@ -119,6 +131,12 @@ def main(argv=None):
                   f" — throughput comparison may be meaningless)")
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
+        if pipe_mismatch:
+            print(f"compare_bench: drop beyond "
+                  f"{args.max_regression:.1f}% tolerance, but the "
+                  f"documents ran different pipeline depths — "
+                  f"advisory, not a regression", file=sys.stderr)
+            return 0
         print(f"compare_bench: REGRESSION beyond "
               f"{args.max_regression:.1f}% tolerance", file=sys.stderr)
         return 1

@@ -16,8 +16,15 @@ reference mount, no TPU, seconds on the CPU backend:
   corrupt-ckpt       crash-corrupted snapshot write (payload truncated,
                      .old kept) -> load_checkpoint falls back to .old
                      and the resumed run still reaches the fixpoint
+  garble-ckpt        bit-rot snapshot write (payload bytes XOR-flipped
+                     in place, size preserved — only the manifest
+                     CRC32 can catch it) -> CRC verify fails, .old
+                     fallback, resumed run reaches the fixpoint
   exchange-drop      transient sharded-exchange failure -> journaled
                      retry, level step re-issued, exact fixpoint
+  pipeline-faults    oom + kill injected into -pipeline 4 runs ->
+                     the dispatch window drains, the supervisor/rescue
+                     paths behave exactly as at -pipeline 1
 
 Prints one JSON object; exit 0 iff every scenario passed.  Run by
 tests/test_resilience.py under tier-1 and standalone:
@@ -175,6 +182,85 @@ def scenario_corrupt_ckpt(tmp):
     }
 
 
+def scenario_garble_ckpt(tmp):
+    ORACLE = _oracle()
+    from tpuvsr.resilience import faults
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "garble-ck")
+    # every-level checkpoints; the level-3 write is bit-rotted in place
+    # (fpset.npz garbled, size preserved — only the CRC catches it)
+    faults.install("garble-ckpt:fpset.npz@level=3")
+    try:
+        res1 = stub_device_engine().run(max_depth=3,
+                                        checkpoint_path=ck)
+    finally:
+        faults.clear()
+    old_ok = os.path.isdir(ck + ".old")
+    # the garbled payload is np.load-able garbage of the right size:
+    # only the manifest CRC32 distinguishes it from a good snapshot
+    logs = []
+    res2 = stub_device_engine().run(resume_from=ck,
+                                    log=logs.append)
+    crc_seen = any("CRC32 mismatch" in m for m in logs)
+    return {
+        "ok": (bool(res1.error) and old_ok and crc_seen and res2.ok
+               and res2.distinct_states == ORACLE["distinct"]
+               and res2.levels == ORACLE["levels"]),
+        "old_present": old_ok, "crc_detected": crc_seen,
+        "distinct_after_recover": res2.distinct_states,
+    }
+
+
+def scenario_pipeline_faults(tmp):
+    """oom + kill landing while a -pipeline 4 window is in flight:
+    the drain-and-replay contract must leave the supervisor/rescue
+    paths bit-identical to the synchronous engine."""
+    ORACLE = _oracle()
+    from tpuvsr.obs import RunObserver
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard,
+                                              Supervisor)
+    from tpuvsr.testing import counter_spec, stub_device_engine, \
+        stub_engine_factory
+    spec = counter_spec()
+    # oom mid-run under the supervisor, window depth 4
+    faults.install("oom@level=3")
+    try:
+        sup = Supervisor(spec, checkpoint_path=os.path.join(tmp, "ck"),
+                         engine_factory=stub_engine_factory(
+                             spec, pipeline=4),
+                         tile_size=4, min_tile=2, backoff_base=0.0,
+                         sleep=lambda s: None)
+        res = sup.run()
+    finally:
+        faults.clear()
+    oom_ok = (res.ok and res.distinct_states == ORACLE["distinct"]
+              and res.levels == ORACLE["levels"])
+    # kill mid-run, window depth 4: rescue at the (drained) boundary
+    ck = os.path.join(tmp, "kill-ck")
+    jp = os.path.join(tmp, "kill.jsonl")
+    faults.install("kill@level=3")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                stub_device_engine(pipeline=4).run(
+                    checkpoint_path=ck,
+                    obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    res2 = stub_device_engine(pipeline=4).run(resume_from=ck) \
+        if preempted else None
+    kill_ok = (preempted is not None and res2 is not None and res2.ok
+               and res2.distinct_states == ORACLE["distinct"]
+               and res2.levels == ORACLE["levels"])
+    return {"ok": oom_ok and kill_ok, "oom_ok": oom_ok,
+            "kill_ok": kill_ok}
+
+
 def scenario_exchange_drop(tmp):
     ORACLE = _oracle()
     import jax
@@ -211,7 +297,9 @@ SCENARIOS = [
     ("oom-paged-fallback", scenario_oom_paged_fallback),
     ("kill-rescue", scenario_kill_rescue),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
+    ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),
+    ("pipeline-faults", scenario_pipeline_faults),
 ]
 
 

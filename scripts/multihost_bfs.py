@@ -47,7 +47,9 @@ def worker():
     # loopback moved ~1.4 GB/tile at 4096 — the first full-fixpoint
     # attempt was wire-bound.  Buckets grow on overflow anyway.
     eng = ShardedBFS(spec, mesh, tile=64, bucket_cap=None,
-                     next_capacity=1 << 14, fpset_capacity=1 << 16)
+                     next_capacity=1 << 14, fpset_capacity=1 << 16,
+                     pipeline=int(os.environ.get(
+                         "TPUVSR_MH_PIPELINE", "1")))
     depth = int(os.environ.get("TPUVSR_MH_DEPTH", "0")) or None
     log = (lambda m: print(f"[rank0] {m}", flush=True)) if pid == 0 \
         else None

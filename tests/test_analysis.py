@@ -211,6 +211,73 @@ def test_widths_reports_headroom_info():
     assert any("headroom" in f.message for f in infos)
 
 
+# -- AL05 suffix-log / CP06 checkpoint-plane dedicated fields (ISSUE 4)
+def _family_mod(name):
+    return WIDTH_MOD.replace("VR_REPLICA_RECOVERY ", f"{name} ")
+
+
+def _family_cfg(values="{v1}", timer=1):
+    return (f"CONSTANTS\n ReplicaCount = 3\n Values = {values}\n"
+            f" StartViewOnTimerLimit = {timer}\n CrashLimit = 1\n"
+            f"INIT Init\nNEXT Next\n")
+
+
+def test_widths_al05_suffix_log_dedicated_field():
+    mod = _family_mod("VR_REPLICA_RECOVERY_ASYNC_LOG")
+    # derivable bound: the dedicated suffix_log field reports the
+    # re-based plane fit (the suffix consumes the full MAX_OPS plane
+    # exactly, by construction)
+    rep = run_lint(_spec(mod, _family_cfg()), passes=("widths",))
+    assert rep.ok
+    infos = _fired(rep, "widths", "info")
+    assert any(f.subject == "suffix_log" and "re-based" in f.message
+               for f in infos)
+    # Values bound to a non-set: the suffix-log bound is underivable
+    # and the dedicated field FIRES as a warning
+    rep = run_lint(_spec(mod, _family_cfg(values="v1")),
+                   passes=("widths",))
+    warns = _fired(rep, "widths", "warning")
+    assert any(f.subject == "suffix_log" and "unverified" in f.message
+               for f in warns)
+    # AL05 entries are plain value ids — the old packed-entry
+    # "operation << 8" attribution must be gone; the view bound stays
+    # (inherited RR05Codec construction guard) and still fires
+    assert not any(f.subject == "operation" for f in rep.findings)
+    bad = run_lint(_spec(mod, _family_cfg(timer=255)),
+                   passes=("widths",))
+    errs = _fired(bad, "widths", "error")
+    assert errs and errs[0].subject == "view_number"
+    assert "RR05Codec" in errs[0].message
+
+
+def test_widths_cp06_checkpoint_plane_and_entry_code():
+    mod = _family_mod("VR_REPLICA_RECOVERY_CP")
+    # dedicated checkpoint-plane field reports the fit
+    rep = run_lint(_spec(mod, _family_cfg()), passes=("widths",))
+    assert rep.ok
+    assert any(f.subject == "checkpoint_plane"
+               and "m_cp" in f.message
+               for f in _fired(rep, "widths", "info"))
+    # underivable Values: the dedicated field fires as a warning
+    rep = run_lint(_spec(mod, _family_cfg(values="v1")),
+                   passes=("widths",))
+    assert any(f.subject == "checkpoint_plane"
+               for f in _fired(rep, "widths", "warning"))
+    # the WinningDVC suffix sort key packs entries into a 64-wide
+    # field: NoOp id = |Values|+1, so 62 values is the last fit and
+    # 63 overflows (one past the budget, the classic silent mis-sort)
+    v62 = "{" + ", ".join(f"v{i}" for i in range(1, 63)) + "}"
+    v63 = "{" + ", ".join(f"v{i}" for i in range(1, 64)) + "}"
+    ok = run_lint(_spec(mod, _family_cfg(values=v62)),
+                  passes=("widths",))
+    assert ok.ok
+    bad = run_lint(_spec(mod, _family_cfg(values=v63)),
+                   passes=("widths",))
+    errs = _fired(bad, "widths", "error")
+    assert errs and errs[0].subject == "entry_code"
+    assert "_winning_dvc" in errs[0].message
+
+
 # ---------------------------------------------------------------------
 # pass 3: vacuity — dead guard, vacuous invariant
 # ---------------------------------------------------------------------

@@ -238,6 +238,64 @@ def test_bad_crc_recovers_through_engine_resume(snapshot, tmp_path):
 
 
 # ---------------------------------------------------------------------
+# garble-ckpt: in-place byte garbling — the direct CRC-path fault
+# (ISSUE 4 satellite)
+# ---------------------------------------------------------------------
+def test_garble_ckpt_spec_grammar():
+    f = parse_fault("garble-ckpt:fpset.npz@level=3")
+    assert f.kind == "garble-ckpt" and f.site == "checkpoint"
+    assert f.payload == "fpset.npz" and f.level == 3
+    with pytest.raises(ValueError):
+        parse_fault("garble-ckpt")           # missing payload
+
+
+def test_garble_ckpt_preserves_size_and_breaks_only_crc(tmp_path):
+    """The flavor's whole point: the garbled payload stays np.load-able
+    garbage of the ORIGINAL size, so the manifest CRC32 is the only
+    line of defense — and it fires."""
+    ck = str(tmp_path / "snap")
+    pristine = str(tmp_path / "pristine")
+    res0 = stub_device_engine().run(max_depth=2, checkpoint_path=pristine)
+    assert res0.error
+    faults.install("garble-ckpt:fpset.npz@level=2")
+    res1 = stub_device_engine().run(max_depth=2, checkpoint_path=ck)
+    faults.clear()
+    assert res1.error                        # depth-limited
+    g = os.path.join(ck, "fpset.npz")
+    p = os.path.join(pristine, "fpset.npz")
+    assert os.path.getsize(g) == os.path.getsize(p)   # size preserved
+    # the fault keeps the previous snapshot as .old (the crash window);
+    # drop it to face the CRC check head-on
+    shutil.rmtree(ck + ".old")
+    with pytest.raises(CheckpointCorrupt, match="CRC32 mismatch"):
+        load_checkpoint(ck)
+
+
+def test_garble_ckpt_journals_and_falls_back_to_old(tmp_path):
+    ck = str(tmp_path / "snap")
+    jp = str(tmp_path / "j.jsonl")
+    # every-level cadence: the level-3 write is garbled, level-2 stays
+    # behind as .old
+    faults.install("garble-ckpt:frontier.npz@level=3")
+    res1 = stub_device_engine().run(
+        max_depth=3, checkpoint_path=ck,
+        obs=RunObserver(journal_path=jp))
+    faults.clear()
+    assert res1.error
+    events = read_journal(jp)
+    garbles = [e for e in events if e["event"] == "fault"
+               and e["what"] == "garble-ckpt"]
+    assert garbles and garbles[0]["payload"] == "frontier.npz"
+    assert os.path.isdir(ck + ".old")
+    logs = []
+    res2 = stub_device_engine().run(resume_from=ck, log=logs.append)
+    assert any("CRC32 mismatch" in m and "falling back" in m
+               for m in logs)
+    assert res2.ok and res2.distinct_states == ORACLE_DISTINCT
+    assert res2.levels == ORACLE_LEVELS
+
+
+# ---------------------------------------------------------------------
 # preemption: SIGTERM -> rescue checkpoint -> resumable -> equivalence
 # ---------------------------------------------------------------------
 def test_preemption_guard_flag_and_restore():
@@ -423,7 +481,7 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["ok"] and len(out["scenarios"]) == 5
+    assert out["ok"] and len(out["scenarios"]) == 7
 
 
 # ---------------------------------------------------------------------
@@ -440,12 +498,11 @@ def _cli(args):
 
 
 @pytest.mark.parametrize("bad", [
-    ["-supervise", "-fused"],
     ["-supervise", "-simulate"],
     ["-supervise", "-engine", "interp"],
     ["-supervise", "-fpset", "host"],
     ["-inject", "explode@level=1"],
-], ids=["fused", "simulate", "interp", "host-fpset", "bad-inject"])
+], ids=["simulate", "interp", "host-fpset", "bad-inject"])
 def test_cli_supervise_and_inject_flag_validation(bad):
     r = _cli(["X.tla"] + bad)
     assert r.returncode == 2, r.stderr

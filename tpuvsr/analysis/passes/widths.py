@@ -53,6 +53,30 @@ _PACKED_ENTRY = (
                            "(value_id << 8 must fit int32)"),
 )
 
+# AL05 reverts to plain value-id entries (al05.py undoes RR05's
+# 2-field packing), so _PACKED_ENTRY's attributions are wrong for it —
+# but AL05Codec still INHERITS RR05Codec.__init__'s MAX_VIEW < 256
+# construction guard, so the view bound itself is real.  Its
+# module-specific hazard is the re-based recovery suffix log
+# (dedicated plane check: FAMILY_PLANES).
+_AL05_PACKED = (
+    ("view_number", 1 << 8, "inherited packed-entry construction "
+                            "guard (AL05Codec <- RR05Codec.__init__: "
+                            "MAX_VIEW < 256)"),
+)
+# CP06 entries are plain ids too (NoOp = |Values|+1, cp06.py), but
+# WinningDVC packs its suffix sort keys as domain*64 + entry_code
+# (cp06_kernel._winning_dvc) — entry codes must stay under 64 or the
+# deterministic-CHOOSE tie-break silently mis-sorts.
+_CP06_PACKED = (
+    ("view_number", 1 << 8, "inherited packed-entry construction "
+                            "guard (CP06Codec <- RR05Codec.__init__: "
+                            "MAX_VIEW < 256)"),
+    ("entry_code", 64, "packed suffix sort key domain*64 + entry "
+                       "(cp06_kernel._winning_dvc; NoOp id = "
+                       "|Values|+1)"),
+)
+
 # module name -> packed-field table (absent = generic checks only)
 FAMILY_PACKED = {
     "VSR": _VSR_PACKED,
@@ -61,8 +85,25 @@ FAMILY_PACKED = {
     "VR_INC_RESEND": _PACKED_ENTRY,
     "VR_APP_STATE": _PACKED_ENTRY,
     "VR_REPLICA_RECOVERY": _PACKED_ENTRY,
-    "VR_REPLICA_RECOVERY_ASYNC_LOG": _PACKED_ENTRY,
-    "VR_REPLICA_RECOVERY_CP": _PACKED_ENTRY,
+    "VR_REPLICA_RECOVERY_ASYNC_LOG": _AL05_PACKED,
+    "VR_REPLICA_RECOVERY_CP": _CP06_PACKED,
+}
+
+# module name -> dedicated plane-budget checks (ISSUE 4 satellite;
+# ROADMAP follow-up): (field, bounded quantity, where).  The plane
+# capacity is MAX_OPS = |Values| rows, derived from the same cfg —
+# normally an INFO fit/headroom line, a WARN when the bound is
+# underivable from the constants, an ERROR should the derived range
+# ever exceed the plane.
+FAMILY_PLANES = {
+    "VR_REPLICA_RECOVERY_ASYNC_LOG": (
+        ("suffix_log", "op_number",
+         "re-based recovery suffix rows rec_log/m_log[MAX_OPS] "
+         "(al05.py _encode_rec: first_op = prefix_ceil + 1)"),),
+    "VR_REPLICA_RECOVERY_CP": (
+        ("checkpoint_plane", "cp_number",
+         "checkpoint payload rows m_cp/rec_cp/dvc_cp[MAX_OPS] "
+         "(cp06.py zero_state)"),),
 }
 
 
@@ -95,6 +136,11 @@ def derive_ranges(spec):
         rng["op_number"] = (0, nvalues)        # MAX_OPS = |Values|
         rng["commit_number"] = (0, nvalues)
         rng["request_number"] = (0, nvalues)
+        # checkpoints cover committed prefixes: cp_number <= commit
+        rng["cp_number"] = (0, nvalues)
+        # dense log entry codes: value ids 1..|Values| plus CP06's
+        # NoOp id |Values|+1 (cp06.py noop_id)
+        rng["entry_code"] = (0, nvalues + 1)
     if clients is not None:
         rng["client_id"] = (0, clients)
     if replicas is not None:
@@ -127,6 +173,31 @@ def run(spec, report):
                    "no registered packed layout for this module; "
                    "generic int32 checks only")
         return
+
+    # dedicated plane-row budgets (AL05 suffix log, CP06 checkpoint
+    # plane): the quantity must provably fit the MAX_OPS = |Values|
+    # rows its dense plane allocates
+    values = c.get("Values")
+    nvalues = len(values) if isinstance(values, frozenset) else None
+    for fld, qty, where in FAMILY_PLANES.get(spec.module.name, ()):
+        if nvalues is None or qty not in rng:
+            report.add(PASS, SEV_WARN, fld,
+                       f"cannot derive the {fld} bound ({qty} vs the "
+                       f"MAX_OPS = |Values| plane rows) from the cfg "
+                       f"constants; {where} is unverified")
+            continue
+        lo, hi = rng[qty]
+        if hi > nvalues:
+            report.add(PASS, SEV_ERROR, fld,
+                       f"derived {qty} range [{lo}, {hi}] exceeds the "
+                       f"{nvalues}-row plane in {where}; rows would "
+                       f"clip silently")
+        else:
+            slack = "exactly" if hi == nvalues else \
+                f"(headroom {nvalues - hi})"
+            report.add(PASS, SEV_INFO, fld,
+                       f"{qty} range [{lo}, {hi}] fits the "
+                       f"{nvalues}-row plane in {where} {slack}")
 
     for fld, limit, where in packed:
         if fld not in rng:

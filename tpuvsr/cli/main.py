@@ -34,7 +34,19 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
   -fused           device BFS: whole fixpoint in O(1) dispatches (no
                    per-level host syncs — the remote-TPU mode; not
                    combinable with -checkpoint/-recover or temporal
-                   properties)
+                   properties, EXCEPT under -supervise, where each
+                   fused dispatch is bounded to a rescue quantum so
+                   level-boundary snapshots and SIGTERM rescues work;
+                   a supervised resume continues through the chunked
+                   engine)
+  -pipeline K      device/paged BFS dispatch window: keep up to K
+                   level-kernel dispatches in flight, blocking only on
+                   the oldest, so host-side work (journal, metrics,
+                   spill compaction, checkpoint staging) overlaps
+                   device compute (default 2; 1 = the synchronous
+                   pre-pipeline behavior).  Counts, level sizes and
+                   violation traces are bit-identical for every K
+                   (README "Pipelining")
   -lint            run the speclint static analyzer (tpuvsr/analysis)
                    over the bound spec and exit: 0 clean/warnings,
                    1 errors.  With -json the report is one JSON object.
@@ -74,9 +86,10 @@ spans (view with TensorBoard / Perfetto).  TPUVSR_FAULT=SPEC arms
 fault injection (same grammar as -inject).
 
 Mutually exclusive flags (argparse errors, exit code 2, before any
-spec is loaded): -fused with -checkpoint/-recover; -fpset host with
+spec is loaded): -fused with -checkpoint/-recover (unless -supervise,
+whose rescue quantum makes fused snapshots possible); -fpset host with
 -engine device; -fpset hbm/paged with -engine interp; -supervise with
--fused/-simulate/-engine interp/-fpset host.
+-simulate/-engine interp/-fpset host.
 
 Exit codes: 0 ok; 1 speclint errors (-lint); 2 bad flags; 12 safety/
 temporal violation (TLC's code); 75 preempted-but-resumable (a
@@ -119,7 +132,14 @@ def build_parser():
     p.add_argument("-fused", action="store_true",
                    help="device engine: run the whole fixpoint in O(1)"
                         " dispatches (no per-level host syncs; remote-"
-                        "TPU mode; excludes -checkpoint/-recover)")
+                        "TPU mode; excludes -checkpoint/-recover "
+                        "unless -supervise)")
+    p.add_argument("-pipeline", type=int, default=2, metavar="K",
+                   help="device/paged BFS dispatch window: keep K "
+                        "level-kernel dispatches in flight, blocking "
+                        "only on the oldest (default 2; 1 = "
+                        "synchronous).  Results are bit-identical "
+                        "for every K")
     p.add_argument("-lower", action="store_true",
                    help="compile the device kernel's guards/actions/"
                         "invariants from the spec AST (tpuvsr/lower) "
@@ -156,19 +176,20 @@ def validate_args(parser, args):
     """Flag-conflict validation at parse time: documented mutual
     exclusions fail with argparse's usage error (exit code 2) instead
     of a late engine failure."""
-    if args.fused and (args.checkpoint is not None or args.recover):
+    if args.fused and not args.supervise and (
+            args.checkpoint is not None or args.recover):
         parser.error("-fused cannot be combined with "
-                     "-checkpoint/-recover (the fused fixpoint never "
-                     "syncs at a level boundary to snapshot)")
+                     "-checkpoint/-recover without -supervise (only "
+                     "the supervised fused run bounds its dispatch to "
+                     "a rescue quantum; a fused resume continues "
+                     "through the chunked engine)")
+    if args.pipeline < 1:
+        parser.error(f"-pipeline must be >= 1 (got {args.pipeline})")
     if args.fpset == "host" and args.engine == "device":
         parser.error("-fpset host requires -engine interp (the host "
                      "fingerprint set only exists in the interpreter)")
     if args.fpset in ("hbm", "paged") and args.engine == "interp":
         parser.error(f"-fpset {args.fpset} requires the device engine")
-    if args.supervise and args.fused:
-        parser.error("-supervise cannot be combined with -fused (the "
-                     "fused fixpoint never syncs at a level boundary "
-                     "to snapshot or degrade)")
     if args.supervise and args.simulate:
         parser.error("-supervise supervises BFS runs, not simulation")
     if args.supervise and (args.engine == "interp"
@@ -310,7 +331,11 @@ def main(argv=None):
                     checkpoint_every=(args.checkpoint * 60.0
                                       if args.checkpoint else None),
                     journal_path=args.journal,
-                    metrics_path=args.metrics, log=log)
+                    metrics_path=args.metrics, log=log,
+                    # -fused under -supervise: rescue-quantum-bounded
+                    # fused dispatches; resume continues chunked
+                    fused=args.fused and engine == "device",
+                    engine_kwargs={"pipeline": args.pipeline})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -330,10 +355,12 @@ def main(argv=None):
                 want_graph = bool(spec.temporal_props) and \
                     not spec.symmetry_perms
                 if want_graph:
-                    eng = PagedBFS(spec, retain_levels=True)
+                    eng = PagedBFS(spec, retain_levels=True,
+                                   pipeline=args.pipeline)
                 else:
                     eng = (PagedBFS if engine == "paged"
-                           else DeviceBFS)(spec)
+                           else DeviceBFS)(spec,
+                                           pipeline=args.pipeline)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:

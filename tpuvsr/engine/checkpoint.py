@@ -139,15 +139,25 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         json.dump(manifest, f)
         f.flush()
         os.fsync(f.fileno())
-    # fault hook: emulate a crash-corrupted write — truncate the named
-    # payload AND leave the previous snapshot as .old (the crash window
-    # between rename-into-place and .old cleanup)
+    # fault hook: emulate a corrupted write AND leave the previous
+    # snapshot as .old (the crash window between rename-into-place and
+    # .old cleanup).  Two flavors (resilience/faults.py): corrupt-ckpt
+    # truncates the named payload (torn write — np.load chokes);
+    # garble-ckpt XOR-flips a byte span mid-file with the size
+    # preserved (bit rot — ONLY the manifest CRC32 catches it)
     corrupt = fault_point("checkpoint", depth=depth, path=path, obs=obs)
     if corrupt:
-        victim = os.path.join(tmp, corrupt)
+        victim = os.path.join(tmp, corrupt.payload)
         size = os.path.getsize(victim)
         with open(victim, "r+b") as f:
-            f.truncate(max(1, size // 2))
+            if corrupt.kind == "garble-ckpt":
+                span = max(1, min(64, size // 2))
+                f.seek(size // 2)
+                chunk = f.read(span)
+                f.seek(size // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+            else:
+                f.truncate(max(1, size // 2))
     for name in PAYLOADS:
         _fsync_path(os.path.join(tmp, name))
     _fsync_path(tmp)

@@ -31,6 +31,14 @@ commits NOTHING for the action that failed, so the host can grow the
 relevant structure and re-enter the level at the paused tile — lanes
 already committed simply dedup against the FPSet on re-run.
 
+Dispatch pipelining (ISSUE 4): the chunked loop keeps a bounded
+window of K level-kernel dispatches in flight (``pipeline=K``,
+default 2), chained on device-side (start_t, nn) scalars and blocking
+only on the oldest — host-side work overlaps device compute, and the
+pause protocol above is exactly what makes speculation safe
+(engine/pipeline.py has the drain-and-replay argument).  Results are
+bit-identical for every K.
+
 Scale note: fingerprints live in HBM at 16 B/state; the frontier and
 next-frontier buffers hold dense states in HBM (~state_size x capacity);
 the host holds 10 B/state of trace pointers.  Multi-host sharding is
@@ -87,7 +95,7 @@ class DeviceBFS:
     def __init__(self, spec: SpecModel, max_msgs=None, tile_size=128,
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
-                 expand_mults=None, model_factory=None):
+                 expand_mults=None, model_factory=None, pipeline=2):
         if (tile_size > MAX_VALIDATED_TPU_TILE
                 and os.environ.get("TPUVSR_UNSAFE_TILE") != "1"
                 and jax.default_backend() != "cpu"):
@@ -103,6 +111,10 @@ class DeviceBFS:
         self.hash_mode = hash_mode
         self.next_cap = next_capacity
         self.chunk_tiles = chunk_tiles
+        # dispatch-window depth: keep up to `pipeline` level-kernel
+        # dispatches in flight, blocking only on the oldest (ISSUE 4;
+        # 1 = the fully synchronous pre-pipeline behavior)
+        self.pipe_window = max(1, int(pipeline))
         # per-action enabled-lane compaction capacity = tile * mult
         # (each action's cap auto-doubles on its own R_EXPAND_GROW;
         # pass a pre-calibrated per-action vector to skip the growth
@@ -186,6 +198,7 @@ class DeviceBFS:
                 reason, viol = c["reason"], c["viol"]
                 en_any = jnp.zeros((T,), bool)
                 gen_local = jnp.asarray(0, I32)
+                act_local = []      # per-action enabled-lane counts
                 grow_aid = c["grow_aid"]
 
                 # headroom check up front: with N_cap - nn >= total_E no
@@ -219,6 +232,7 @@ class DeviceBFS:
                     en_f = en.reshape(TL)
                     n_en = en_f.sum()
                     gen_local = gen_local + n_en
+                    act_local.append(n_en)
                     ovf_a = n_en > E_a
                     grow_aid = jnp.where(ovf_a & ~ovf_e, aid, grow_aid)
                     ovf_e = ovf_e | ovf_a
@@ -304,6 +318,10 @@ class DeviceBFS:
                 reason = jnp.where(dl & (reason == RUNNING),
                                    R_DEADLOCK, reason)
                 dead_i = jnp.where(dl, base + jnp.argmax(dead), c["dead"])
+                # per-action expansion counters ride the carry as an
+                # on-device accumulator (ISSUE 4 satellite) — same
+                # commit gating as `gen`, so sum(act) == gen always
+                act_vec = jnp.stack(act_local).astype(jnp.uint32)
                 return {
                     "t": jnp.where(commit & (reason == RUNNING),
                                    t + 1, t),
@@ -313,6 +331,8 @@ class DeviceBFS:
                     "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                     "nn": nn, "dist": dist,
                     "gen": c["gen"] + jnp.where(commit, gen_local, 0),
+                    "act": c["act"] + jnp.where(commit, act_vec,
+                                                jnp.uint32(0)),
                 }
 
             return body
@@ -344,6 +364,7 @@ class DeviceBFS:
                 "nn": jnp.asarray(n_next0, I32),
                 "dist": jnp.asarray(0, I32),
                 "gen": jnp.asarray(0, I32),
+                "act": jnp.zeros((len(_caps),), jnp.uint32),
             }
             return jax.lax.while_loop(cond, body, init)
 
@@ -403,6 +424,7 @@ class DeviceBFS:
                     "nn": c["nn"],
                     "dist": jnp.asarray(0, I32),
                     "gen": c["gen_level"],
+                    "act": c["act"],
                 }
                 r = jax.lax.while_loop(icond, body, iinit)
                 committed = r["reason"] == RUNNING
@@ -455,6 +477,7 @@ class DeviceBFS:
                     "reason": r["reason"],
                     "viol": r["viol"], "dead": r["dead"],
                     "grow_aid": r["grow_aid"],
+                    "act": r["act"],
                 }
 
             init = {
@@ -474,6 +497,7 @@ class DeviceBFS:
                 "viol": jnp.full((3,), -1, I32),
                 "dead": jnp.asarray(-1, I32),
                 "grow_aid": jnp.asarray(-1, I32),
+                "act": jnp.zeros((len(_caps),), jnp.uint32),
             }
             return jax.lax.while_loop(ocond, obody, init)
 
@@ -561,8 +585,13 @@ class DeviceBFS:
         preflight(self.spec, log=log)   # fail fast, before any dispatch
         obs = RunObserver.ensure(obs, "device", self.spec, log=log,
                                  progress_every=progress_every)
+        obs.pipeline = self.pipe_window
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
+        # per-action expansion counters (on-device accumulator, pulled
+        # with the control scalars; run-scoped, not checkpointed)
+        self._act_counts = np.zeros(len(self.kern.action_names),
+                                    np.int64)
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend(),
@@ -648,6 +677,31 @@ class DeviceBFS:
         # keyword-only: the loop state is a pile of same-typed ints and
         # identically shaped buffers — a transposed positional arg
         # would type-check and silently corrupt traces/metrics
+        from .pipeline import DispatchPipeline
+        pipe = DispatchPipeline(self.pipe_window, obs,
+                                ready=lambda o: o["reason"])
+
+        def pull(o):
+            # ONE host round-trip for all control scalars — separate
+            # int() pulls cost one tunnel RTT each on a remote TPU
+            return jax.device_get([o["reason"], o["t"], o["nn"],
+                                   o["gen"], o["dist"], o["act"]])
+        return self._chunk_loop(
+            res, obs, pipe, pull, table=table, front=front,
+            bufs=bufs, fpar=fpar, fact=fact, fprm=fprm,
+            n_front=n_front, level_base=level_base, depth=depth,
+            fp_count=fp_count, fp_cap=fp_cap, t0=t0,
+            max_states=max_states, max_depth=max_depth,
+            max_seconds=max_seconds, check_deadlock=check_deadlock,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            last_checkpoint=last_checkpoint)
+
+    def _chunk_loop(self, res, obs, pipe, pull, *, table, front, bufs,
+                    fpar, fact, fprm, n_front, level_base, depth,
+                    fp_count, fp_cap, t0, max_states, max_depth,
+                    max_seconds, check_deadlock, checkpoint_path,
+                    checkpoint_every, last_checkpoint):
         spec = self.spec
         emit = obs.log
         while n_front > 0:
@@ -660,36 +714,54 @@ class DeviceBFS:
             n_next = 0
             n_tiles = (n_front + self.tile - 1) // self.tile
             stop = None
-            while start_t < n_tiles:
-                nb, nbp, nba, nbprm = bufs
-                phase = "compile" if self._fresh_jit else "dispatch"
-                with obs.timer(phase), obs.annotate(
-                        f"level {depth} {phase}"):
-                    out = self._level(
-                        table["slots"], front,
-                        jnp.asarray(n_front, I32),
-                        jnp.asarray(start_t, I32),
-                        nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
-                        jnp.asarray(bool(check_deadlock)))
-                    out["reason"].block_until_ready()
-                self._fresh_jit = False
-                obs.count("dispatches")
-                table = {"slots": out["slots"]}
-                bufs = (out["nb"], out["nbp"], out["nba"], out["nbprm"])
-                # ONE host round-trip for all control scalars — separate
-                # int() pulls cost one tunnel RTT each on a remote TPU
-                with obs.timer("host_sync"):
-                    sc = jax.device_get([out["reason"], out["t"],
-                                         out["nn"], out["gen"],
-                                         out["dist"]])
+            # device-side chain: the next dispatch's (start_t, nn)
+            # come straight off the previous dispatch's outputs, so
+            # filling the window costs zero host syncs
+            pend_t = jnp.asarray(0, I32)
+            pend_nn = jnp.asarray(0, I32)
+            while True:
+                # keep the window full (speculation past a pause or the
+                # level end is safe: such dispatches commit nothing and
+                # pipe.drain() discards their deltas — pipeline.py)
+                while pipe.has_room():
+                    nb, nbp, nba, nbprm = bufs
+                    out = pipe.launch(
+                        self._level, table["slots"], front,
+                        jnp.asarray(n_front, I32), pend_t,
+                        nb, nbp, nba, nbprm, pend_nn,
+                        jnp.asarray(bool(check_deadlock)),
+                        fresh=self._fresh_jit,
+                        label=f"level {depth} dispatch")
+                    self._fresh_jit = False
+                    table = {"slots": out["slots"]}
+                    bufs = (out["nb"], out["nbp"], out["nba"],
+                            out["nbprm"])
+                    pend_t, pend_nn = out["t"], out["nn"]
+                out, sc = pipe.collect(pull)
                 reason, start_t, n_next, gen_add, dist_add = (
-                    int(x) for x in sc)
+                    int(x) for x in sc[:5])
                 res.states_generated += gen_add
                 fp_count += dist_add
+                self._act_counts += np.asarray(sc[5], np.int64)
 
                 if reason == RUNNING:
-                    pass
-                elif reason == R_VIOLATION:
+                    obs.progress(depth=depth, distinct=fp_count,
+                                 generated=res.states_generated)
+                    if max_seconds and time.time() - t0 > max_seconds:
+                        stop = f"time budget {max_seconds}s reached"
+                        pipe.drain()
+                        break
+                    if start_t >= n_tiles:
+                        pipe.drain()     # in-flight tickets are no-ops
+                        break            # level complete
+                    continue
+                # pause or terminal reason: everything still in flight
+                # is a replay of the same paused tile — drop it, then
+                # handle the reason on the chain-tip table/buffers
+                # (identical to the consumed ticket's: replays commit
+                # nothing)
+                pipe.drain()
+                if reason == R_VIOLATION:
                     vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
                     gid = level_base + vp
                     parent_dense = self._fetch_row(front, vp)
@@ -759,7 +831,8 @@ class DeviceBFS:
                     res.diameter = depth
                     return self._finish(res, obs, fp_count,
                                         table=table, fp_cap=fp_cap)
-
+                # growth pauses fall through here; terminal reasons
+                # returned above
                 obs.progress(depth=depth, distinct=fp_count,
                              generated=res.states_generated)
                 if max_seconds and time.time() - t0 > max_seconds:
@@ -875,19 +948,33 @@ class DeviceBFS:
     @closes_observer
     def run_fused(self, max_states=None, max_depth=None,
                   max_seconds=None, check_deadlock=False, log=None,
-                  levels_per_dispatch=256, obs=None) -> CheckResult:
+                  levels_per_dispatch=256, checkpoint_path=None,
+                  checkpoint_every=None, rescue_quantum=8,
+                  obs=None) -> CheckResult:
         """Like run(), but through the fused multi-level pass
         (_make_multilevel): the whole reachable space is explored in a
         handful of dispatches (one, absent growth pauses), eliminating
         the per-level host round-trips that dominate on a remote TPU.
         Trace pointers and level sizes accumulate on device and are
-        pulled once at the end.  No checkpoint/resume (use run() for
-        long preemptible jobs)."""
+        pulled once at the end.
+
+        With ``checkpoint_path`` (the supervised mode, ISSUE 4
+        satellite) each dispatch is bounded to a ``rescue_quantum``
+        level quantum so the host regains control at level boundaries:
+        run()-format snapshots are written there (every boundary, or on
+        the ``checkpoint_every`` cadence), and a pending SIGTERM/SIGINT
+        (PreemptionGuard) turns into a rescue snapshot + ``Preempted``
+        exactly like the chunked engine.  The snapshot resumes through
+        ``run()`` — the fused pass itself has no resume path."""
         from ..analysis import preflight
         preflight(self.spec, log=log)   # fail fast, before any dispatch
         obs = RunObserver.ensure(obs, "device-fused", self.spec, log=log)
+        obs.pipeline = 1                # one fused dispatch in flight
+        obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
+        self._act_counts = np.zeros(len(self.kern.action_names),
+                                    np.int64)
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend())
@@ -921,11 +1008,18 @@ class DeviceBFS:
         n_front, start_t, nn, gen_level = n0, 0, 0, 0
         depth, level_base, fp_count = 0, 0, n0
         self.level_sizes = [n0]
+        last_checkpoint = time.time()
         # adaptive dispatch quantum: small first dispatches give the
         # host early wall-clock checkpoints for max_seconds, growing
         # toward levels_per_dispatch so steady state stays O(1)
-        # dispatches (on a remote TPU the extra early syncs are noise)
-        quantum = 4 if max_seconds else levels_per_dispatch
+        # dispatches (on a remote TPU the extra early syncs are noise).
+        # A checkpointing (supervised) run stays bounded at
+        # rescue_quantum so a preemption is never more than that many
+        # levels away from a rescue boundary.
+        q_cap = (min(levels_per_dispatch, max(1, int(rescue_quantum)))
+                 if checkpoint_path else levels_per_dispatch)
+        quantum = min(4, q_cap) if (max_seconds or checkpoint_path) \
+            else levels_per_dispatch
 
         def set_pointers(n):
             self._h_parent = [np.asarray(tpp[:n]).astype(np.int64)]
@@ -952,7 +1046,7 @@ class DeviceBFS:
                 out["reason"].block_until_ready()
             self._fresh_jit = False
             obs.count("dispatches")
-            quantum = min(quantum * 4, levels_per_dispatch)
+            quantum = min(quantum * 4, q_cap)
             table = {"slots": out["slots"]}
             front, nb = out["front"], out["nb"]
             nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
@@ -963,9 +1057,10 @@ class DeviceBFS:
                     [out[k] for k in ("reason", "n_front", "start_t",
                                       "nn", "gen_level", "gen", "depth",
                                       "level_base", "fp_count",
-                                      "lvl_cur")])
+                                      "lvl_cur", "act")])
             (reason, n_front, start_t, nn, gen_level, gen_add, depth,
-             level_base, fp_count, lvl_cur) = (int(x) for x in sc)
+             level_base, fp_count, lvl_cur) = (int(x) for x in sc[:10])
+            self._act_counts += np.asarray(sc[10], np.int64)
             res.states_generated += gen_add
             if lvl_cur:
                 # level boundaries inside one dispatch share its
@@ -994,6 +1089,62 @@ class DeviceBFS:
                 if max_seconds and time.time() - t0 > max_seconds:
                     res.error = f"time budget {max_seconds}s reached"
                     break
+                # quantum boundary == level boundary (ocond only exits
+                # between levels): rescue/cadence checkpoint first
+                # (ISSUE 4 satellite — the fused fixpoint is
+                # preemption-safe under -supervise), then the level
+                # fault hook for the next quantum's first level —
+                # mirroring the chunked engine's checkpoint-then-
+                # fault chronology so a fault always finds the
+                # freshest snapshot behind it.  The preemption flag is
+                # polled regardless of checkpoint_path (chunked-run
+                # parity: a guard-caught SIGTERM must never be
+                # silently swallowed — Preempted's message reports the
+                # missing snapshot)
+                rescue = preempt_signal()
+                if checkpoint_path and (
+                        rescue is not None
+                        or checkpoint_every is None
+                        or time.time() - last_checkpoint
+                        >= checkpoint_every):
+                    from .checkpoint import save_checkpoint, spec_digest
+                    with obs.timer("checkpoint"):
+                        set_pointers(level_base + n_front)
+                        save_checkpoint(
+                            checkpoint_path,
+                            slots=table["slots"], frontier=front,
+                            n_front=n_front,
+                            h_parent=np.concatenate(self._h_parent),
+                            h_action=np.concatenate(self._h_action),
+                            h_param=np.concatenate(self._h_param),
+                            init_dense=self._init_dense,
+                            level_sizes=self.level_sizes, depth=depth,
+                            fp_count=fp_count,
+                            states_generated=res.states_generated,
+                            max_msgs=self.codec.shape.MAX_MSGS,
+                            expand_mults=self.expand_mults,
+                            elapsed=time.time() - t0,
+                            digest=spec_digest(spec), obs=obs)
+                    last_checkpoint = time.time()
+                    obs.checkpoint(checkpoint_path, depth, fp_count)
+                    emit(f"checkpoint written to {checkpoint_path} "
+                         f"(depth {depth}, {fp_count} distinct; "
+                         f"resume via the chunked engine)")
+                if rescue is not None:
+                    obs.rescue(checkpoint_path or "", depth, fp_count,
+                               rescue)
+                    emit(f"preempted by {rescue}: rescue snapshot at "
+                         f"depth {depth} ({checkpoint_path}); exiting "
+                         f"resumable")
+                    raise Preempted(checkpoint_path, depth, fp_count,
+                                    rescue)
+                # the next quantum starts with level depth+1 — same
+                # depth convention as the chunked engine's per-level
+                # hook.  The host only sees quantum boundaries, so a
+                # level-pinned fault fires iff its level is the first
+                # of a quantum (pin rescue_quantum accordingly in
+                # injection tests)
+                fault_point("level", depth=depth + 1, obs=obs)
                 if level_base + n_front + f_cap > tp_cap:
                     add = tp_cap                     # double
                     tpp = jnp.concatenate(
@@ -1131,6 +1282,14 @@ class DeviceBFS:
         if fp_cap:
             obs.gauge("fpset_capacity", int(fp_cap))
             obs.gauge("fpset_occupancy", fp_count / fp_cap)
+        acts = getattr(self, "_act_counts", None)
+        if acts is not None:
+            # per-action expansion counters from the on-device
+            # accumulator (ISSUE 4 satellite); sums to generated minus
+            # the init states on a clean run
+            obs.gauge("action_expansions",
+                      {n: int(c) for n, c in
+                       zip(self.kern.action_names, acts)})
         if table is not None and obs.detailed:
             from .fpset import table_stats
             st = table_stats(table["slots"])

@@ -104,8 +104,11 @@ class PagedBFS(DeviceBFS):
         preflight(self.spec, log=log)   # fail fast, before any dispatch
         obs = RunObserver.ensure(obs, "paged", self.spec, log=log,
                                  progress_every=progress_every)
+        obs.pipeline = self.pipe_window
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
+        self._act_counts = np.zeros(len(self.kern.action_names),
+                                    np.int64)
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend(),
@@ -177,6 +180,19 @@ class PagedBFS(DeviceBFS):
         bufs = self._alloc_bufs(self.next_cap)
         stop = None
 
+        # pipelined dispatch window (ISSUE 4): chained on device-side
+        # (start_t, nn) scalars; host-side spill compaction and
+        # journal/metrics work overlap the in-flight dispatches.  The
+        # window drains at every pause/spill/chunk boundary — dropped
+        # tickets are replays that committed nothing (engine/pipeline.py)
+        from .pipeline import DispatchPipeline
+        pipe = DispatchPipeline(self.pipe_window, obs,
+                                ready=lambda o: o["reason"])
+
+        def pull(o):
+            return jax.device_get([o["reason"], o["t"], o["nn"],
+                                   o["gen"], o["dist"], o["act"]])
+
         while n_front > 0 and stop is None:
             if max_depth is not None and depth >= max_depth:
                 res.error = f"depth limit {max_depth} reached"
@@ -194,9 +210,11 @@ class PagedBFS(DeviceBFS):
             n_c = 0
             n_next = 0
 
-            def drain():
+            def spill():
                 """Page the first n_next rows of the next buffers out to
-                host RAM and reset the counter."""
+                host RAM and reset the counter.  Reads the chain-tip
+                buffers: identical to the paused dispatch's (replays
+                commit nothing)."""
                 nonlocal n_next_total, n_next
                 if n_next == 0:
                     return
@@ -233,35 +251,48 @@ class PagedBFS(DeviceBFS):
                 put_chunk()
                 n_tiles_c = (n_c + self.tile - 1) // self.tile
                 start_t = 0
-                while start_t < n_tiles_c and stop is None:
-                    nb, nbp, nba, nbprm = bufs
-                    phase = "compile" if self._fresh_jit else "dispatch"
-                    with obs.timer(phase), obs.annotate(
-                            f"level {depth} {phase}"):
-                        out = self._level(
-                            table["slots"], dev_chunk,
-                            jnp.asarray(n_c, I32),
-                            jnp.asarray(start_t, I32),
-                            nb, nbp, nba, nbprm, jnp.asarray(n_next, I32),
-                            jnp.asarray(bool(check_deadlock)))
-                        out["reason"].block_until_ready()
-                    self._fresh_jit = False
-                    obs.count("dispatches")
-                    table = {"slots": out["slots"]}
-                    bufs = (out["nb"], out["nbp"], out["nba"],
-                            out["nbprm"])
-                    with obs.timer("host_sync"):
-                        sc = jax.device_get([out["reason"], out["t"],
-                                             out["nn"], out["gen"],
-                                             out["dist"]])
+                pend_t = jnp.asarray(0, I32)
+                pend_nn = jnp.asarray(n_next, I32)
+                while True:
+                    while pipe.has_room():
+                        nb, nbp, nba, nbprm = bufs
+                        out = pipe.launch(
+                            self._level, table["slots"], dev_chunk,
+                            jnp.asarray(n_c, I32), pend_t,
+                            nb, nbp, nba, nbprm, pend_nn,
+                            jnp.asarray(bool(check_deadlock)),
+                            fresh=self._fresh_jit,
+                            label=f"level {depth} dispatch")
+                        self._fresh_jit = False
+                        table = {"slots": out["slots"]}
+                        bufs = (out["nb"], out["nbp"], out["nba"],
+                                out["nbprm"])
+                        pend_t, pend_nn = out["t"], out["nn"]
+                    out, sc = pipe.collect(pull)
                     reason, start_t, n_next, gen_add, dist_add = (
-                        int(x) for x in sc)
+                        int(x) for x in sc[:5])
                     res.states_generated += gen_add
                     fp_count += dist_add
+                    self._act_counts += np.asarray(sc[5], np.int64)
 
                     if reason == RUNNING:
-                        pass
-                    elif reason == R_VIOLATION:
+                        obs.progress(depth=depth, distinct=fp_count,
+                                     generated=res.states_generated,
+                                     frontier=n_front,
+                                     extra="host-paged")
+                        if max_seconds and time.time() - t0 > max_seconds:
+                            stop = f"time budget {max_seconds}s reached"
+                            pipe.drain()
+                            break
+                        if start_t >= n_tiles_c:
+                            pipe.drain()     # no-op tickets past the end
+                            break            # chunk complete
+                        continue
+                    # pause/terminal: in-flight tickets are replays of
+                    # the same paused tile — drop, then handle on the
+                    # chain-tip table/buffers
+                    pipe.drain()
+                    if reason == R_VIOLATION:
                         vp, va, vprm = (int(v)
                                         for v in np.asarray(out["viol"]))
                         gid = level_base + chunk_start + vp
@@ -287,12 +318,15 @@ class PagedBFS(DeviceBFS):
                                             table=table, fp_cap=fp_cap)
                     elif reason == R_NEXT_GROW:
                         # the spill tier: page the filled buffer out to
-                        # host RAM instead of growing it in HBM
+                        # host RAM instead of growing it in HBM; the
+                        # refilled window then overlaps the host-side
+                        # compaction below with device compute
                         self.spill_count += 1
-                        drain()
+                        spill()
+                        pend_nn = jnp.asarray(0, I32)
                     elif reason == R_BAG_GROW:
                         old = self.codec.shape.MAX_MSGS
-                        drain()
+                        spill()
                         self._build(old * 2)
                         obs.grow("message_table",
                                  self.codec.shape.MAX_MSGS)
@@ -308,6 +342,8 @@ class PagedBFS(DeviceBFS):
                             self.next_cap, self._total_E() + self.tile)
                         bufs = self._alloc_bufs(self.next_cap)
                         put_chunk()     # same chunk, re-enter at start_t
+                        pend_t = jnp.asarray(start_t, I32)
+                        pend_nn = jnp.asarray(0, I32)
                         emit(f"message table grown to "
                              f"{self.codec.shape.MAX_MSGS} slots "
                              f"(recompiling)")
@@ -325,9 +361,10 @@ class PagedBFS(DeviceBFS):
                             donate_argnums=(0, 4, 5, 6, 7))
                         self._fresh_jit = True
                         if self.next_cap < self._total_E() + self.tile:
-                            drain()
+                            spill()
                             self.next_cap = self._total_E() + self.tile
                             bufs = self._alloc_bufs(self.next_cap)
+                            pend_nn = jnp.asarray(0, I32)
                         obs.grow("expand_buffer", self.expand_mults[aid])
                         emit(f"expand buffer for "
                              f"{self.kern.action_names[aid]} grown to "
@@ -352,14 +389,16 @@ class PagedBFS(DeviceBFS):
                         res.diameter = depth
                         return self._finish(res, obs, fp_count,
                                             table=table, fp_cap=fp_cap)
-
+                    # growth pauses fall through here; terminal reasons
+                    # returned above
                     obs.progress(depth=depth, distinct=fp_count,
                                  generated=res.states_generated,
                                  frontier=n_front, extra="host-paged")
                     if max_seconds and time.time() - t0 > max_seconds:
                         stop = f"time budget {max_seconds}s reached"
+                        break
                 # chunk done (or stopped): spill whatever accumulated
-                drain()
+                spill()
                 chunk_start += n_c
 
             # ---- level complete: assemble next frontier on host ------

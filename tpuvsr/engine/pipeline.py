@@ -1,0 +1,140 @@
+"""Pipelined dispatch window shared by the BFS engines (ISSUE 4).
+
+The synchronous engine loops ran ``dispatch -> block_until_ready ->
+device_get(control scalars) -> handle`` — the device idled through a
+full host round-trip (plus journal/metrics/spill bookkeeping) after
+EVERY level-kernel dispatch, which on a tunneled TPU is most of the
+runtime (BENCH_r05: ~1,348 distinct/s shipped-pin, ~917 distinct/s on
+the RR05 deep run, both host-sync bound).  This module keeps a bounded
+window of K dispatches in flight instead:
+
+* **launch** enqueues a dispatch and returns its (asynchronous) output
+  structure immediately; the engine chains the control scalars the
+  next dispatch needs (``start_t`` / ``nn`` for the level kernel)
+  straight off that structure as DEVICE arrays, so filling the window
+  costs zero host syncs;
+* **collect** blocks on the OLDEST in-flight dispatch only, then pulls
+  its control scalars; the host handles them (journal, metrics,
+  growth decisions, spill compaction, checkpoint staging) while the
+  K-1 newer dispatches keep the device busy;
+* **drain** discards every still-in-flight ticket without accumulating
+  its deltas.  That is SAFE and exact because of the level kernel's
+  pause protocol: a dispatch chained after a paused one re-attempts
+  the same tile, commits nothing, and re-fails identically (committed
+  lanes dedup against the FPSet), and a dispatch chained after the
+  level's last tile is an empty while_loop that passes its buffers
+  through untouched.  So the tickets behind a pause, a stop, or a
+  level end are replays/no-ops whose host-visible deltas must NOT be
+  double-counted — dropping them keeps counts, level sizes and traces
+  bit-identical to the synchronous (K=1) path.
+
+Window semantics: ``window=1`` reproduces today's behavior exactly,
+including the phase accounting (the dispatch blocks inside the
+``dispatch``/``compile`` timer, the scalar pull inside ``host_sync``).
+With ``window>1`` the enqueue cost lands in ``dispatch``/``compile``,
+the blocking wait on the oldest ticket in a new ``inflight`` phase,
+and the scalar pull in ``host_sync`` — the phases stay disjoint and
+still sum to the run's wall-clock (tpuvsr/obs/SCHEMA.md).  The
+``overlap_saved_s`` gauge reports host time spent OUTSIDE pipeline
+calls while at least one dispatch was in flight — the work the window
+actually hid behind device compute.
+
+Drained-but-unconsumed replay dispatches still run on device (they
+were already enqueued); their FPSet inserts are idempotent, so only
+the end-of-run occupancy gauge can read marginally high after a
+time-budget stop.  Engines drain the window at every level boundary,
+so rescue checkpoints (resilience supervisor / PreemptionGuard) never
+race an in-flight dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+
+class DispatchPipeline:
+    """A bounded window of in-flight jitted dispatches.
+
+    ``ready(out)`` must return a device array of the dispatch output to
+    block on (the control-scalar leaf every engine already syncs on).
+    One instance rides one engine run; the window gauges
+    (``pipeline_depth``, ``overlap_saved_s``) are stamped on the
+    observer incrementally, so every engine return path sees them.
+    """
+
+    def __init__(self, window, obs, ready):
+        self.window = max(1, int(window))
+        self.obs = obs
+        self._ready = ready
+        self._q = deque()            # (out, enqueue perf_counter)
+        self._overlap = 0.0          # host-work seconds hidden by the window
+        self._free_since = None      # host running free with work in flight
+        # gauges are stamped incrementally (last-write-wins) so the
+        # run's metrics document carries them no matter which engine
+        # return path finalizes the observer first
+        obs.gauge("pipeline_depth", self.window)
+
+    @property
+    def in_flight(self):
+        return len(self._q)
+
+    def has_room(self):
+        return len(self._q) < self.window
+
+    def launch(self, fn, *args, fresh=False, label=""):
+        """Enqueue ``fn(*args)``; returns the (async) output structure.
+
+        The first dispatch after a (re)jit compiles synchronously at
+        call time and is charged to the ``compile`` phase; at window 1
+        the dispatch also blocks to completion here (synchronous-path
+        parity)."""
+        obs = self.obs
+        # host work done since the last pipeline call counts as
+        # overlapped when something was in flight through it (the
+        # collect->handle->launch span is where the hidden work lives)
+        self._credit_overlap()
+        with obs.timer("compile" if fresh else "dispatch"), \
+                obs.annotate(label):
+            out = fn(*args)
+            if self.window == 1:
+                self._ready(out).block_until_ready()
+        obs.count("dispatches")
+        self._q.append((out, time.perf_counter()))
+        self._free_since = time.perf_counter()
+        return out
+
+    def _credit_overlap(self):
+        if self._free_since is None:
+            return
+        self._overlap += time.perf_counter() - self._free_since
+        self._free_since = None
+        if self.window > 1:
+            self.obs.gauge("overlap_saved_s", round(self._overlap, 6))
+
+    def collect(self, pull):
+        """Block on the OLDEST in-flight dispatch, pull its control
+        scalars with ``pull(out)``, and return ``(out, scalars)``."""
+        out, _t_push = self._q.popleft()
+        obs = self.obs
+        self._credit_overlap()
+        if self.window > 1:
+            with obs.timer("inflight"):
+                self._ready(out).block_until_ready()
+        with obs.timer("host_sync"):
+            sc = pull(out)
+        if self._q:
+            self._free_since = time.perf_counter()
+        return out, sc
+
+    def drain(self):
+        """Discard every still-in-flight ticket (see module docstring:
+        everything behind a pause, stop, or level end is a replay/no-op
+        whose deltas must not be re-counted).  Returns the number of
+        tickets dropped."""
+        n = len(self._q)
+        if n:
+            self.obs.count("pipeline_replays", n)
+            self._q.clear()
+        self._free_since = None
+        return n

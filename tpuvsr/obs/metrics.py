@@ -37,8 +37,11 @@ METRICS_SCHEMA = "tpuvsr-metrics/1"
 
 # phase names every engine uses where applicable; other names are
 # allowed (liveness uses graph_build/scc) but these are the canonical
-# cross-engine vocabulary
-WELL_KNOWN_PHASES = ("check", "compile", "dispatch", "host_sync")
+# cross-engine vocabulary.  "inflight" is the pipelined engines'
+# blocked wait on the oldest in-flight dispatch (ISSUE 4) — zero on
+# synchronous (-pipeline 1) runs.
+WELL_KNOWN_PHASES = ("check", "compile", "dispatch", "host_sync",
+                     "inflight")
 
 # keys a metrics document must carry to be schema-valid
 REQUIRED_METRICS_KEYS = ("schema", "run_id", "engine", "elapsed_s",

@@ -75,6 +75,11 @@ class RunObserver:
         self.engine = None
         self.module = None
         self.backend = None
+        # dispatch-window depth (ISSUE 4): engines with a pipelined
+        # dispatch loop set this before start(); every run_start
+        # carries it (1 = synchronous) so journals stay key-set
+        # uniform across engines
+        self.pipeline = 1
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -143,7 +148,7 @@ class RunObserver:
         self.journal.write("run_start", schema=JOURNAL_SCHEMA,
                            engine=self.engine, module=self.module,
                            backend=self.backend, resumed=bool(resumed),
-                           **extra)
+                           pipeline=int(self.pipeline or 1), **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")

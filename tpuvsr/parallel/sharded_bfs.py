@@ -137,9 +137,10 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                        check_deadlock: bool = False):
     """Build the jitted one-tile sharded BFS step.
 
-    step(tables, frontier, n_front, nb, nbp, nba, nbprm, nn, base_gid)
-      -> (tables, nb, nbp, nba, nbprm, nn, reason, viol, gen, dist,
-          fatal)
+    step(tables, frontier, n_front, start_t, nb, nbp, nba, nbprm, nn,
+         base_gid)
+      -> (tables, nb, nbp, nba, nbprm, nn, t, reason, viol, gen, sent,
+          dead, act)
     Every array is sharded over `axis`; scalars come back as [D] arrays
     (one per device; identical where globally agreed).  With
     ``check_deadlock`` a frontier state with no enabled successor
@@ -148,6 +149,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
+    n_act = len(kern.action_names)
     lane_aid = jnp.asarray(kern.lane_action)
     lane_prm = jnp.asarray(kern.lane_param)
     from ..models.vsr import ERR_BAG_OVERFLOW
@@ -186,11 +188,15 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             bag_err = ((errv & ERR_BAG_OVERFLOW) != 0).any()
             slot_err = ((errv & ~ERR_BAG_OVERFLOW) != 0).any()
 
-            # first violating lane, as (parent gid, action, param)
+            # first violating lane, as (parent gid, action, param).
+            # flat successor index i is state-major ([T, L] reshaped),
+            # so the lane tables (length L) are indexed by i % L — a
+            # bare lane_aid[i] silently CLAMPS for i >= L and records
+            # the wrong action/param in the trace metadata
             vidx = jnp.argmax(viol_l)
             vinfo = jnp.stack([
                 base_gid[0] + base + (vidx // L).astype(jnp.int32),
-                lane_aid[vidx], lane_prm[vidx]])
+                lane_aid[vidx % L], lane_prm[vidx % L]])
             viol = jnp.where(viol_l.any() & (c["viol"][0] < 0), vinfo,
                              c["viol"])
 
@@ -199,8 +205,8 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             fps_s = fps[perm]
             owner = (route(fps_s) % jnp.uint32(n_dev)).astype(jnp.int32)
             meta_p = base_gid[0] + (perm // L).astype(jnp.int32) + base
-            meta_a = lane_aid[perm]
-            meta_m = lane_prm[perm]
+            meta_a = lane_aid[perm % L]
+            meta_m = lane_prm[perm % L]
 
             cap = bucket_cap
             b_fps = jnp.zeros((n_dev, cap, 4), U32)
@@ -295,6 +301,11 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                                                         RUNNING))))))
             reason = jnp.where((reason == RUNNING) & g_povf,
                                R_FPSET_GROW, reason)
+            # per-action expansion counters (ISSUE 4 satellite): same
+            # commit gating as `gen`, so shard-summed act == gen
+            act_seg = jax.ops.segment_sum(
+                en_f.astype(jnp.uint32), jnp.tile(lane_aid, T),
+                num_segments=n_act)
             return {
                 "t": jnp.where(commit & ~g_povf, t + 1, t),
                 "reason": jnp.where(c["reason"] == RUNNING, reason,
@@ -304,6 +315,8 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": nn + jnp.where(commit, n_fresh, 0),
                 "gen": c["gen"] + jnp.where(commit & ~g_povf, n_en, 0),
+                "act": c["act"] + jnp.where(commit & ~g_povf, act_seg,
+                                            jnp.uint32(0)),
                 # exchange-occupancy metric: useful bucket rows this
                 # device shipped (the wire moves full static buckets)
                 "sent": c["sent"] + jnp.where(
@@ -319,6 +332,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
             "nn": nn0[0],
             "gen": jnp.asarray(0, jnp.int32),
+            "act": jnp.zeros((n_act,), jnp.uint32),
             "sent": jnp.asarray(0, jnp.int32),
         }
         out = jax.lax.while_loop(cond, body, init)
@@ -327,13 +341,13 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 out["nb"], out["nbp"], out["nba"], out["nbprm"],
                 one(out["nn"]), one(out["t"]), one(out["reason"]),
                 out["viol"][None], one(out["gen"]), one(out["sent"]),
-                one(out["dead"]))
+                one(out["dead"]), out["act"][None])
 
     sp = P(axis)
     step = jax.jit(_shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 12))
+        out_specs=(sp,) * 13))
     return step
 
 
@@ -349,12 +363,21 @@ class ShardedBFS:
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=None, next_capacity=1 << 12,
                  fpset_capacity=1 << 14, check_deadlock=False,
-                 model_factory=None):
+                 model_factory=None, pipeline=1):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
+        # dispatch-window depth (ISSUE 4; 1 = synchronous).  Unlike
+        # the device/paged engines (default 2), the sharded window is
+        # OPT-IN: the step is one whole-level attempt (overlap covers
+        # only pause handling and boundary bookkeeping) and its jit
+        # has no buffer donation, so K>1 keeps K generations of the
+        # FPSet shards + frontier alive in HBM — a real cost on the
+        # capacity-bound runs this engine exists for.  Semantics are
+        # identical at every K (tests/test_pipeline.py).
+        self.pipe_window = max(1, int(pipeline))
         # model_factory(spec, max_msgs=..) -> (codec, kernel); default
         # is the hand-kernel registry (DeviceBFS parity — tests drive
         # the driver with stub kernels through this hook)
@@ -449,7 +472,10 @@ class ShardedBFS:
         preflight(self.spec, log=log)   # fail fast, before any dispatch
         obs = RunObserver.ensure(obs, "sharded", self.spec, log=log,
                                  progress_every=progress_every)
+        obs.pipeline = self.pipe_window
         self._obs_active = obs          # closes_observer finalizes it
+        self._act_counts = np.zeros(len(self.kern.action_names),
+                                    np.int64)
         # multi-process: every rank collects, only host 0 writes the
         # journal / metrics file / stats table (per-shard numbers are
         # reduced host-side before they reach the collector)
@@ -653,6 +679,35 @@ class ShardedBFS:
         else:
             def agree(flag):
                 return bool(flag)
+        # pipelined dispatch window (ISSUE 4): the sharded step is one
+        # whole-level attempt, chained on its own outputs; the host
+        # blocks only on the oldest in-flight step's reason.  Replays
+        # behind a pause commit nothing (every sharded abort is a
+        # pre-commit vote), so pipe.drain() discarding them keeps
+        # counts/levels/traces identical to -pipeline 1.
+        from ..engine.pipeline import DispatchPipeline
+        pipe = DispatchPipeline(self.pipe_window, obs,
+                                ready=lambda o: o[7])
+
+        pack_scalars = jax.jit(
+            lambda r, s, g, a: jnp.concatenate(
+                [r[:, None], s[:, None], g[:, None],
+                 a.astype(jnp.int32)], axis=1))
+
+        def pull(o):
+            # ONE replication pull for all per-dispatch control
+            # scalars — separate _pull calls cost one collective (a
+            # tunnel RTT on a remote TPU) EACH; pack [D] reason/sent/
+            # gen and the [D, A] act counters into a single [D, 3+A]
+            # array first
+            packed = np.asarray(self._pull(
+                pack_scalars(o[7], o[10], o[9], o[12])), np.int64)
+            reason = int(packed[0, 0])
+            sent = int(packed[:, 1].sum())
+            gen = int(packed[:, 2].sum())
+            act = packed[:, 3:].sum(axis=0)
+            return reason, sent, gen, act
+
         while True:
             with obs.timer("host_sync"):
                 front_total = int(self._pull(n_front).sum())
@@ -668,41 +723,47 @@ class ShardedBFS:
             start_t = self._put(np.zeros(D, np.int32))
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
-                # injected transient exchange failure: journal it and
-                # re-issue the level step — the pause/re-enter protocol
-                # makes the retry lossless (committed lanes just dedup).
-                # shard matching is per HOST process: single-process
-                # meshes drive every shard, so any armed shard fires
-                # (shard=None context matches all)
-                try:
-                    fault_point("exchange", depth=depth,
-                                shard=(jax.process_index()
-                                       if jax.process_count() > 1
-                                       else None), obs=obs)
-                except InjectedExchangeDrop:
-                    obs.retry(attempt=1, backoff_s=0.0, what="exchange")
-                    emit(f"exchange drop at level {depth}: "
-                         f"re-issuing the level step")
-                    continue
-                phase = "compile" if self._fresh_jit else "dispatch"
-                with obs.timer(phase), obs.annotate(
-                        f"level {depth} {phase}"):
-                    (tables, nb, nbp, nba, nbprm, nn, t_out, reason_out,
-                     viol_out, gen_out, sent_out, dead_out) = self._step(
-                        tables, front, n_front, start_t,
-                        nb, nbp, nba, nbprm, nn, base_gid)
-                    reason_out.block_until_ready()
-                self._fresh_jit = False
-                obs.count("dispatches")
-                with obs.timer("host_sync"):
-                    reason = int(self._pull(reason_out)[0])
-                    sent = int(self._pull(sent_out).sum())
+                while pipe.has_room():
+                    # injected transient exchange failure: journal it
+                    # and re-issue the level step — the pause/re-enter
+                    # protocol makes the retry lossless (committed
+                    # lanes just dedup).  shard matching is per HOST
+                    # process: single-process meshes drive every shard,
+                    # so any armed shard fires (shard=None matches all)
+                    try:
+                        fault_point("exchange", depth=depth,
+                                    shard=(jax.process_index()
+                                           if jax.process_count() > 1
+                                           else None), obs=obs)
+                    except InjectedExchangeDrop:
+                        obs.retry(attempt=1, backoff_s=0.0,
+                                  what="exchange")
+                        emit(f"exchange drop at level {depth}: "
+                             f"re-issuing the level step")
+                        continue
+                    out = pipe.launch(
+                        self._step, tables, front, n_front, start_t,
+                        nb, nbp, nba, nbprm, nn, base_gid,
+                        fresh=self._fresh_jit,
+                        label=f"level {depth} dispatch")
+                    self._fresh_jit = False
+                    (tables, nb, nbp, nba, nbprm, nn,
+                     start_t) = out[:7]
+                out, sc = pipe.collect(pull)
+                reason, sent, gen_add, act_add = sc
                 exch_rows_useful += sent
                 exch_bytes_useful += sent * _row_bytes()
-                start_t = t_out
+                # generated is accumulated per dispatch attempt (a
+                # paused attempt's committed tiles count once; its
+                # replays in the window are discarded by drain())
+                res.states_generated += gen_add
+                self._act_counts += act_add
                 if reason == RUNNING:
+                    pipe.drain()     # trailing tickets are no-ops
                     break
+                pipe.drain()         # trailing tickets replay the pause
                 if reason == R_VIOLATION:
+                    viol_out = out[8]
                     vrows = self._pull(viol_out)
                     sel = vrows[vrows[:, 0] >= 0][0]
                     gid, va, vprm = (int(x) for x in sel)
@@ -724,7 +785,7 @@ class ShardedBFS:
                         "dense-layout slot collision in sharded BFS "
                         "(see models/vsr.py docstring)")
                 if reason == R_DEADLOCK:
-                    dd = self._pull(dead_out)
+                    dd = self._pull(out[11])
                     d = int(np.nonzero(dd >= 0)[0][0])
                     di = int(dd[d])
                     gid = int(base_dev[d]) + di
@@ -798,14 +859,13 @@ class ShardedBFS:
                     raise TLAError(f"unknown sharded reason {reason}")
 
             # committed tiles this level x full static bucket volume
+            # (generated was already accumulated per dispatch attempt)
             with obs.timer("host_sync"):
                 wire = (int(self._pull(start_t).max())
                         * D * D * self.bucket_cap)
                 exch_rows_wire += wire
                 exch_bytes_wire += wire * _row_bytes()
                 nn_h = self._pull(nn)
-                gen_h = int(self._pull(gen_out).sum())
-            res.states_generated += gen_h
             n_next = int(nn_h.sum())
             fp_count += n_next
             obs.level_done(depth, frontier=front_total,
@@ -918,6 +978,11 @@ class ShardedBFS:
             # rank that writes the metrics file / journal)
             obs.gauge("shard_distinct",
                       [int(x) for x in self._dev_distinct])
+        acts = getattr(self, "_act_counts", None)
+        if acts is not None:
+            obs.gauge("action_expansions",
+                      {n: int(c) for n, c in
+                       zip(self.kern.action_names, acts)})
         return obs.finish(res,
                           levels=getattr(self, "level_sizes", None))
 

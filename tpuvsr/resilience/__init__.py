@@ -4,7 +4,8 @@ Three pieces (ISSUE 3 tentpole):
 
 * **fault injection** (``faults.py``) — ``TPUVSR_FAULT`` / CLI
   ``-inject`` specs (``oom@level=3``, ``kill@level=5``,
-  ``corrupt-ckpt:frontier.npz``, ``exchange-drop@shard=0``) fire
+  ``corrupt-ckpt:frontier.npz``, ``garble-ckpt:fpset.npz``,
+  ``exchange-drop@shard=0``) fire
   deterministically inside the real engine loops and the checkpoint
   writer, so every recovery path below is tier-1-testable;
 * **supervised run loop** (``supervisor.py``) — catches

@@ -25,6 +25,13 @@ comma-separated, parameters attached with ``@key=value``):
                                    (the crash window the fallback path
                                    exists for); ``@level=N`` pins it to
                                    the level-N snapshot
+    garble-ckpt:fpset.npz          like corrupt-ckpt, but the payload
+                                   is garbled IN PLACE (a byte span
+                                   mid-file XOR-flipped, size
+                                   preserved) — a torn/bit-rotted
+                                   write only the manifest CRC32 can
+                                   catch, exercising the CRC verify
+                                   path directly (ISSUE 4 satellite)
     exchange-drop@shard=0          one transient exchange failure in the
                                    sharded engine (journaled, step
                                    re-issued); ``@level=N`` pins a
@@ -50,8 +57,12 @@ KIND_SITE = {
     "oom": "level",
     "kill": "level",
     "corrupt-ckpt": "checkpoint",
+    "garble-ckpt": "checkpoint",
     "exchange-drop": "exchange",
 }
+
+# checkpoint-site kinds that need a payload file name
+_CKPT_KINDS = ("corrupt-ckpt", "garble-ckpt")
 
 _ENTRY_RE = re.compile(
     r"^(?P<kind>[a-z][a-z-]*)"
@@ -130,10 +141,10 @@ def parse_fault(entry):
         kw[key] = int(val)
     if m.group("arg"):
         kw["payload"] = m.group("arg")
-    if kind == "corrupt-ckpt" and "payload" not in kw:
+    if kind in _CKPT_KINDS and "payload" not in kw:
         raise ValueError(
-            f"{entry!r}: corrupt-ckpt needs a payload file name "
-            f"(e.g. corrupt-ckpt:frontier.npz)")
+            f"{entry!r}: {kind} needs a payload file name "
+            f"(e.g. {kind}:frontier.npz)")
     return Fault(kind, **kw)
 
 
@@ -162,8 +173,10 @@ class FaultPlan:
                              turns that into checkpoint-and-exit; with
                              no handler installed the process dies —
                              raw preemption)
-        * ``corrupt-ckpt``   returns the payload name for the caller
-                             (the checkpoint writer) to corrupt
+        * ``corrupt-ckpt``/``garble-ckpt``
+                             returns the Fault itself; the caller (the
+                             checkpoint writer) truncates or garbles
+                             its ``payload`` per ``kind``
         * ``exchange-drop``  raises InjectedExchangeDrop
 
         Returns None when nothing fired."""
@@ -187,8 +200,8 @@ class FaultPlan:
             if f.kind == "kill":
                 os.kill(os.getpid(), signal.SIGTERM)
                 return f.kind
-            if f.kind == "corrupt-ckpt":
-                return f.payload
+            if f.kind in _CKPT_KINDS:
+                return f
             if f.kind == "exchange-drop":
                 raise InjectedExchangeDrop(
                     f"injected exchange drop at level {depth} "

@@ -156,13 +156,20 @@ class Supervisor:
                  metrics_path=None, log=None, tile_size=128,
                  min_tile=DEFAULT_MIN_TILE, max_retries=6,
                  backoff_base=0.5, backoff_cap=30.0,
-                 engine_kwargs=None, engine_factory=None,
+                 engine_kwargs=None, engine_factory=None, fused=False,
                  sleep=time.sleep):
         if engine not in ("device", "paged"):
             raise ValueError(f"Supervisor supervises the device/paged "
                              f"engines, not {engine!r}")
         self.spec = spec
         self.kind = engine
+        # fused=True: first attempt runs the fused fixpoint with its
+        # dispatch bounded to a rescue quantum (run_fused checkpoint
+        # mode, ISSUE 4 satellite); any retry that has a snapshot to
+        # resume from continues through the chunked engine (the fused
+        # pass has no resume path) — journaled as a mode degrade
+        self.fused = bool(fused)
+        self._fused_degraded = False
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
         self.journal_path = journal_path
@@ -202,7 +209,7 @@ class Supervisor:
 
     def summary(self):
         return {"attempts": self.attempts, "engine": self.kind,
-                "tile": self.tile,
+                "tile": self.tile, "fused": self.fused,
                 "degrades": [list(d) for d in self.degrades]}
 
     # ------------------------------------------------------------------
@@ -217,7 +224,28 @@ class Supervisor:
                     obs = RunObserver(journal_path=self.journal_path,
                                       metrics_path=self.metrics_path,
                                       log=self._log)
+                    use_fused = self.fused and self.kind == "device"
+                    if use_fused and resume is not None \
+                            and not self._fused_degraded:
+                        self._fused_degraded = True
+                        self.degrades.append(("mode", "fused",
+                                              "chunked"))
+                        self._jwrite("degrade", what="mode",
+                                     **{"from": "fused",
+                                        "to": "chunked"})
+                        self.log("resuming from a snapshot: the fused "
+                                 "pass has no resume path; continuing "
+                                 "through the chunked engine")
                     try:
+                        if use_fused and resume is None:
+                            return self.engine.run_fused(
+                                max_states=max_states,
+                                max_depth=max_depth,
+                                max_seconds=max_seconds,
+                                check_deadlock=check_deadlock,
+                                checkpoint_path=self.checkpoint_path,
+                                checkpoint_every=self.checkpoint_every,
+                                obs=obs, log=self._log, **run_kwargs)
                         return self.engine.run(
                             max_states=max_states, max_depth=max_depth,
                             max_seconds=max_seconds,

@@ -53,13 +53,23 @@ STUB_DISTINCT = 16
 STUB_LEVELS = [1, 2, 3, 4, 3, 2, 1]
 
 
-def counter_spec():
-    """The inline two-counter spec (16 states, diameter 6)."""
-    return SpecModel(parse_module_text(COUNTER),
+def counter_spec(inv_bound=None):
+    """The inline two-counter spec (16 states, diameter 6).
+
+    With ``inv_bound`` the Bound invariant tightens to
+    ``x + y <= inv_bound`` — reachable violations for bounds < 6, so
+    engine violation/trace paths are testable without the reference
+    (pair with ``stub_model_factory(inv_bound=...)`` so the device
+    kernel's invariant agrees with the interpreter's)."""
+    src = COUNTER
+    if inv_bound is not None:
+        src = src.replace("Bound == x + y <= 2 * Limit",
+                          f"Bound == x + y <= {int(inv_bound)}")
+    return SpecModel(parse_module_text(src),
                      parse_cfg_text(COUNTER_CFG))
 
 
-def stub_model_factory(limit=3):
+def stub_model_factory(limit=3, inv_bound=None):
     """A ``model_factory`` producing a (codec, kernel) pair for the
     counter spec — drives the real device engines with no reference
     kernel registered."""
@@ -137,25 +147,30 @@ def stub_model_factory(limit=3):
             return jax.vmap(self.fingerprint)(arr)
 
         def invariant_fn(self, names):
-            return lambda st: jnp.asarray(True)
+            if inv_bound is None:
+                return lambda st: jnp.asarray(True)
+            return lambda st: st["x"] + st["y"] <= inv_bound
 
     return lambda spec, max_msgs=None: (StubCodec(), StubKern())
 
 
-def stub_device_engine(cls=None, spec=None, **kw):
+def stub_device_engine(cls=None, spec=None, inv_bound=None, **kw):
     """A small DeviceBFS (or `cls`) instance over the counter spec and
-    the stub kernel — the standard harness for engine-loop tests."""
+    the stub kernel — the standard harness for engine-loop tests.
+    Extra keywords (``pipeline=...``, ``chunk_tiles=...``) reach the
+    engine constructor."""
     from .engine.device_bfs import DeviceBFS
     cls = cls or DeviceBFS
-    return cls(spec or counter_spec(), model_factory=stub_model_factory(),
+    return cls(spec or counter_spec(inv_bound),
+               model_factory=stub_model_factory(inv_bound=inv_bound),
                hash_mode="full", tile_size=kw.pop("tile_size", 4),
                fpset_capacity=1 << 8, next_capacity=1 << 6, **kw)
 
 
-def stub_engine_factory(spec):
+def stub_engine_factory(spec, **engine_kw):
     """A ``Supervisor`` engine factory over the stub kernel: builds the
     device or paged engine at the requested tile (the degrade ladder's
-    knob) on `spec`."""
+    knob) on `spec`; `engine_kw` (e.g. ``pipeline=2``) is forwarded."""
     from .engine.device_bfs import DeviceBFS
     from .engine.paged_bfs import PagedBFS
 
@@ -163,5 +178,6 @@ def stub_engine_factory(spec):
         cls = PagedBFS if kind == "paged" else DeviceBFS
         return cls(spec, model_factory=stub_model_factory(),
                    hash_mode="full", tile_size=tile,
-                   fpset_capacity=1 << 8, next_capacity=1 << 6)
+                   fpset_capacity=1 << 8, next_capacity=1 << 6,
+                   **engine_kw)
     return make
