@@ -219,6 +219,30 @@ def main():
     RESULT["compile_s"] = round(compile_s, 1)
     print(f"bench: compile+warmup {compile_s:.1f}s", file=sys.stderr)
 
+    # BENCH_SUPERVISE=1: run the timed headline through the resilience
+    # supervisor (ISSUE 5 satellite) so a real TPU OOM degrades through
+    # the tile ladder (and the paged fallback) instead of killing the
+    # round; the supervisor outcome (attempts, degrades list,
+    # resharded-from) lands in the round doc as RESULT["supervisor"]
+    if os.environ.get("BENCH_SUPERVISE", "0") == "1" and not fused:
+        from tpuvsr.engine.paged_bfs import PagedBFS
+        from tpuvsr.resilience.supervisor import Supervisor
+        sup = Supervisor(
+            spec, engine="device", tile_size=tile,
+            engine_factory=lambda kind, t:
+                (PagedBFS if kind == "paged" else DeviceBFS)(
+                    spec, tile_size=t, fpset_capacity=1 << 21,
+                    next_capacity=1 << 15, expand_mult=2,
+                    expand_mults={"ReceiveMatchingSVC": 4,
+                                  "SendDVC": 4}),
+            log=lambda m: print(f"bench: {m}", file=sys.stderr))
+
+        def runner(**kw):
+            kw.pop("log", None)     # the supervisor logs through its own
+            r = sup.run(**kw)
+            RESULT["supervisor"] = sup.summary()
+            return r
+
     RESULT["phase"] = "device-bfs"
     t0 = time.time()
     res = runner(max_seconds=max(30.0, DEADLINE - time.time()),
@@ -265,6 +289,13 @@ def main():
         # directly diffable via scripts/compare_bench.py
         "metrics": res.metrics,
     })
+    # supervisor outcome + mesh identity (ISSUE 5): degrades list and
+    # resharded-from make a degraded/resharded round self-describing;
+    # compare_bench treats mesh-size mismatches as advisory
+    g = (res.metrics or {}).get("gauges", {})
+    RESULT.setdefault("supervisor", None)
+    RESULT["mesh_devices"] = g.get("mesh_devices")
+    RESULT["resharded_from"] = g.get("resharded_from")
     # second timed run on the same engine: separates machine noise from
     # real throughput (VERDICT r3 item 8 asked the r2->r3 CPU drop be
     # explained with two runs; the identified cause — the CP06 header

@@ -99,9 +99,13 @@ def main(argv=None):
 
     # pipeline-depth mismatch (ISSUE 4): a -pipeline 1 doc vs a
     # -pipeline 2 doc measures a different dispatch regime, not a
-    # regression — downgrade any verdict to advisory
+    # regression — downgrade any verdict to advisory.  Mesh-size
+    # mismatch (ISSUE 5) likewise: a supervised sharded round that
+    # degraded to a smaller mesh (or resharded a snapshot) measures
+    # different hardware, not a code regression
     bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
     pipe_mismatch = False
+    mesh_mismatch = False
     if bm and cm:
         bp = bm.get("gauges", {}).get("pipeline_depth")
         cp = cm.get("gauges", {}).get("pipeline_depth")
@@ -109,6 +113,12 @@ def main(argv=None):
             pipe_mismatch = True
             print(f"  pipeline_depth: {bp} -> {cp} (different dispatch"
                   f" windows — comparison is advisory)")
+        bmesh = bm.get("gauges", {}).get("mesh_devices")
+        cmesh = cm.get("gauges", {}).get("mesh_devices")
+        if bmesh is not None and cmesh is not None and bmesh != cmesh:
+            mesh_mismatch = True
+            print(f"  mesh_devices: {bmesh} -> {cmesh} (different "
+                  f"mesh sizes — comparison is advisory)")
 
     # context: phase-timer and counter drift between the documents
     if bm and cm:
@@ -131,10 +141,12 @@ def main(argv=None):
                   f" — throughput comparison may be meaningless)")
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
-        if pipe_mismatch:
+        if pipe_mismatch or mesh_mismatch:
+            what = ("pipeline depths" if pipe_mismatch
+                    else "mesh sizes")
             print(f"compare_bench: drop beyond "
                   f"{args.max_regression:.1f}% tolerance, but the "
-                  f"documents ran different pipeline depths — "
+                  f"documents ran different {what} — "
                   f"advisory, not a regression", file=sys.stderr)
             return 0
         print(f"compare_bench: REGRESSION beyond "

@@ -22,6 +22,17 @@ reference mount, no TPU, seconds on the CPU backend:
                      fallback, resumed run reaches the fixpoint
   exchange-drop      transient sharded-exchange failure -> journaled
                      retry, level step re-issued, exact fixpoint
+  exchange-drop-retry persistent exchange-drop:3 -> three journaled
+                     retries with exponential backoff, then the level
+                     step goes through; exact fixpoint (and a drop
+                     count beyond the budget fails loudly)
+  oom-mesh-degrade   injected OOM on a supervised SHARDED run at the
+                     tile floor -> mesh shrink 4 -> 2 devices, elastic
+                     resume re-hash-partitions the snapshot, exact
+                     fixpoint (ISSUE 5 mesh degrade ladder)
+  kill-elastic-resume injected SIGTERM on a 4-device sharded run ->
+                     rescue checkpoint; resumed on a 2-device mesh ->
+                     journaled reshard, exact fixpoint
   pipeline-faults    oom + kill injected into -pipeline 4 runs ->
                      the dispatch window drains, the supervisor/rescue
                      paths behave exactly as at -pipeline 1
@@ -292,6 +303,121 @@ def scenario_exchange_drop(tmp):
     }
 
 
+def scenario_exchange_drop_retry(tmp):
+    """Persistent exchange-drop:3 (a flaky ICI link): three journaled
+    retries with exponential backoff, then the level step goes
+    through — the exact fixpoint either way (ISSUE 5)."""
+    ORACLE = _oracle()
+    import jax
+    if len(jax.devices()) < 2:
+        return {"ok": True, "skipped": "needs 2 virtual devices"}
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.testing import stub_sharded_engine
+    jp = os.path.join(tmp, "xretry.jsonl")
+    faults.install("exchange-drop:3@shard=0@level=2")
+    try:
+        eng = stub_sharded_engine(n_devices=2, sleep=lambda s: None)
+        res = eng.run(obs=RunObserver(journal_path=jp))
+    finally:
+        faults.clear()
+    retries = [e for e in read_journal(jp) if e["event"] == "retry"]
+    backoffs = [e["backoff_s"] for e in retries]
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"]
+               and [e["attempt"] for e in retries] == [1, 2, 3]
+               and all(e.get("what") == "exchange" for e in retries)
+               and backoffs == sorted(backoffs)),
+        "retries": [(e["attempt"], e["backoff_s"]) for e in retries],
+        "distinct": res.distinct_states,
+    }
+
+
+def scenario_oom_mesh_degrade(tmp):
+    """Supervised sharded run, injected OOM at the tile floor: the
+    mesh degrade ladder shrinks 4 -> 2 devices and the elastic resume
+    re-hash-partitions the snapshot — exact fixpoint (ISSUE 5)."""
+    ORACLE = _oracle()
+    import jax
+    if len(jax.devices()) < 4:
+        return {"ok": True, "skipped": "needs 4 virtual devices"}
+    from tpuvsr.obs import read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import Supervisor
+    from tpuvsr.testing import counter_spec, stub_sharded_factory
+    spec = counter_spec()
+    jp = os.path.join(tmp, "mesh.jsonl")
+    faults.install("oom@level=3")
+    try:
+        sup = Supervisor(spec, engine="sharded", mesh_devices=4,
+                         checkpoint_path=os.path.join(tmp, "ck"),
+                         journal_path=jp,
+                         engine_factory=stub_sharded_factory(spec),
+                         tile_size=4, min_tile=4, backoff_base=0.0,
+                         sleep=lambda s: None)
+        res = sup.run()
+    finally:
+        faults.clear()
+    ev = [e["event"] for e in read_journal(jp)]
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"]
+               and ("mesh", 4, 2) in sup.degrades
+               and sup.summary()["resharded_from"] == 4
+               and "degrade" in ev and "retry" in ev
+               and "reshard" in ev),
+        "degrades": sup.degrades, "mesh_devices": sup.n_dev,
+        "distinct": res.distinct_states,
+    }
+
+
+def scenario_kill_elastic_resume(tmp):
+    """SIGTERM on a 4-device sharded run -> rescue checkpoint; the
+    resume comes back on HALF the mesh (a lost pod slice) and the
+    snapshot is re-hash-partitioned at load — exact fixpoint, reshard
+    journaled (ISSUE 5)."""
+    ORACLE = _oracle()
+    import jax
+    if len(jax.devices()) < 4:
+        return {"ok": True, "skipped": "needs 4 virtual devices"}
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_sharded_engine
+    ck = os.path.join(tmp, "kill-ck")
+    jp = os.path.join(tmp, "kill.jsonl")
+    faults.install("kill@level=3")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                stub_sharded_engine(n_devices=4).run(
+                    checkpoint_path=ck,
+                    obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    eng2 = stub_sharded_engine(n_devices=2)
+    res2 = eng2.run(resume_from=ck,
+                    obs=RunObserver(journal_path=jp))
+    ev = [e["event"] for e in read_journal(jp)]
+    return {
+        "ok": (preempted.depth == 3 and res2.ok
+               and res2.distinct_states == ORACLE["distinct"]
+               and res2.levels == ORACLE["levels"]
+               and eng2.resharded_from == 4
+               and "rescue_checkpoint" in ev and "reshard" in ev),
+        "rescue_depth": preempted.depth,
+        "resharded_from": eng2.resharded_from,
+        "distinct_after_recover": res2.distinct_states,
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -299,6 +425,9 @@ SCENARIOS = [
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),
+    ("exchange-drop-retry", scenario_exchange_drop_retry),
+    ("oom-mesh-degrade", scenario_oom_mesh_degrade),
+    ("kill-elastic-resume", scenario_kill_elastic_resume),
     ("pipeline-faults", scenario_pipeline_faults),
 ]
 

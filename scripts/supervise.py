@@ -5,8 +5,13 @@ child exits with the resumable code (75 — a SIGTERM/SIGINT was turned
 into a rescue checkpoint at a level boundary), re-runs it with
 ``-recover CKPT`` so a preempted multi-day run continues from the
 snapshot with cumulative elapsed and one continuous journal.  In-run
-OOM retry/degrade (tile halving -> paged fallback) happens INSIDE the
-child's supervisor; this wrapper only restarts across process deaths.
+OOM retry/degrade (tile halving -> paged fallback; with
+``-engine sharded`` the mesh-aware ladder: tile halving -> mesh
+shrink -> paged) happens INSIDE the child's supervisor; this wrapper
+only restarts across process deaths.  A sharded restart that comes
+back with fewer devices re-hash-partitions the snapshot onto the
+smaller mesh at load time (elastic resume — the journal records a
+``reshard`` event).
 
 Signals sent to the wrapper are forwarded to the child — a SIGTERM to
 the wrapper lets the child rescue-checkpoint, and the wrapper then

@@ -1,13 +1,19 @@
 """CLI tests: the reference specs + cfgs run unchanged through the
-TLC-compatible entry point."""
+TLC-compatible entry point, and the flag contract (documented mutual
+exclusions -> argparse exit 2) holds without any spec being loaded.
+
+The flag-contract tests run under tier-1 (no reference mount: the
+conflicts fail at parse time, before the spec path is touched); the
+end-to-end runs are reference-gated per test.
+"""
 
 import json
 import subprocess
 import sys
 
-from tests.conftest import REFERENCE, requires_reference
+import pytest
 
-pytestmark = requires_reference
+from tests.conftest import REFERENCE, requires_reference
 
 
 def _run(*argv, timeout=420):
@@ -19,6 +25,7 @@ def _run(*argv, timeout=420):
              "HOME": "/root"})
 
 
+@requires_reference
 def test_cli_bfs_interp_maxstates():
     r = _run(f"{REFERENCE}/VSR.tla", "-engine", "interp",
              "-maxstates", "500", "-json")
@@ -27,6 +34,7 @@ def test_cli_bfs_interp_maxstates():
     assert out["mode"] == "bfs" and out["distinct_states"] >= 500
 
 
+@requires_reference
 def test_cli_simulate_interp():
     r = _run(f"{REFERENCE}/VSR.tla", "-engine", "interp", "-simulate",
              "-num", "5", "-depth", "10", "-json")
@@ -35,6 +43,7 @@ def test_cli_simulate_interp():
     assert out["mode"] == "simulate" and out["walks"] == 5
 
 
+@requires_reference
 def test_cli_checks_temporal_properties(tmp_path):
     # a cfg with PROPERTY must run the liveness checker after safety;
     # fairness-free spec -> stuttering violation, nonzero exit
@@ -65,9 +74,48 @@ FairSpec == Init /\\ [][Next]_vars /\\ WF_vars(Incr)
     assert r2.returncode == 0 and out2["properties_ok"] is True
 
 
+@requires_reference
 def test_cli_analysis_spec_with_shipped_cfg():
     r = _run(f"{REFERENCE}/analysis/03-state-transfer/VR_STATE_TRANSFER.tla",
              "-maxstates", "300", "-json")
     assert r.returncode == 0, r.stderr
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["distinct_states"] >= 300
+
+
+# ---------------------------------------------------------------------
+# flag contract (ISSUE 5 satellite): -engine sharded is first-class —
+# -supervise -engine sharded parses, invalid sharded combos are clean
+# argparse errors (exit 2) before any spec is loaded.  No reference
+# mount needed: the conflicts fire at parse time.
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("bad", [
+    ["-engine", "sharded", "-fused"],
+    ["-engine", "sharded", "-simulate"],
+    ["-engine", "sharded", "-fpset", "host"],
+    ["-engine", "sharded", "-fpset", "hbm"],
+    ["-engine", "sharded", "-fpset", "paged"],
+    ["-supervise", "-engine", "sharded", "-fused"],
+    ["-engine", "sharded", "-supervise", "-inject", "kill@level="],
+    ["-engine", "sharded", "-inject", "exchange-drop:0@shard=0"],
+    ["-engine", "sharded", "-pipeline", "0"],
+], ids=["fused", "simulate", "fpset-host", "fpset-hbm", "fpset-paged",
+        "supervise-fused", "bad-kill-spec", "zero-drop-count",
+        "bad-pipeline"])
+def test_cli_sharded_flag_conflicts_exit_2(bad):
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
+@pytest.mark.parametrize("good", [
+    ["-supervise", "-engine", "sharded"],
+    ["-engine", "sharded", "-supervise", "-inject", "oom@shard=0"],
+    ["-engine", "sharded", "-inject", "exchange-drop:3@shard=0"],
+    ["-engine", "sharded", "-recover", "/nonexistent-ckpt"],
+], ids=["supervise", "supervise-oom-shard", "drop-count", "recover"])
+def test_cli_sharded_valid_combos_pass_parsing(good):
+    """Valid sharded combinations get past flag validation: the run
+    fails on the nonexistent spec path (not exit 2)."""
+    r = _run("/nonexistent-spec-dir/X.tla", *good)
+    assert r.returncode != 2, (r.stdout, r.stderr)

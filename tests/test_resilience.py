@@ -123,8 +123,15 @@ def test_new_journal_events_validate():
     validate_journal_line(dict(base, event="rescue_checkpoint",
                                path="x", depth=3, distinct=9,
                                signal="SIGTERM"))
+    validate_journal_line(dict(base, event="degrade", what="mesh",
+                               **{"from": 8, "to": 4}))
+    validate_journal_line(dict(base, event="reshard", from_shards=8,
+                               to_shards=4, distinct=100))
     with pytest.raises(ValueError):
         validate_journal_line(dict(base, event="fault", what="oom"))
+    with pytest.raises(ValueError):
+        validate_journal_line(dict(base, event="reshard",
+                                   from_shards=8))
 
 
 # ---------------------------------------------------------------------
@@ -466,10 +473,164 @@ def test_sharded_recover_rejects_mismatched_shard_layout(tmp_path):
     with pytest.raises(TLAError, match="shard layout"):
         _sharded_engine(mesh2).run(resume_from=ck)
 
-    # (b) a 4-shard mesh refusing the pristine 2-shard snapshot
+    # (b) a mesh-size mismatch is no longer a refusal (ISSUE 5 elastic
+    # resume) — but an INCONSISTENT snapshot still is: garble the
+    # manifest fp_count so the pooled FPSet rows cannot match it
+    with open(os.path.join(pristine, "manifest.json")) as f:
+        mf2 = json.load(f)
+    mf2["fp_count"] += 5
+    with open(os.path.join(pristine, "manifest.json"), "w") as f:
+        json.dump(mf2, f)
     mesh4 = Mesh(np.array(jax.devices()[:4]), ("d",))
-    with pytest.raises(TLAError, match="this mesh has 4"):
+    with pytest.raises(TLAError, match="inconsistent"):
         _sharded_engine(mesh4).run(resume_from=pristine)
+
+
+# ---------------------------------------------------------------------
+# elastic resume (ISSUE 5 tentpole): a D-shard snapshot resumed on an
+# M-device mesh — both shrink and grow — reproduces the uninterrupted
+# run exactly, with the reshard journaled
+# ---------------------------------------------------------------------
+def _stub_sharded(n, **kw):
+    from tpuvsr.testing import stub_sharded_engine
+    return stub_sharded_engine(n_devices=n, **kw)
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 8,
+                    reason="needs 8 virtual devices")
+@pytest.mark.parametrize("m_dev", [2, 8], ids=["shrink-4to2",
+                                               "grow-4to8"])
+def test_elastic_resume_equivalence(tmp_path, m_dev):
+    """ISSUE 5 acceptance: checkpoint on a 4-shard mesh, resume on
+    M < D and M > D; distinct/generated/level_sizes match the
+    uninterrupted run exactly and the journal records the reshard."""
+    ck = str(tmp_path / "ck")
+    jp = str(tmp_path / "elastic.jsonl")
+    r1 = _stub_sharded(4).run(max_depth=3, checkpoint_path=ck)
+    assert r1.error                     # depth-limited
+    eng = _stub_sharded(m_dev)
+    res = eng.run(resume_from=ck, obs=RunObserver(journal_path=jp))
+    oracle = _stub_sharded(4).run()
+    assert res.ok
+    assert res.distinct_states == oracle.distinct_states \
+        == ORACLE_DISTINCT
+    assert eng.level_sizes == oracle.levels == ORACLE_LEVELS
+    assert res.states_generated == oracle.states_generated
+    assert eng.resharded_from == 4
+    events = read_journal(jp)
+    rs = [e for e in events if e["event"] == "reshard"]
+    assert len(rs) == 1
+    assert rs[0]["from_shards"] == 4 and rs[0]["to_shards"] == m_dev
+    assert rs[0]["distinct"] == r1.distinct_states
+    # the metrics gauges carry the mesh identity for compare_bench
+    assert res.metrics["gauges"]["mesh_devices"] == m_dev
+    assert res.metrics["gauges"]["resharded_from"] == 4
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 8,
+                    reason="needs 8 virtual devices")
+def test_elastic_resume_trace_bit_identical(tmp_path):
+    """The unique-witness invariant (x <= 2: the only violation at its
+    BFS level is (3,0), reached one way) must surface the bit-identical
+    counterexample trace from every mesh size AND from an elastic
+    resume that crossed mesh sizes mid-run."""
+    def trace_of(res):
+        assert not res.ok and res.violated_invariant == "Bound"
+        return [tuple(sorted(s.state.items())) for s in res.trace]
+
+    golden = trace_of(_stub_sharded(1, inv_x_bound=2).run())
+    for m in (2, 4, 8):
+        assert trace_of(_stub_sharded(m, inv_x_bound=2).run()) == golden
+
+    # checkpoint at depth 2 on 4 devices, resume on 2: same witness
+    ck = str(tmp_path / "ck")
+    r1 = _stub_sharded(4, inv_x_bound=2).run(max_depth=2,
+                                             checkpoint_path=ck)
+    assert r1.error and r1.ok           # depth-limited, no viol yet
+    eng = _stub_sharded(2, inv_x_bound=2)
+    res = eng.run(resume_from=ck)
+    assert eng.resharded_from == 4
+    assert trace_of(res) == golden
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 4,
+                    reason="needs 4 virtual devices")
+def test_sharded_mesh_degrade_ladder_to_paged(tmp_path):
+    """ISSUE 5 acceptance: injected OOMs walk the full mesh ladder —
+    per-shard tile halving, mesh shrink 4 -> 2 -> 1, single-device
+    paged fallback (snapshot converted in place) — and the run still
+    reaches the exact fixpoint with every rung journaled."""
+    from tpuvsr.resilience.supervisor import Supervisor
+    from tpuvsr.testing import stub_sharded_factory
+    spec = counter_spec()
+    jp = str(tmp_path / "ladder.jsonl")
+    faults.install("oom@level=2,oom@level=3,oom@level=4,"
+                   "oom@level=5,oom@level=6")
+    sup = Supervisor(spec, engine="sharded", mesh_devices=4,
+                     checkpoint_path=str(tmp_path / "ck"),
+                     journal_path=jp,
+                     engine_factory=stub_sharded_factory(spec),
+                     tile_size=8, min_tile=4, backoff_base=0.0,
+                     sleep=lambda s: None)
+    res = sup.run()
+    assert res.ok and res.distinct_states == ORACLE_DISTINCT
+    assert res.levels == ORACLE_LEVELS
+    assert ("tile", 8, 4) in sup.degrades
+    assert ("mesh", 4, 2) in sup.degrades
+    assert ("mesh", 2, 1) in sup.degrades
+    assert ("engine", "sharded", "paged") in sup.degrades
+    assert sup.kind == "paged"
+    degr = [e for e in read_journal(jp) if e["event"] == "degrade"]
+    assert [d["what"] for d in degr] == ["tile", "mesh", "mesh",
+                                         "engine"]
+    assert {"what": "mesh", "from": 4, "to": 2}.items() \
+        <= degr[1].items()
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 2,
+                    reason="needs 2 virtual devices")
+def test_exchange_retry_is_bounded(tmp_path):
+    """A drop count beyond the retry budget must fail loudly (bounded
+    retry, not an infinite re-issue spin)."""
+    jp = str(tmp_path / "x.jsonl")
+    faults.install("exchange-drop:9@shard=0")
+    eng = _stub_sharded(2, sleep=lambda s: None)
+    with pytest.raises(TLAError, match="giving up"):
+        eng.run(obs=RunObserver(journal_path=jp))
+    retries = [e for e in read_journal(jp) if e["event"] == "retry"]
+    assert [e["attempt"] for e in retries] == [1, 2, 3, 4, 5]
+    backoffs = [e["backoff_s"] for e in retries]
+    assert backoffs == sorted(backoffs)     # exponential, capped
+
+
+def test_exchange_drop_count_grammar():
+    plan = FaultPlan.parse("exchange-drop:3@shard=1")
+    f = plan.faults[0]
+    assert f.kind == "exchange-drop" and f.count == 3 and f.shard == 1
+    assert repr(f) == "exchange-drop:3@shard=1"
+    # fires exactly count times, then clears
+    from tpuvsr.resilience.faults import InjectedExchangeDrop
+    for _ in range(3):
+        with pytest.raises(InjectedExchangeDrop):
+            plan.fire("exchange", shard=1)
+    assert plan.fire("exchange", shard=1) is None
+    assert not plan.pending()
+    with pytest.raises(ValueError, match="integer count"):
+        parse_fault("exchange-drop:x")
+    with pytest.raises(ValueError, match="count must be"):
+        parse_fault("exchange-drop:0")
+
+
+def test_oom_shard_scoped_fault():
+    """oom@shard=S fires at the level site only for the matching host
+    process (None context — a single-process mesh — matches any)."""
+    plan = FaultPlan.parse("oom@shard=1")
+    assert plan.fire("level", depth=2, shard=0) is None
+    with pytest.raises(InjectedOOM):
+        plan.fire("level", depth=2, shard=1)
+    plan2 = FaultPlan.parse("oom@shard=1")
+    with pytest.raises(InjectedOOM):    # single-process: any shard
+        plan2.fire("level", depth=2, shard=None)
 
 
 # ---------------------------------------------------------------------
@@ -481,7 +642,7 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["ok"] and len(out["scenarios"]) == 7
+    assert out["ok"] and len(out["scenarios"]) == 10
 
 
 # ---------------------------------------------------------------------
@@ -502,7 +663,14 @@ def _cli(args):
     ["-supervise", "-engine", "interp"],
     ["-supervise", "-fpset", "host"],
     ["-inject", "explode@level=1"],
-], ids=["simulate", "interp", "host-fpset", "bad-inject"])
+    ["-engine", "sharded", "-fused"],
+    ["-engine", "sharded", "-simulate"],
+    ["-engine", "sharded", "-fpset", "paged"],
+    ["-supervise", "-engine", "sharded", "-fused"],
+    ["-inject", "exchange-drop:x@shard=0"],
+], ids=["simulate", "interp", "host-fpset", "bad-inject",
+        "sharded-fused", "sharded-simulate", "sharded-fpset",
+        "sharded-supervise-fused", "bad-drop-count"])
 def test_cli_supervise_and_inject_flag_validation(bad):
     r = _cli(["X.tla"] + bad)
     assert r.returncode == 2, r.stderr

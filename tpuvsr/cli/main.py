@@ -12,9 +12,12 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
   -depth N         walk depth in simulation mode (default 100)
   -num N           number of walks (default 10000; TLC runs forever)
   -seed N          simulation RNG seed
-  -engine E        auto | device | interp (default auto: the jit+vmap
-                   device engine for specs with a compiled kernel, the
-                   interpreter otherwise)
+  -engine E        auto | device | interp | sharded (default auto:
+                   the jit+vmap device engine for specs with a
+                   compiled kernel, the interpreter otherwise;
+                   sharded = the multi-chip engine over every visible
+                   device — frontier and fingerprint set
+                   hash-partitioned over a 1-D mesh)
   -fpset NAME      fingerprint-set implementation, mirroring TLC's
                    pluggable-FPSet class flag: auto (default) | hbm
                    (the HBM-resident device table — forces the device
@@ -72,12 +75,19 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    the latest snapshot, and SIGTERM/SIGINT checkpoint
                    at the next level boundary and exit with the
                    resumable code 75 (rerun with -recover, or drive
-                   the loop with scripts/supervise.py).  Device/paged
-                   BFS only; implies level-boundary checkpointing to
-                   -checkpointdir when -checkpoint is not given.
+                   the loop with scripts/supervise.py).  With
+                   -engine sharded the ladder is mesh-aware: per-shard
+                   tile halving, then mesh shrink to the largest
+                   usable power-of-two device count (the resume
+                   re-hash-partitions the snapshot onto the smaller
+                   mesh), then single-device paged fallback.
+                   Device/paged/sharded BFS only; implies
+                   level-boundary checkpointing to -checkpointdir
+                   when -checkpoint is not given.
   -inject SPEC     arm the deterministic fault-injection plan
                    (tpuvsr/resilience/faults.py grammar, e.g.
-                   "oom@level=3,corrupt-ckpt:frontier.npz"); the
+                   "oom@level=3,corrupt-ckpt:frontier.npz",
+                   "oom@shard=0", "exchange-drop:3@shard=0"); the
                    TPUVSR_FAULT env var arms the same plan
 
 Environment: TPUVSR_PROFILE=DIR wraps the engine fixpoint loop in
@@ -89,7 +99,10 @@ Mutually exclusive flags (argparse errors, exit code 2, before any
 spec is loaded): -fused with -checkpoint/-recover (unless -supervise,
 whose rescue quantum makes fused snapshots possible); -fpset host with
 -engine device; -fpset hbm/paged with -engine interp; -supervise with
--simulate/-engine interp/-fpset host.
+-simulate/-engine interp/-fpset host; -engine sharded with
+-simulate/-fused (the sharded engine has no fused fixpoint) or any
+non-auto -fpset (its fingerprint set is always the mesh-sharded HBM
+table).
 
 Exit codes: 0 ok; 1 speclint errors (-lint); 2 bad flags; 12 safety/
 temporal violation (TLC's code); 75 preempted-but-resumable (a
@@ -117,7 +130,8 @@ def build_parser():
     p.add_argument("-depth", type=int, default=100)
     p.add_argument("-num", type=int, default=10000)
     p.add_argument("-seed", type=int, default=0)
-    p.add_argument("-engine", choices=["auto", "device", "interp"],
+    p.add_argument("-engine",
+                   choices=["auto", "device", "interp", "sharded"],
                    default="auto")
     p.add_argument("-fpset", choices=["auto", "hbm", "paged", "host"],
                    default="auto")
@@ -134,12 +148,14 @@ def build_parser():
                         " dispatches (no per-level host syncs; remote-"
                         "TPU mode; excludes -checkpoint/-recover "
                         "unless -supervise)")
-    p.add_argument("-pipeline", type=int, default=2, metavar="K",
-                   help="device/paged BFS dispatch window: keep K "
-                        "level-kernel dispatches in flight, blocking "
-                        "only on the oldest (default 2; 1 = "
-                        "synchronous).  Results are bit-identical "
-                        "for every K")
+    p.add_argument("-pipeline", type=int, default=None, metavar="K",
+                   help="device/paged/sharded BFS dispatch window: "
+                        "keep K level-kernel dispatches in flight, "
+                        "blocking only on the oldest (default 2; the "
+                        "sharded engine defaults to 1 — its step has "
+                        "no buffer donation, so K>1 holds K buffer "
+                        "generations in HBM; 1 = synchronous).  "
+                        "Results are bit-identical for every K")
     p.add_argument("-lower", action="store_true",
                    help="compile the device kernel's guards/actions/"
                         "invariants from the spec AST (tpuvsr/lower) "
@@ -166,9 +182,10 @@ def build_parser():
                         "exits with the resumable code 75")
     p.add_argument("-inject", default=None, metavar="SPEC",
                    help="arm deterministic fault injection (grammar: "
-                        "oom@level=N, kill@level=N, "
+                        "oom@level=N, oom@shard=S, kill@level=N, "
                         "corrupt-ckpt:FILE[@level=N], "
-                        "exchange-drop@shard=S; comma-separated)")
+                        "exchange-drop[:K]@shard=S; comma-separated; "
+                        ":K = K consecutive drops)")
     return p
 
 
@@ -183,19 +200,34 @@ def validate_args(parser, args):
                      "the supervised fused run bounds its dispatch to "
                      "a rescue quantum; a fused resume continues "
                      "through the chunked engine)")
-    if args.pipeline < 1:
+    if args.pipeline is not None and args.pipeline < 1:
         parser.error(f"-pipeline must be >= 1 (got {args.pipeline})")
     if args.fpset == "host" and args.engine == "device":
         parser.error("-fpset host requires -engine interp (the host "
                      "fingerprint set only exists in the interpreter)")
     if args.fpset in ("hbm", "paged") and args.engine == "interp":
         parser.error(f"-fpset {args.fpset} requires the device engine")
+    if args.engine == "sharded":
+        if args.simulate:
+            parser.error("-engine sharded checks by BFS; simulation "
+                         "runs on the device/interp engines")
+        if args.fused:
+            parser.error("-engine sharded cannot be combined with "
+                         "-fused (the sharded engine has no fused "
+                         "fixpoint; its per-level exchange needs the "
+                         "host in the loop)")
+        if args.fpset != "auto":
+            parser.error(f"-engine sharded always uses the "
+                         f"mesh-sharded HBM fingerprint set; it "
+                         f"cannot be combined with -fpset "
+                         f"{args.fpset}")
     if args.supervise and args.simulate:
         parser.error("-supervise supervises BFS runs, not simulation")
     if args.supervise and (args.engine == "interp"
                            or args.fpset == "host"):
-        parser.error("-supervise needs the device/paged engine (the "
-                     "interpreter has no checkpoint/degrade ladder)")
+        parser.error("-supervise needs the device/paged/sharded "
+                     "engine (the interpreter has no "
+                     "checkpoint/degrade ladder)")
     if args.inject:
         from ..resilience.faults import FaultPlan
         try:
@@ -250,6 +282,10 @@ def main(argv=None):
         return report.exit_code
 
     engine = _pick_engine(args.engine, args.fpset, spec)
+    if args.pipeline is None:
+        # the sharded dispatch window is opt-in (its step has no
+        # buffer donation, so K>1 holds K buffer generations in HBM)
+        args.pipeline = 1 if engine == "sharded" else 2
 
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
@@ -259,7 +295,15 @@ def main(argv=None):
             "resolved to the interpreter — running unsupervised")
         args.supervise = False
 
-    if engine in ("device", "paged"):
+    if engine in ("device", "paged", "sharded"):
+        if engine == "sharded":
+            # multi-host env (TPUVSR_MH_*): jax.distributed must
+            # initialize before the backend is touched, for BOTH the
+            # supervised and plain sharded paths (a supervised pack
+            # that skips this sees only local devices and its
+            # rank-agreement degenerates to single-process)
+            from ..parallel.multihost import init_from_env
+            init_from_env()
         backend = ensure_backend(log)
         log(f"backend: {backend}")
     log(f"spec {spec.module.name}, engine {engine}, "
@@ -311,7 +355,7 @@ def main(argv=None):
                    "elapsed_s": round(res.elapsed, 3),
                    "metrics": summary_metrics(res.metrics)}
     else:
-        if engine in ("device", "paged"):
+        if engine in ("device", "paged", "sharded"):
             from ..engine.device_bfs import DeviceBFS
             from ..engine.paged_bfs import PagedBFS
             ckpt_dir = args.checkpointdir or (
@@ -347,6 +391,31 @@ def main(argv=None):
                     return EXIT_RESUMABLE
                 eng = sup.engine
                 log(f"supervised run done: {sup.summary()}")
+            elif engine == "sharded":
+                # multi-chip BFS over every visible device (the mesh
+                # is the whole device set; multi-host runs set the
+                # TPUVSR_MH_* env — jax.distributed was initialized
+                # with the backend above, so devices() spans hosts)
+                import numpy as np
+
+                import jax
+                from jax.sharding import Mesh
+
+                from ..parallel.sharded_bfs import ShardedBFS
+                mesh = Mesh(np.array(jax.devices()), ("d",))
+                log(f"sharded mesh: {mesh.shape['d']} devices")
+                eng = ShardedBFS(spec, mesh, pipeline=args.pipeline)
+                res = eng.run(
+                    max_states=args.maxstates,
+                    max_seconds=args.maxseconds,
+                    check_deadlock=args.deadlock, log=log, obs=obs,
+                    checkpoint_path=(ckpt_dir if args.checkpoint or
+                                     args.recover else None),
+                    checkpoint_every=(args.checkpoint * 60.0
+                                      if args.checkpoint else
+                                      30 * 60.0 if args.recover
+                                      else None),
+                    resume_from=args.recover)
             else:
                 # temporal properties need the behavior graph: run the
                 # safety BFS through the paged engine with level
@@ -416,7 +485,8 @@ def main(argv=None):
             log(f"checking temporal properties: "
                 f"{', '.join(spec.temporal_props)}")
             graph = None
-            if engine in ("device", "paged") and not spec.symmetry_perms:
+            if engine in ("device", "paged", "sharded") and \
+                    not spec.symmetry_perms:
                 # device-built behavior graph (round-3 fix: the CLI
                 # used the interpreter graph even for device runs,
                 # which cannot terminate beyond toy constants), reusing
@@ -424,9 +494,10 @@ def main(argv=None):
                 # run's blocks only cover post-resume levels, so the
                 # graph re-enumerates from scratch in that case.
                 from ..engine.device_liveness import DeviceGraph
-                if args.recover or args.supervise:
-                    # resumed/supervised runs don't retain level
-                    # blocks; re-enumerate for the behavior graph
+                if args.recover or args.supervise \
+                        or engine == "sharded":
+                    # resumed/supervised/sharded runs don't retain
+                    # level blocks; re-enumerate for the graph
                     graph = DeviceGraph(spec, log=log)
                 else:
                     graph = DeviceGraph(spec, engine=eng, result=res,

@@ -607,9 +607,15 @@ class DeviceBFS:
             if (ck.get("extra") or {}).get("sharded"):
                 raise TLAError("checkpoint was written by the sharded "
                                "engine; resume it there")
+            # an EMPTY expand_mults means the snapshot carries no
+            # per-action multipliers (written by the sharded engine,
+            # then converted single-device for the supervisor's paged
+            # fallback) — keep this engine's own defaults
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
-                    list(ck["expand_mults"]) != list(self.expand_mults):
-                self.expand_mults = list(ck["expand_mults"])
+                    (ck["expand_mults"] and list(ck["expand_mults"])
+                     != list(self.expand_mults)):
+                if ck["expand_mults"]:
+                    self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
                 codec = self.codec
             table = {"slots": jnp.asarray(ck["slots"])}

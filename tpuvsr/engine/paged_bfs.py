@@ -127,9 +127,14 @@ class PagedBFS(DeviceBFS):
             if (ck.get("extra") or {}).get("sharded"):
                 raise TLAError("checkpoint was written by the sharded "
                                "engine; resume it there")
+            # empty expand_mults (a converted sharded snapshot, see
+            # parallel.sharded_bfs.convert_sharded_snapshot): keep
+            # this engine's own per-action defaults
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
-                    list(ck["expand_mults"]) != list(self.expand_mults):
-                self.expand_mults = list(ck["expand_mults"])
+                    (ck["expand_mults"] and list(ck["expand_mults"])
+                     != list(self.expand_mults)):
+                if ck["expand_mults"]:
+                    self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])

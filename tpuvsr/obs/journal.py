@@ -46,6 +46,9 @@ EVENT_REQUIRED = {
     "degrade": ("what", "from", "to", "elapsed_s"),
     "rescue_checkpoint": ("path", "depth", "distinct", "signal",
                           "elapsed_s"),
+    # elastic sharded resume (ISSUE 5): an N-shard snapshot was
+    # re-hash-partitioned onto an M-device mesh at load time
+    "reshard": ("from_shards", "to_shards", "distinct", "elapsed_s"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

@@ -245,6 +245,17 @@ class RunObserver:
                            elapsed_s=round(self.elapsed(), 3),
                            **{"from": from_, "to": to})
 
+    def reshard(self, from_shards, to_shards, distinct):
+        """An elastic sharded resume: the snapshot's N FPSet shards and
+        frontier were re-hash-partitioned onto this M-device mesh at
+        load time (ISSUE 5)."""
+        self.count("reshards")
+        self.gauge("resharded_from", int(from_shards))
+        self.journal.write("reshard", from_shards=int(from_shards),
+                           to_shards=int(to_shards),
+                           distinct=int(distinct),
+                           elapsed_s=round(self.elapsed(), 3))
+
     def rescue(self, path, depth, distinct, signal_name):
         """A preemption rescue snapshot written at a level boundary
         (the run exits with the resumable code right after)."""

@@ -39,6 +39,7 @@ ever profiles as the bottleneck.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import numpy as np
@@ -87,6 +88,96 @@ def make_sharded_tables(mesh, axis, capacity_per_device):
     sh = NamedSharding(mesh, P(axis))
     return {"slots": put_sharded(
         np.zeros((n, capacity_per_device, 5), np.uint32), sh)}
+
+
+# ======================================================================
+# Elastic resharding (ISSUE 5): host-side re-hash-partitioning of a
+# snapshot's FPSet shards + frontier onto a different mesh size
+# ======================================================================
+
+def pool_shard_fingerprints(slots):
+    """All occupied (keyed) fingerprint rows of a stacked [N, cap, 5]
+    sharded table, shard-major.  The stored rows are the canonical
+    keyed encoding (fpset._keyed: word 0 remapped 0 -> 1); re-keying
+    is idempotent and ``route`` reads words 1/3 which the keying never
+    touches, so the rows re-insert and re-route exactly like the raw
+    fingerprints they came from."""
+    s = np.asarray(slots)
+    occ = s[..., 0] != 0
+    return s[occ][:, :4].astype(np.uint32)
+
+
+def build_shard_tables(fps, owner, n_shards, cap_start):
+    """Rebuild per-shard FPSet tables from pooled keyed fingerprint
+    rows and their new ownership: returns (slots [n_shards, cap, 5],
+    per-shard counts).  The capacity is shared across shards (the
+    stacked array is one global [D, cap, 5]) and grows — power of two,
+    load factor <= 1/4 up front — until every shard inserts without a
+    probe overflow."""
+    counts = np.bincount(owner, minlength=n_shards).astype(np.int64)
+    cap = int(cap_start)
+    while cap < 4 * max(1, int(counts.max(initial=0))):
+        cap *= 2
+    chunk = 1 << 14
+    while True:
+        out = np.zeros((n_shards, cap, 5), np.uint32)
+        ok = True
+        for d in range(n_shards):
+            tab = {"slots": jnp.zeros((cap, 5), U32)}
+            part = fps[owner == d]
+            for off in range(0, part.shape[0], chunk):
+                p = part[off:off + chunk]
+                pad = np.zeros((chunk - p.shape[0], 4), np.uint32)
+                batch = jnp.asarray(np.concatenate([p, pad]))
+                m = jnp.asarray(np.arange(chunk) < p.shape[0])
+                tab, _, ovf = insert_core(tab, batch, m)
+                if bool(ovf):
+                    ok = False
+                    break
+            if not ok:
+                break
+            out[d] = np.asarray(tab["slots"])
+        if ok:
+            return out, counts
+        cap *= 2
+
+
+def convert_sharded_snapshot(path, spec, log=None):
+    """Rewrite an N-shard sharded snapshot at ``path`` into the
+    single-device engine format IN PLACE: merge the FPSet shards into
+    one table (re-inserting every occupied keyed row) and drop the
+    sharded ``extra`` — the frontier/trace payloads are already
+    global.  The supervisor's sharded -> paged fallback calls this so
+    the final rung of the mesh degrade ladder keeps the run's
+    progress.  ``expand_mults`` is written empty; the single-device
+    engines keep their own defaults when a snapshot carries none.
+    Returns True when a conversion happened (False: the snapshot was
+    not written by the sharded engine)."""
+    from ..engine.checkpoint import (load_checkpoint, save_checkpoint,
+                                     spec_digest)
+    digest = spec_digest(spec)
+    ck = load_checkpoint(path, expect_digest=digest, log=log)
+    ex = ck.get("extra") or {}
+    if not ex.get("sharded"):
+        return False
+    fps = pool_shard_fingerprints(ck["slots"])
+    merged, _ = build_shard_tables(
+        fps, np.zeros(fps.shape[0], np.int64), 1,
+        int(np.asarray(ck["slots"]).shape[1]))
+    if log:
+        log(f"converted sharded snapshot {path} "
+            f"({np.asarray(ck['slots']).shape[0]} shards, "
+            f"{fps.shape[0]} fingerprints) to single-device format")
+    save_checkpoint(
+        path, slots=merged[0], frontier=ck["frontier"],
+        n_front=ck["n_front"], h_parent=ck["h_parent"],
+        h_action=ck["h_action"], h_param=ck["h_param"],
+        init_dense=ck["init_dense"], level_sizes=ck["level_sizes"],
+        depth=ck["depth"], fp_count=ck["fp_count"],
+        states_generated=ck["states_generated"],
+        max_msgs=ck["max_msgs"], expand_mults=[],
+        elapsed=ck["elapsed"], digest=digest, extra=None)
+    return True
 
 
 # ======================================================================
@@ -363,12 +454,26 @@ class ShardedBFS:
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=None, next_capacity=1 << 12,
                  fpset_capacity=1 << 14, check_deadlock=False,
-                 model_factory=None, pipeline=1):
+                 model_factory=None, pipeline=1, exchange_retries=5,
+                 exchange_backoff=0.05, exchange_backoff_cap=2.0,
+                 sleep=time.sleep):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
+        # bounded exponential-backoff budget for transient exchange
+        # failures (ISSUE 5): a dropped exchange re-issues the level
+        # step (lossless — committed lanes just dedup) up to
+        # `exchange_retries` CONSECUTIVE times before the run fails
+        # loudly; `sleep` is injectable so tests don't wait
+        self.exchange_retries = int(exchange_retries)
+        self.exchange_backoff = float(exchange_backoff)
+        self.exchange_backoff_cap = float(exchange_backoff_cap)
+        self._sleep = sleep
+        # set by an elastic resume that re-hash-partitioned an N-shard
+        # snapshot onto this mesh (None: no reshard happened)
+        self.resharded_from = None
         # dispatch-window depth (ISSUE 4; 1 = synchronous).  Unlike
         # the device/paged engines (default 2), the sharded window is
         # OPT-IN: the step is one whole-level attempt (overlap covers
@@ -519,15 +624,12 @@ class ShardedBFS:
             if not ex.get("sharded"):
                 raise TLAError("checkpoint was written by the "
                                "single-device engine; resume it there")
-            if len(ex["shard_counts"]) != D:
-                raise TLAError(
-                    f"checkpoint has {len(ex['shard_counts'])} FPSet "
-                    f"shards, this mesh has {D}; refusing to resume")
             # the per-shard counts drive the frontier re-scatter below:
             # verify them against the actual snapshot arrays so a
             # snapshot written under a different shard layout fails
             # here with a clear message instead of an index error
             _counts = [int(x) for x in ex["shard_counts"]]
+            n_src = len(_counts)
             if min(_counts, default=0) < 0 or \
                     sum(_counts) != int(ck["n_front"]):
                 raise TLAError(
@@ -536,33 +638,85 @@ class ShardedBFS:
                     f"frontier count {ck['n_front']}: snapshot was "
                     f"written under a different shard layout; "
                     f"refusing to resume")
-            if len(ex.get("dev_distinct", [])) != D:
+            if len(ex.get("dev_distinct", [])) != n_src:
                 raise TLAError(
                     f"checkpoint extra.dev_distinct has "
-                    f"{len(ex.get('dev_distinct', []))} entries, this "
-                    f"mesh has {D} shards; refusing to resume")
+                    f"{len(ex.get('dev_distinct', []))} entries for "
+                    f"{n_src} FPSet shards; refusing to resume")
             if ck["max_msgs"] != self.codec.shape.MAX_MSGS or \
                     ex["bucket_cap"] != self.bucket_cap:
                 self.bucket_cap = int(ex["bucket_cap"])
                 self._build(ck["max_msgs"])
-            slots = np.asarray(ck["slots"])
+            rows = ck["frontier"]
+            h_parent = np.asarray(ck["h_parent"])
+            h_action = np.asarray(ck["h_action"])
+            h_param = np.asarray(ck["h_param"])
+            if n_src != D:
+                # --- elastic resume: re-hash-partition N -> D ---------
+                # (ISSUE 5 tentpole).  Every fingerprint and frontier
+                # state migrates to route(fp) % D — the same ownership
+                # rule the live exchange uses — so the resumed run is
+                # indistinguishable from one that ran on this mesh all
+                # along (modulo within-shard frontier order, which the
+                # stable partition keeps in saved global order).
+                fps_pool = pool_shard_fingerprints(ck["slots"])
+                if fps_pool.shape[0] != int(ck["fp_count"]):
+                    raise TLAError(
+                        f"checkpoint FPSet shards hold "
+                        f"{fps_pool.shape[0]} fingerprints, manifest "
+                        f"says fp_count={ck['fp_count']}: snapshot "
+                        f"is inconsistent; refusing to resume")
+                owner = (np.asarray(route(jnp.asarray(fps_pool)))
+                         % np.uint32(D)).astype(np.int64)
+                slots, dev_distinct = build_shard_tables(
+                    fps_pool, owner, D,
+                    int(np.asarray(ck["slots"]).shape[1]))
+                # frontier rows migrate to their new owner; the LAST
+                # level's trace-pointer block permutes with them so
+                # gid -> (parent, action, param) stays aligned (the
+                # frontier IS the last level_sizes entry, saved in the
+                # same global order as the trace tail)
+                ffps = np.asarray(self.kern.fingerprint_batch(
+                    {k: np.asarray(v) for k, v in rows.items()}))
+                fowner = (np.asarray(route(jnp.asarray(ffps)))
+                          % np.uint32(D)).astype(np.int64)
+                perm = np.argsort(fowner, kind="stable")
+                rows = {k: np.asarray(v)[perm] for k, v in rows.items()}
+                counts0 = np.bincount(fowner, minlength=D
+                                      ).astype(np.int64)
+                nf = int(ck["n_front"])
+                if nf:
+                    h_parent = np.concatenate(
+                        [h_parent[:-nf], h_parent[-nf:][perm]])
+                    h_action = np.concatenate(
+                        [h_action[:-nf], h_action[-nf:][perm]])
+                    h_param = np.concatenate(
+                        [h_param[:-nf], h_param[-nf:][perm]])
+                self.resharded_from = n_src
+                obs.reshard(n_src, D, int(ck["fp_count"]))
+                emit(f"resharded snapshot: {n_src} shards -> {D} "
+                     f"devices ({fps_pool.shape[0]} fingerprints, "
+                     f"{nf} frontier rows re-hash-partitioned)")
+            else:
+                slots = np.asarray(ck["slots"])
+                counts0 = np.asarray(_counts, np.int64)
+                dev_distinct = np.asarray(ex["dev_distinct"], np.int64)
             self.fp_cap = int(slots.shape[1])
             tables = {"slots": self._put(slots)}
-            counts0 = np.asarray(ex["shard_counts"], np.int64)
-            self.N = max(self.N, int(counts0.max()))
+            self.N = max(self.N, int(counts0.max(initial=0)))
             codec = self.codec
             self._init_states = [codec.decode(d)
                                  for d in ck["init_dense"]]
-            self._h_parent = [ck["h_parent"]]
-            self._h_action = [ck["h_action"]]
-            self._h_param = [ck["h_param"]]
+            self._h_parent = [h_parent]
+            self._h_action = [h_action]
+            self._h_param = [h_param]
             self.level_sizes = list(ck["level_sizes"])
             depth0 = ck["depth"]
             fp_count = ck["fp_count"]
             res.states_generated = ck["states_generated"]
             t0 -= ck["elapsed"]
             obs.set_epoch(t0)
-            self._dev_distinct = np.asarray(ex["dev_distinct"], np.int64)
+            self._dev_distinct = dev_distinct
             xc = ex.get("exchange") or {}
             exch_rows_useful = xc.get("useful_rows", 0)
             exch_rows_wire = xc.get("wire_rows", 0)
@@ -572,7 +726,6 @@ class ShardedBFS:
             zero = self.codec.zero_state()
             host_front = {k: np.zeros((D * F,) + np.shape(v), np.int32)
                           for k, v in zero.items()}
-            rows = ck["frontier"]
             pos = 0
             for d in range(D):
                 for j in range(int(counts0[d])):
@@ -676,9 +829,20 @@ class ShardedBFS:
             def agree(flag):
                 return bool(int(multihost_utils.broadcast_one_to_all(
                     np.int32(bool(flag)))))
+
+            def agree_any(flag):
+                # any-rank reduce (vs rank 0's verdict): an exchange
+                # drop observed on ONE host must make EVERY host take
+                # the retry branch, or the pack issues mismatched
+                # collectives.  One int32 allgather per dispatch —
+                # noise next to the step's own all_to_alls
+                return bool(multihost_utils.process_allgather(
+                    np.int32(bool(flag))).any())
         else:
             def agree(flag):
                 return bool(flag)
+
+            agree_any = bool
         # pipelined dispatch window (ISSUE 4): the sharded step is one
         # whole-level attempt, chained on its own outputs; the host
         # blocks only on the oldest in-flight step's reason.  Replays
@@ -708,6 +872,13 @@ class ShardedBFS:
             act = packed[:, 3:].sum(axis=0)
             return reason, sent, gen, act
 
+        # shard context for fault hooks: the HOST process in
+        # multi-process runs; a single-process mesh drives every
+        # shard, so any armed shard matches (shard=None)
+        my_shard = (jax.process_index() if jax.process_count() > 1
+                    else None)
+        xretry = 0      # consecutive exchange-drop retries (bounded)
+
         while True:
             with obs.timer("host_sync"):
                 front_total = int(self._pull(n_front).sum())
@@ -717,30 +888,49 @@ class ShardedBFS:
                 res.error = f"depth limit {max_depth} reached"
                 break
             depth += 1
-            fault_point("level", depth=depth, obs=obs)
+            fault_point("level", depth=depth, shard=my_shard, obs=obs)
             nb, nbp, nba, nbprm = self._alloc_frontier(self.N)
             nn = self._put(np.zeros(D, np.int32))
             start_t = self._put(np.zeros(D, np.int32))
             base_gid = self._put(base_dev.astype(np.int32))
             while True:
                 while pipe.has_room():
-                    # injected transient exchange failure: journal it
-                    # and re-issue the level step — the pause/re-enter
-                    # protocol makes the retry lossless (committed
-                    # lanes just dedup).  shard matching is per HOST
-                    # process: single-process meshes drive every shard,
-                    # so any armed shard fires (shard=None matches all)
+                    # transient exchange failure: bounded exponential-
+                    # backoff retry loop (ISSUE 5; was a one-shot
+                    # re-issue).  The pause/re-enter protocol makes
+                    # every retry lossless — committed lanes just
+                    # dedup — so the only budget is patience: after
+                    # `exchange_retries` CONSECUTIVE drops the run
+                    # fails loudly instead of spinning forever.  The
+                    # retry branch is rank-agreed (any-rank reduce):
+                    # a drop seen on one host process must send every
+                    # process down the same branch
+                    dropped = False
                     try:
                         fault_point("exchange", depth=depth,
-                                    shard=(jax.process_index()
-                                           if jax.process_count() > 1
-                                           else None), obs=obs)
+                                    shard=my_shard, obs=obs)
                     except InjectedExchangeDrop:
-                        obs.retry(attempt=1, backoff_s=0.0,
+                        dropped = True
+                    if agree_any(dropped):
+                        xretry += 1
+                        if xretry > self.exchange_retries:
+                            raise TLAError(
+                                f"sharded exchange failed {xretry} "
+                                f"consecutive times at level {depth} "
+                                f"(retry budget "
+                                f"{self.exchange_retries}); giving up")
+                        backoff = min(
+                            self.exchange_backoff_cap,
+                            self.exchange_backoff * 2 ** (xretry - 1))
+                        obs.retry(attempt=xretry, backoff_s=backoff,
                                   what="exchange")
-                        emit(f"exchange drop at level {depth}: "
-                             f"re-issuing the level step")
+                        emit(f"exchange drop at level {depth}: retry "
+                             f"{xretry}/{self.exchange_retries} in "
+                             f"{backoff:.2f}s")
+                        if backoff > 0:
+                            self._sleep(backoff)
                         continue
+                    xretry = 0
                     out = pipe.launch(
                         self._step, tables, front, n_front, start_t,
                         nb, nbp, nba, nbprm, nn, base_gid,
@@ -973,6 +1163,10 @@ class ShardedBFS:
         obs.gauge("fpset_capacity", cap_total)
         obs.gauge("fpset_occupancy",
                   fp_count / cap_total if cap_total else 0.0)
+        # mesh size of the run (compare_bench treats mesh mismatches
+        # between docs as advisory — a 4-device run and an 8-device
+        # run measure different regimes, not a regression)
+        obs.gauge("mesh_devices", int(self.D))
         if hasattr(self, "_dev_distinct"):
             # per-shard distinct counts, reduced on host 0 (the only
             # rank that writes the metrics file / journal)

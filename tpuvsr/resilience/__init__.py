@@ -9,10 +9,13 @@ Three pieces (ISSUE 3 tentpole):
   deterministically inside the real engine loops and the checkpoint
   writer, so every recovery path below is tier-1-testable;
 * **supervised run loop** (``supervisor.py``) — catches
-  RESOURCE_EXHAUSTED, degrades (tile halving -> paged fallback) with
-  bounded exponential-backoff retries resuming from the latest
-  snapshot, and turns SIGTERM/SIGINT into checkpoint-at-next-level-
-  boundary + the resumable exit code ``EXIT_RESUMABLE`` (75);
+  RESOURCE_EXHAUSTED, degrades (tile halving -> paged fallback; for
+  ``engine="sharded"`` the mesh-aware ladder: tile -> mesh shrink to
+  the largest pow2 device count -> paged, rank-agreed, with elastic
+  snapshot resharding on resume — ISSUE 5) with bounded
+  exponential-backoff retries resuming from the latest snapshot, and
+  turns SIGTERM/SIGINT into checkpoint-at-next-level-boundary + the
+  resumable exit code ``EXIT_RESUMABLE`` (75);
 * **checkpoint hardening** lives in ``engine/checkpoint.py``
   (per-payload CRC32, fsync around the rename dance, ``.old``
   fallback on payload-level corruption) and is exercised through the
@@ -31,12 +34,13 @@ from .faults import clear as clear_faults
 from .faults import install as install_faults
 from .supervisor import (DEFAULT_MIN_TILE, EXIT_RESUMABLE, Preempted,
                          PreemptionGuard, Supervisor, clear_preemption,
-                         is_oom, preempt_signal, request_preemption)
+                         is_device_loss, is_oom, preempt_signal,
+                         request_preemption)
 
 __all__ = [
     "FaultPlan", "InjectedFault", "InjectedOOM", "InjectedExchangeDrop",
     "fault_point", "install_faults", "clear_faults",
     "Supervisor", "PreemptionGuard", "Preempted", "EXIT_RESUMABLE",
-    "DEFAULT_MIN_TILE", "is_oom", "preempt_signal",
+    "DEFAULT_MIN_TILE", "is_oom", "is_device_loss", "preempt_signal",
     "request_preemption", "clear_preemption",
 ]

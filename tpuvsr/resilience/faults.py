@@ -13,6 +13,14 @@ comma-separated, parameters attached with ``@key=value``):
 
     oom@level=3                    raise an injected RESOURCE_EXHAUSTED
                                    at the start of BFS level 3
+    oom@shard=0                    same, scoped to one shard of a
+                                   sharded run: fires only on HOST
+                                   process 0 in multi-process runs (a
+                                   single-process mesh drives every
+                                   shard, so any armed shard fires) —
+                                   the device-loss/per-shard-OOM drill
+                                   the supervisor's mesh degrade
+                                   ladder exists for
     kill@level=5                   SIGTERM this process at the start of
                                    level 5 (simulated preemption; with
                                    the supervisor's PreemptionGuard the
@@ -39,8 +47,18 @@ comma-separated, parameters attached with ``@key=value``):
                                    process in multi-process runs; a
                                    single-process mesh drives every
                                    shard, so any armed shard fires
+    exchange-drop:3@shard=0        PERSISTENT flavor: the optional
+                                   ``:K`` count makes the drop fire K
+                                   consecutive times before clearing —
+                                   the flaky-ICI-link drill the
+                                   sharded driver's bounded
+                                   exponential-backoff retry loop
+                                   exists for (K greater than the
+                                   retry budget exhausts it and the
+                                   run fails loudly)
 
-Each entry fires AT MOST ONCE (arm the same spec twice for a repeat).
+Each entry fires AT MOST ONCE (arm the same spec twice for a repeat;
+``exchange-drop:K`` is the one counted exception — it fires K times).
 Faults are journaled as ``fault`` events through the run's observer
 before they act, so a journal always records *why* a run died or
 degraded.  With no plan installed every hook is a cheap no-op.
@@ -86,11 +104,16 @@ class InjectedExchangeDrop(InjectedFault):
 
 
 class Fault:
-    """One armed fault: kind + optional (level, shard, payload)."""
+    """One armed fault: kind + optional (level, shard, payload).
 
-    __slots__ = ("kind", "site", "level", "shard", "payload", "fired")
+    ``count`` is the number of times the fault fires before it clears
+    (1 for every kind except a counted ``exchange-drop:K``)."""
 
-    def __init__(self, kind, *, level=None, shard=None, payload=None):
+    __slots__ = ("kind", "site", "level", "shard", "payload", "fired",
+                 "count")
+
+    def __init__(self, kind, *, level=None, shard=None, payload=None,
+                 count=1):
         if kind not in KIND_SITE:
             raise ValueError(
                 f"unknown fault kind {kind!r} "
@@ -100,6 +123,10 @@ class Fault:
         self.level = level
         self.shard = shard
         self.payload = payload
+        self.count = int(count)
+        if self.count < 1:
+            raise ValueError(f"fault count must be >= 1 "
+                             f"(got {count!r})")
         self.fired = False
 
     def matches(self, site, depth=None, shard=None):
@@ -119,6 +146,8 @@ class Fault:
         parts = [self.kind]
         if self.payload:
             parts.append(f":{self.payload}")
+        elif self.kind == "exchange-drop" and self.count != 1:
+            parts.append(f":{self.count}")
         for k in ("level", "shard"):
             v = getattr(self, k)
             if v is not None:
@@ -140,7 +169,17 @@ def parse_fault(entry):
                              f"in {entry!r} (want level/shard)")
         kw[key] = int(val)
     if m.group("arg"):
-        kw["payload"] = m.group("arg")
+        if kind == "exchange-drop":
+            # exchange-drop:K — the arg is a persistence count, not a
+            # payload (a flaky link that drops K consecutive attempts)
+            try:
+                kw["count"] = int(m.group("arg"))
+            except ValueError:
+                raise ValueError(
+                    f"{entry!r}: exchange-drop:K needs an integer "
+                    f"count (got {m.group('arg')!r})")
+        else:
+            kw["payload"] = m.group("arg")
     if kind in _CKPT_KINDS and "payload" not in kw:
         raise ValueError(
             f"{entry!r}: {kind} needs a payload file name "
@@ -183,7 +222,10 @@ class FaultPlan:
         for f in self.faults:
             if not f.matches(site, depth=depth, shard=shard):
                 continue
-            f.fired = True
+            # counted faults (exchange-drop:K) clear after K fires;
+            # everything else is one-shot
+            f.count -= 1
+            f.fired = f.count <= 0
             if obs is not None:
                 extra = {}
                 if depth is not None:

@@ -10,6 +10,17 @@ The survival machinery for multi-day checking runs (ISSUE 3 tentpole):
   from the HBM-resident device engine to the host-paged frontier
   (``hbm -> paged``).  Every step is journaled (``fault`` / ``retry`` /
   ``degrade`` events) so the journal shows *why* a run slowed.
+* **Mesh-aware supervision** (ISSUE 5) — ``engine="sharded"`` runs the
+  multi-chip engine through its own ladder: per-shard tile halving ->
+  mesh shrink to the largest usable power-of-two device count (device
+  loss skips straight to the shrink) -> single-device paged fallback
+  (the sharded snapshot is converted in place so the final rung keeps
+  the run's progress).  A shrunken-mesh resume re-hash-partitions the
+  snapshot's N shards onto the smaller mesh
+  (``ShardedBFS`` reshard-on-load, journaled as a ``reshard`` event).
+  Restart decisions are rank-agreed — rank 0's classification of the
+  failure is broadcast so every process of a multi-host pack takes
+  the same branch of the ladder.
 * **Preemption** — ``PreemptionGuard`` installs SIGTERM/SIGINT
   handlers that request a checkpoint at the next level boundary; the
   engines write the rescue snapshot, journal a ``rescue_checkpoint``
@@ -136,20 +147,52 @@ def is_oom(exc):
         or "out of memory" in msg
 
 
+def is_device_loss(exc):
+    """True for failures that look like a device dropping out of the
+    mesh (ICI/DCN link loss, halted chip, dead runtime client) — the
+    pod-scale failure the sharded ladder answers with a mesh shrink
+    rather than a tile halving (less tile would not bring the device
+    back)."""
+    msg = str(exc)
+    return any(s in msg for s in (
+        "DATA_LOSS", "device is in an invalid state",
+        "Device or resource busy", "failed to connect",
+        "Socket closed", "DEADLINE_EXCEEDED", "device halted",
+        "UNAVAILABLE"))
+
+
+def _pow2_below(n):
+    """Largest power of two strictly below n (n >= 2)."""
+    p = 1
+    while p * 2 < n:
+        p *= 2
+    return p
+
+
 class Supervisor:
     """Run a BFS engine to completion through the retry/degrade ladder.
 
     ``engine_factory(kind, tile_size)`` builds a fresh engine per
-    attempt (kind is ``"device"`` or ``"paged"``); the default factory
-    builds DeviceBFS/PagedBFS on the supervisor's spec with
+    attempt (kind is ``"device"``, ``"paged"`` or ``"sharded"``; a
+    factory that also accepts an ``n_devices`` keyword is handed the
+    current mesh size); the default factory builds
+    DeviceBFS/PagedBFS/ShardedBFS on the supervisor's spec with
     ``engine_kwargs``.  The ladder on OOM:
 
-        tile -> tile/2 -> ... -> min_tile -> paged engine -> plain retry
+        device:  tile -> tile/2 -> ... -> min_tile -> paged -> retry
+        sharded: tile -> ... -> min_tile -> mesh D -> largest pow2 < D
+                 -> ... -> min_devices -> paged (snapshot converted
+                 in place so the fallback keeps the run's progress);
+                 device-loss failures skip straight to the mesh shrink
 
     with exponential backoff between attempts and auto-resume from the
-    supervisor's checkpoint dir whenever a snapshot exists.  Violations,
-    deadlocks and non-OOM errors propagate unchanged; ``Preempted``
-    propagates for the caller to map to EXIT_RESUMABLE."""
+    supervisor's checkpoint dir whenever a snapshot exists — a sharded
+    resume on a shrunken mesh re-hash-partitions the snapshot
+    (``ShardedBFS`` reshard-on-load).  Violations, deadlocks and
+    non-retryable errors propagate unchanged; ``Preempted`` propagates
+    for the caller to map to EXIT_RESUMABLE.  Every restart decision
+    is rank-agreed (rank 0's verdict broadcast) so a multi-host pack
+    never splits across ladder branches."""
 
     def __init__(self, spec, engine="device", *, checkpoint_path=None,
                  checkpoint_every=None, journal_path=None,
@@ -157,12 +200,23 @@ class Supervisor:
                  min_tile=DEFAULT_MIN_TILE, max_retries=6,
                  backoff_base=0.5, backoff_cap=30.0,
                  engine_kwargs=None, engine_factory=None, fused=False,
-                 sleep=time.sleep):
-        if engine not in ("device", "paged"):
-            raise ValueError(f"Supervisor supervises the device/paged "
-                             f"engines, not {engine!r}")
+                 mesh_devices=None, min_devices=1, sleep=time.sleep):
+        if engine not in ("device", "paged", "sharded"):
+            raise ValueError(f"Supervisor supervises the device/paged/"
+                             f"sharded engines, not {engine!r}")
         self.spec = spec
         self.kind = engine
+        # mesh size for the sharded ladder: starts at `mesh_devices`
+        # (default: every visible device) and only ever shrinks —
+        # to the largest usable power of two — down to `min_devices`
+        if engine == "sharded":
+            if mesh_devices is None:
+                import jax
+                mesh_devices = len(jax.devices())
+            self.n_dev = int(mesh_devices)
+        else:
+            self.n_dev = None
+        self.min_devices = max(1, int(min_devices))
         # fused=True: first attempt runs the fused fixpoint with its
         # dispatch bounded to a rescue quantum (run_fused checkpoint
         # mode, ISSUE 4 satellite); any retry that has a snapshot to
@@ -186,6 +240,7 @@ class Supervisor:
         self.engine = None          # last engine instance (CLI liveness)
         self.attempts = 0           # engine runs started
         self.degrades = []          # [(what, from, to), ...]
+        self._skip_resume = False   # set when a snapshot became unusable
         self._journal = Journal(journal_path)
         self._t0 = time.time()
 
@@ -197,9 +252,39 @@ class Supervisor:
         self._journal.write(
             event, elapsed_s=round(time.time() - self._t0, 3), **fields)
 
+    def _agree(self, flag):
+        """Rank-agreed boolean: rank 0's verdict, broadcast, so every
+        process of a multi-host pack takes the same ladder branch.
+        Single-process: the flag itself."""
+        import jax
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            return bool(int(multihost_utils.broadcast_one_to_all(
+                np.int32(bool(flag)))))
+        return bool(flag)
+
     def _make_engine(self):
         if self._factory is not None:
+            import inspect
+            params = inspect.signature(self._factory).parameters
+            if "n_devices" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()):
+                return self._factory(self.kind, self.tile,
+                                     n_devices=self.n_dev)
             return self._factory(self.kind, self.tile)
+        if self.kind == "sharded":
+            import numpy as np
+
+            import jax
+            from jax.sharding import Mesh
+
+            from ..parallel.sharded_bfs import ShardedBFS
+            kw = dict(self._engine_kwargs)
+            kw["tile"] = self.tile
+            mesh = Mesh(np.array(jax.devices()[:self.n_dev]), ("d",))
+            return ShardedBFS(self.spec, mesh, **kw)
         from ..engine.device_bfs import DeviceBFS
         from ..engine.paged_bfs import PagedBFS
         kw = dict(self._engine_kwargs)
@@ -210,6 +295,9 @@ class Supervisor:
     def summary(self):
         return {"attempts": self.attempts, "engine": self.kind,
                 "tile": self.tile, "fused": self.fused,
+                "mesh_devices": self.n_dev,
+                "resharded_from": getattr(self.engine,
+                                          "resharded_from", None),
                 "degrades": [list(d) for d in self.degrades]}
 
     # ------------------------------------------------------------------
@@ -257,11 +345,19 @@ class Supervisor:
                     except Preempted:
                         raise
                     except Exception as e:  # noqa: BLE001 — filtered below
-                        if not is_oom(e) \
+                        # retryability is RANK-AGREED: rank 0's
+                        # classification is broadcast so every process
+                        # of a multi-host pack takes the same branch
+                        # (a split here issues mismatched collectives)
+                        retryable = is_oom(e) or (
+                            self.kind == "sharded" and is_device_loss(e))
+                        if not self._agree(retryable) \
                                 or self.attempts > self.max_retries:
                             raise
                         self._handle_oom(e)
-                        if self.checkpoint_path and \
+                        if self._skip_resume:
+                            resume = None
+                        elif self.checkpoint_path and \
                                 os.path.isdir(self.checkpoint_path):
                             resume = self.checkpoint_path
                         # else: keep the caller's resume_from (the OOM
@@ -278,8 +374,13 @@ class Supervisor:
         # injected OOMs were journaled as `fault` events by the engine's
         # observer at fire time; journal real ones here so the journal
         # always explains the retry that follows
+        self._skip_resume = False
         if not isinstance(exc, InjectedFault):
             self._jwrite("fault", what="oom", site="run")
+        if self.kind == "sharded":
+            self._degrade_sharded(exc)
+            self._backoff_and_journal()
+            return
         if self.kind != "paged" and self.tile // 2 >= self.min_tile:
             old, self.tile = self.tile, self.tile // 2
             self.degrades.append(("tile", old, self.tile))
@@ -296,6 +397,73 @@ class Supervisor:
         else:
             self.log(f"OOM ({exc}): already on the paged engine; "
                      f"plain retry")
+        self._backoff_and_journal()
+
+    def _degrade_sharded(self, exc):
+        """The mesh-aware ladder (ISSUE 5): per-shard tile halving ->
+        mesh shrink to the largest usable power-of-two device count ->
+        single-device paged fallback.  Device-loss failures skip the
+        tile rung (a smaller tile does not bring a device back); the
+        paged rung converts the sharded snapshot in place so the
+        fallback resumes with the run's progress."""
+        dev_lost = is_device_loss(exc) and not is_oom(exc)
+        what = "device loss" if dev_lost else "OOM"
+        if not dev_lost and self.tile // 2 >= self.min_tile:
+            old, self.tile = self.tile, self.tile // 2
+            self.degrades.append(("tile", old, self.tile))
+            self._jwrite("degrade", what="tile",
+                         **{"from": old, "to": self.tile})
+            self.log(f"{what} ({exc}): degrading per-shard tile "
+                     f"{old} -> {self.tile}")
+        elif self.n_dev > max(1, self.min_devices):
+            old = self.n_dev
+            self.n_dev = max(self.min_devices, _pow2_below(self.n_dev))
+            self.degrades.append(("mesh", old, self.n_dev))
+            self._jwrite("degrade", what="mesh",
+                         **{"from": old, "to": self.n_dev})
+            self.log(f"{what} ({exc}): shrinking mesh {old} -> "
+                     f"{self.n_dev} devices (resume re-hash-partitions "
+                     f"the snapshot)")
+        else:
+            self.degrades.append(("engine", "sharded", "paged"))
+            self._jwrite("degrade", what="engine",
+                         **{"from": "sharded", "to": "paged"})
+            self.kind = "paged"
+            # sharded-only knobs (bucket_cap, axis, exchange_*, sleep,
+            # check_deadlock, ...) never reach the paged constructor:
+            # keep only what PagedBFS.__init__ actually accepts, so
+            # the final ladder rung cannot die on a TypeError
+            import inspect
+
+            from ..engine.device_bfs import DeviceBFS
+            from ..engine.paged_bfs import PagedBFS
+            accepted = set()
+            for cls in (DeviceBFS, PagedBFS):   # paged delegates *args
+                for name, p in inspect.signature(
+                        cls.__init__).parameters.items():
+                    if p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                        accepted.add(name)
+            accepted.discard("self")
+            for k in [k for k in self._engine_kwargs
+                      if k not in accepted]:
+                self._engine_kwargs.pop(k)
+            self.log(f"{what} ({exc}): mesh floor reached; falling "
+                     f"back to the single-device paged engine")
+            if self.checkpoint_path and \
+                    os.path.isdir(self.checkpoint_path):
+                try:
+                    from ..parallel.sharded_bfs import \
+                        convert_sharded_snapshot
+                    convert_sharded_snapshot(self.checkpoint_path,
+                                             self.spec, log=self._log)
+                except Exception as ce:  # noqa: BLE001 — keep degrading
+                    self._skip_resume = True
+                    self.log(f"sharded snapshot conversion failed "
+                             f"({type(ce).__name__}: {ce}); the paged "
+                             f"fallback restarts from the initial "
+                             f"states")
+
+    def _backoff_and_journal(self):
         backoff = min(self.backoff_cap,
                       self.backoff_base * (2 ** (self.attempts - 1)))
         self._jwrite("retry", attempt=self.attempts,

@@ -53,26 +53,38 @@ STUB_DISTINCT = 16
 STUB_LEVELS = [1, 2, 3, 4, 3, 2, 1]
 
 
-def counter_spec(inv_bound=None):
+def counter_spec(inv_bound=None, inv_x_bound=None):
     """The inline two-counter spec (16 states, diameter 6).
 
     With ``inv_bound`` the Bound invariant tightens to
     ``x + y <= inv_bound`` — reachable violations for bounds < 6, so
     engine violation/trace paths are testable without the reference
     (pair with ``stub_model_factory(inv_bound=...)`` so the device
-    kernel's invariant agrees with the interpreter's)."""
+    kernel's invariant agrees with the interpreter's).
+
+    ``inv_x_bound`` instead tightens to ``x <= inv_x_bound`` — the
+    UNIQUE-WITNESS variant: the first reachable violating state is
+    ``(inv_x_bound + 1, 0)``, which is the only violation at its BFS
+    level and has exactly one parent/action, so every engine on every
+    mesh size must surface the bit-identical counterexample trace
+    (the elastic-resume trace oracle, ISSUE 5)."""
     src = COUNTER
-    if inv_bound is not None:
+    if inv_x_bound is not None:
+        src = src.replace("Bound == x + y <= 2 * Limit",
+                          f"Bound == x <= {int(inv_x_bound)}")
+    elif inv_bound is not None:
         src = src.replace("Bound == x + y <= 2 * Limit",
                           f"Bound == x + y <= {int(inv_bound)}")
     return SpecModel(parse_module_text(src),
                      parse_cfg_text(COUNTER_CFG))
 
 
-def stub_model_factory(limit=3, inv_bound=None):
+def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
     """A ``model_factory`` producing a (codec, kernel) pair for the
     counter spec — drives the real device engines with no reference
-    kernel registered."""
+    kernel registered.  ``inv_bound``/``inv_x_bound`` mirror
+    ``counter_spec``'s tightened invariants (the kernel and the
+    interpreter must agree on what violates)."""
     import jax
     import jax.numpy as jnp
 
@@ -147,6 +159,8 @@ def stub_model_factory(limit=3, inv_bound=None):
             return jax.vmap(self.fingerprint)(arr)
 
         def invariant_fn(self, names):
+            if inv_x_bound is not None:
+                return lambda st: st["x"] <= inv_x_bound
             if inv_bound is None:
                 return lambda st: jnp.asarray(True)
             return lambda st: st["x"] + st["y"] <= inv_bound
@@ -180,4 +194,42 @@ def stub_engine_factory(spec, **engine_kw):
                    hash_mode="full", tile_size=tile,
                    fpset_capacity=1 << 8, next_capacity=1 << 6,
                    **engine_kw)
+    return make
+
+
+def stub_sharded_engine(n_devices=2, spec=None, inv_x_bound=None,
+                        **kw):
+    """A small ShardedBFS over the counter spec and the stub kernel on
+    the first `n_devices` virtual devices — the standard harness for
+    sharded engine-loop tests (elastic resume, exchange retry, mesh
+    supervision) without the reference mount."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from .parallel.sharded_bfs import ShardedBFS
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("d",))
+    return ShardedBFS(
+        spec or counter_spec(inv_x_bound=inv_x_bound), mesh,
+        model_factory=stub_model_factory(inv_x_bound=inv_x_bound),
+        tile=kw.pop("tile", 4), bucket_cap=kw.pop("bucket_cap", 64),
+        next_capacity=kw.pop("next_capacity", 1 << 6),
+        fpset_capacity=kw.pop("fpset_capacity", 1 << 8), **kw)
+
+
+def stub_sharded_factory(spec, **engine_kw):
+    """A ``Supervisor`` engine factory for the MESH degrade ladder:
+    builds the sharded engine at the requested (tile, n_devices) and
+    the paged engine once the ladder falls off the mesh floor — the
+    stub-kernel mirror of the supervisor's default factory."""
+    from .engine.paged_bfs import PagedBFS
+
+    def make(kind, tile, n_devices=None):
+        if kind == "sharded":
+            return stub_sharded_engine(n_devices=n_devices, spec=spec,
+                                       tile=tile, **dict(engine_kw))
+        return PagedBFS(spec, model_factory=stub_model_factory(),
+                        hash_mode="full", tile_size=max(tile, 2),
+                        fpset_capacity=1 << 8, next_capacity=1 << 6)
     return make
