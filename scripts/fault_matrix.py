@@ -36,6 +36,13 @@ reference mount, no TPU, seconds on the CPU backend:
   pipeline-faults    oom + kill injected into -pipeline 4 runs ->
                      the dispatch window drains, the supervisor/rescue
                      paths behave exactly as at -pipeline 1
+  service-preempt-requeue SIGTERM-style kill under the DISPATCHER
+                     (tpuvsr/service, ISSUE 6) -> job requeued with
+                     its rescue checkpoint, reclaimed, resumed to the
+                     exact fixpoint; job_* transitions journaled
+  service-oom-degrade injected OOM under the dispatcher -> the
+                     per-job supervisor degrades the tile inside ONE
+                     job run (no requeue), exact fixpoint
 
 Prints one JSON object; exit 0 iff every scenario passed.  Run by
 tests/test_resilience.py under tier-1 and standalone:
@@ -418,6 +425,67 @@ def scenario_kill_elastic_resume(tmp):
     }
 
 
+def scenario_service_preempt_requeue(tmp):
+    """A SIGTERM-style preemption UNDER THE DISPATCHER (ISSUE 6): the
+    injected kill fires mid-run inside the service worker, the job is
+    requeued with its rescue checkpoint attached, and the same drain
+    claims it again and resumes to the exact fixpoint — every
+    transition visible in the job's own journal."""
+    ORACLE = _oracle()
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    q = JobQueue(os.path.join(tmp, "spool"))
+    job = q.submit("<stub>", engine="device",
+                   flags={"stub": True, "inject": "kill@level=3"})
+    Worker(q, devices=1).drain()
+    done = q.get(job.job_id)
+    ev = [e["event"] for e in read_journal(q.journal_path(job.job_id))]
+    starts = [e for e in read_journal(q.journal_path(job.job_id))
+              if e["event"] == "job_started"]
+    return {
+        "ok": (done.state == "done" and done.attempts == 2
+               and done.result["distinct"] == ORACLE["distinct"]
+               and done.result["levels"] == ORACLE["levels"]
+               and "job_requeued" in ev and "rescue_checkpoint" in ev
+               and "job_done" in ev and len(starts) == 2),
+        "state": done.state, "attempts": done.attempts,
+        "distinct": done.result["distinct"],
+    }
+
+
+def scenario_service_oom_degrade(tmp):
+    """An injected OOM under the dispatcher: the per-job supervisor
+    degrades (tile halving) INSIDE one job run — the job never leaves
+    ``running``, completes with the exact fixpoint, and the degrade is
+    journaled in the job's own journal (ISSUE 6)."""
+    ORACLE = _oracle()
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    q = JobQueue(os.path.join(tmp, "spool"))
+    job = q.submit("<stub>", engine="device",
+                   flags={"stub": True, "inject": "oom@level=3",
+                          "supervisor": {"tile_size": 4, "min_tile": 2,
+                                         "backoff_base": 0.0}})
+    Worker(q, devices=1).drain()
+    done = q.get(job.job_id)
+    ev = [e["event"] for e in read_journal(q.journal_path(job.job_id))]
+    degrades = [e for e in read_journal(q.journal_path(job.job_id))
+                if e["event"] == "degrade"]
+    return {
+        "ok": (done.state == "done" and done.attempts == 1
+               and done.result["distinct"] == ORACLE["distinct"]
+               and done.result["levels"] == ORACLE["levels"]
+               and "fault" in ev and "retry" in ev
+               and any(d["what"] == "tile" and d["from"] == 4
+                       and d["to"] == 2 for d in degrades)
+               and "job_requeued" not in ev),
+        "state": done.state, "attempts": done.attempts,
+        "degrades": [(d["what"], d["from"], d["to"]) for d in degrades],
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -429,6 +497,8 @@ SCENARIOS = [
     ("oom-mesh-degrade", scenario_oom_mesh_degrade),
     ("kill-elastic-resume", scenario_kill_elastic_resume),
     ("pipeline-faults", scenario_pipeline_faults),
+    ("service-preempt-requeue", scenario_service_preempt_requeue),
+    ("service-oom-degrade", scenario_service_oom_degrade),
 ]
 
 

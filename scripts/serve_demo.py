@@ -1,0 +1,130 @@
+"""Dispatch-service smoke: submit 3 stub jobs, drain the queue.
+
+The ISSUE 6 acceptance drill, end to end in one process on the stub
+harness (no reference mount, CPU backend, seconds):
+
+  clean      a plain counter job — runs supervised, reaches the exact
+             16-state fixpoint, state ``done``
+  rejected   a spec that fails the speclint frames pass — the
+             admission gate kills it at ``queued -> failed``; it never
+             reaches ``running`` and costs zero device time
+  preempt    a SIGTERM-style preemption (injected kill@level=2) on a
+             job whose tightened invariant has a unique witness — the
+             job requeues with its rescue checkpoint, resumes, and
+             reports the violation with a trace BIT-IDENTICAL to an
+             uninterrupted oracle run (the PR 4/5 equivalence
+             contract, now holding across the dispatcher)
+
+Every lifecycle transition must be visible in the per-job journals
+(``job_submitted``/``job_admitted``/``job_started``/``job_requeued``/
+``job_done`` interleaved with the engine's own events).
+
+Prints one JSON object; exit 0 iff every expectation holds.
+
+    python scripts/serve_demo.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _fl = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _fl:
+        os.environ["XLA_FLAGS"] = (
+            _fl + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, REPO)
+
+
+def main():
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker, result_summary
+    from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS
+
+    tmp = tempfile.mkdtemp(prefix="tpuvsr-serve-demo-")
+    out = {"jobs": {}}
+    try:
+        q = JobQueue(os.path.join(tmp, "spool"))
+        clean = q.submit("<stub:clean>", engine="device",
+                         flags={"stub": True})
+        rejected = q.submit("<stub:rejected>", engine="device",
+                            flags={"stub": True, "stub_bad": True})
+        preempt = q.submit("<stub:preempt>", engine="device",
+                           flags={"stub": True, "inv_x_bound": 2,
+                                  "inject": "kill@level=2"})
+        runs = Worker(q, devices=2).drain()
+
+        # the uninterrupted oracle for the preempted job: the same
+        # tightened-invariant engine, run clean, serialized the same way
+        from tpuvsr.engine.device_bfs import DeviceBFS
+        from tpuvsr.testing import counter_spec, stub_model_factory
+        eng = DeviceBFS(counter_spec(inv_x_bound=2),
+                        model_factory=stub_model_factory(inv_x_bound=2),
+                        hash_mode="full", tile_size=4,
+                        fpset_capacity=1 << 8, next_capacity=1 << 6)
+        preempt_oracle = result_summary(eng.run())
+
+        checks = {}
+        jc = q.get(clean.job_id)
+        evs_c = [e["event"]
+                 for e in read_journal(q.journal_path(clean.job_id))]
+        checks["clean_done_exact_fixpoint"] = (
+            jc.state == "done"
+            and jc.result["distinct"] == STUB_DISTINCT
+            and jc.result["levels"] == STUB_LEVELS)
+        checks["clean_journal_lifecycle"] = (
+            ["job_submitted", "job_admitted", "job_started"]
+            == [e for e in evs_c if e.startswith("job_")][:3]
+            and evs_c[-1] == "job_done")
+
+        jr = q.get(rejected.job_id)
+        evs_r = [e["event"]
+                 for e in read_journal(q.journal_path(rejected.job_id))]
+        checks["rejected_by_speclint"] = (
+            jr.state == "failed" and jr.reason == "speclint"
+            and bool((jr.result or {}).get("speclint")))
+        checks["rejected_never_ran"] = (
+            "job_started" not in evs_r and "run_start" not in evs_r
+            and jr.attempts == 0)
+
+        jp = q.get(preempt.job_id)
+        evs_p = [e["event"]
+                 for e in read_journal(q.journal_path(preempt.job_id))]
+        checks["preempt_requeued_then_completed"] = (
+            jp.state == "violated" and jp.attempts == 2
+            and "job_requeued" in evs_p
+            and "rescue_checkpoint" in evs_p)
+        checks["preempt_bit_identical_to_oracle"] = (
+            jp.result is not None
+            and jp.result.get("violated")
+            == preempt_oracle.get("violated")
+            and jp.result.get("trace") == preempt_oracle.get("trace")
+            and jp.result["distinct"] == preempt_oracle["distinct"])
+
+        for job, evs in ((jc, evs_c), (jr, evs_r), (jp, evs_p)):
+            out["jobs"][job.spec] = {
+                "state": job.state, "attempts": job.attempts,
+                "reason": job.reason, "journal_events": evs,
+            }
+        out["runs"] = runs
+        out["stats"] = q.stats()
+        out["checks"] = checks
+        out["ok"] = all(checks.values())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(out, indent=1, default=str))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tpuvsr.resilience.supervisor import EXIT_RESUMABLE  # noqa: E402
+from tpuvsr.exitcodes import EX_RESUMABLE as EXIT_RESUMABLE  # noqa: E402
 
 
 def main(argv=None):

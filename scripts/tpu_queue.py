@@ -1,17 +1,20 @@
-"""TPU job queue: waits for the flapping axon tunnel and runs the
-round's TPU workload whenever the tunnel is up, one job at a time
-(the chip is single-tenant), with a hard timeout per job so a mid-job
-flap cannot wedge the queue.
+"""TPU round-workload driver — DEPRECATED thin wrapper.
 
-The first r4 TPU session proved the failure mode this guards against:
-the tunnel came up, bench.py completed on backend "tpu", then the
-tunnel died ~25 min later and the in-flight differential pytest hung
-forever on a dead RPC (zero CPU, state wait_woken) and had to be
-killed.  Probe first, bound everything, record every attempt.
+The bespoke queue this script used to implement (state json + attempt
+log + one-at-a-time subprocess runner) was absorbed by the dispatch
+service (ISSUE 6): there is ONE queue implementation now —
+``tpuvsr.service`` — and this wrapper only (a) submits the round's
+TPU workload as ``kind="shell"`` jobs into a service spool at
+``scripts/tpu_spool/`` and (b) gates the drain loop on the axon
+tunnel probe, with the original flap rule (a failure with the tunnel
+dead afterwards refunds the attempt — the job never really ran).
 
-State: scripts/tpu_queue_state.json (job -> done/attempts).
-Log:   scripts/tpu_queue_log.jsonl (one line per attempt).
-Test results aggregate into scripts/tpu_tests.json (attached to bench).
+Durability, claims, attempts, per-job journals and the exit-code ->
+state mapping all come from the service; the historical
+``scripts/tpu_tests.json`` aggregate is still produced for bench
+attachment.  The old ``tpu_queue_state.json`` / ``tpu_queue_log.jsonl``
+files are no longer written (the spool's ``jobs.jsonl`` +
+``journals/`` supersede them).
 
 Run detached:  python scripts/tpu_queue.py
 """
@@ -20,8 +23,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 
@@ -29,10 +30,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPTS = os.path.join(REPO, "scripts")
 sys.path.insert(0, REPO)
 
-from tpuvsr.platform_select import probe_tpu
+from tpuvsr.platform_select import probe_tpu          # noqa: E402
+from tpuvsr.service.queue import TERMINAL, JobQueue   # noqa: E402
+from tpuvsr.service.worker import Worker              # noqa: E402
 
-STATE = os.path.join(SCRIPTS, "tpu_queue_state.json")
-LOG = os.path.join(SCRIPTS, "tpu_queue_log.jsonl")
+SPOOL = os.path.join(SCRIPTS, "tpu_spool")
 TESTS_OUT = os.path.join(SCRIPTS, "tpu_tests.json")
 
 MODULES = ["vsr", "a01", "i01", "st03", "as04", "rr05", "al05", "cp06"]
@@ -41,17 +43,8 @@ ENV_TEST = {"TPUVSR_TEST_BACKEND": "tpu"}
 ENV_TPU = {"TPUVSR_TPU": "1"}
 
 # (name, argv, timeout_s, extra_env) — ROUND 5 priority order for
-# ~45-min tunnel windows (VERDICT r4 "next round" items 1-3, 6, 8):
-#   1. miscompile repro ladder (localize the tile-1024 TPU divergence;
-#      everything else's trust rests on it),
-#   2. defect-config paged window on the chip (the graded headline:
-#      >=10x the CPU window's 1,160 distinct/s), resumable via
-#      checkpoint so flapped windows extend instead of restarting,
-#   3. a fresh full bench capture,
-#   4. the 7 remaining per-module differential suites under the TPU
-#      lowering (difftest-vsr passed in r4, state carries over),
-#   5. configs[2] simulation scale + the guided hunt on TPU,
-#   6. the RR05 deep pin, extra defect depth, and the slow tier.
+# ~45-min tunnel windows (VERDICT r4 "next round" items; see git
+# history of this file for the full rationale per entry)
 JOBS = [
     ("miscompile-repro",
      [sys.executable, "scripts/tpu_miscompile_repro.py"], 3600,
@@ -65,46 +58,35 @@ JOBS = [
 ]
 for m in MODULES:
     JOBS.append((f"difftest-{m}",
-                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
+                 [sys.executable, "-m", "pytest",
+                  f"tests/test_{m}_kernel.py",
                   "-q", "-m", "not slow", "--tb=line"], 2400, ENV_TEST))
 JOBS += [
-    # shipped-constant runs (VERDICT r4 item 5): the liveness ladder
-    # toward the shipped cfg (the fully-shipped space projects past
-    # 1e8 states — scripts/a01_shipped_probe.json — so the ladder
-    # rungs deliver complete verdicts and the shipped run is an
-    # honest bounded attempt, queued later), and the shipped VSR.cfg
-    # safety pin (resumable via checkpoint)
     ("liveness-a01-v2t1",
      [sys.executable, "scripts/liveness_shipped.py",
       "a01", "8000000", "512", "16", "2", "1"], 3300, ENV_TPU),
-    # |V|=1/timer=2 measured >6M distinct at depth 18 on CPU (the
-    # timer axis is the blow-up); raised cap, may still be bounded
     ("liveness-a01-v1t2",
      [sys.executable, "scripts/liveness_shipped.py",
       "a01", "20000000", "512", "16", "1", "2"], 3600, ENV_TPU),
     ("shipped-pin",
      [sys.executable, "scripts/shipped_pin.py", "1500", "512", "32"],
      2700, ENV_TPU),
-    # walkers max_seconds num — 4096 reuses the calibrated group caps;
-    # the wide job then exploits the TPU's parallel headroom
     ("sim-scale",
      [sys.executable, "scripts/sim_scale.py",
       "4096", "1500", "1000000"], 2100, ENV_TPU),
     ("sim-scale-wide",
      [sys.executable, "scripts/sim_scale.py",
-      "16384", "1500", "1000000", "sim_scale_wide.json"], 2100, ENV_TPU),
-    # walkers depth max_seconds seed sigma mode
+      "16384", "1500", "1000000", "sim_scale_wide.json"], 2100,
+     ENV_TPU),
     ("defect-hunt",
      [sys.executable, "scripts/defect_hunt.py",
       "4096", "48", "1200", "1", "1.0", "guided"], 2000, ENV_TPU),
     ("rr05-deep",
      [sys.executable, "scripts/rr05_deep.py", "1500", "512", "32"],
      2700, ENV_TPU),
-    # a second window resumes the defect checkpoint and goes deeper
     ("defect-window-2",
      [sys.executable, "scripts/defect_bfs_window.py",
       "1800", "512", "32"], 3300, ENV_TPU),
-    # fused-vs-chunked differential ON the TPU lowering
     ("difftest-fused",
      [sys.executable, "-m", "pytest", "tests/test_fused_bfs.py",
       "-q", "--tb=line"], 5400, ENV_TEST),
@@ -117,43 +99,42 @@ JOBS += [
     ("shipped-pin-2",
      [sys.executable, "scripts/shipped_pin.py", "1500", "512", "32"],
      2700, ENV_TPU),
-    # honest bounded attempt at the fully-shipped liveness constants
     ("liveness-shipped-a01",
      [sys.executable, "scripts/liveness_shipped.py",
       "a01", "25000000", "512", "16"], 3600, ENV_TPU),
 ]
 for m in MODULES:
     JOBS.append((f"difftest-slow-{m}",
-                 [sys.executable, "-m", "pytest", f"tests/test_{m}_kernel.py",
+                 [sys.executable, "-m", "pytest",
+                  f"tests/test_{m}_kernel.py",
                   "-q", "-m", "slow", "--tb=line"], 5400, ENV_TEST))
 
 MAX_ATTEMPTS = 3
 
 
-def load_state():
-    if os.path.exists(STATE):
-        with open(STATE) as f:
-            return json.load(f)
-    return {}
+def submit_workload(q):
+    """Enqueue the round's workload once (idempotent: job ids are the
+    workload names; resubmission is skipped)."""
+    existing = {j.job_id for j in q.jobs()}
+    for i, (name, argv, timeout, extra_env) in enumerate(JOBS):
+        if name in existing:
+            continue
+        # earlier entries run first: the service pops highest priority
+        q.submit(name, kind="shell", job_id=name,
+                 priority=len(JOBS) - i,
+                 flags={"argv": argv, "timeout": timeout,
+                        "env": extra_env, "cwd": REPO,
+                        "max_attempts": MAX_ATTEMPTS})
 
 
-def save_state(st):
-    with open(STATE, "w") as f:
-        json.dump(st, f, indent=1)
-
-
-def log(rec):
-    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    with open(LOG, "a") as f:
-        f.write(json.dumps(rec) + "\n")
-
-
-def update_tests_json(st):
+def update_tests_json(q):
     tests = {}
-    for name, info in st.items():
-        if name.startswith("difftest"):
-            tests[name] = {k: info.get(k) for k in
-                           ("done", "attempts", "rc", "tail")}
+    for j in q.jobs():
+        if j.job_id.startswith("difftest"):
+            r = j.result or {}
+            tests[j.job_id] = {"done": j.state == "done",
+                               "attempts": j.attempts,
+                               "rc": r.get("rc"), "tail": r.get("tail")}
     out = {
         "backend": "tpu (axon tunnel, v5e)",
         "what": ("per-module kernel differential pytest runs executed "
@@ -169,64 +150,24 @@ def update_tests_json(st):
         json.dump(out, f, indent=1)
 
 
-def run_job(name, argv, timeout, extra_env):
-    env = dict(os.environ)
-    env.update(extra_env)
-    t0 = time.time()
-    try:
-        p = subprocess.Popen(argv, cwd=REPO, env=env,
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT, text=True,
-                             start_new_session=True)
-        try:
-            out, _ = p.communicate(timeout=timeout)
-            rc = p.returncode
-        except subprocess.TimeoutExpired:
-            os.killpg(p.pid, signal.SIGKILL)
-            out, _ = p.communicate()
-            rc = -9
-    except Exception as e:  # noqa: BLE001
-        return -1, f"launcher error: {e}", time.time() - t0
-    tail = "\n".join((out or "").strip().splitlines()[-6:])
-    return rc, tail, time.time() - t0
-
-
 def main():
-    st = load_state()
+    q = JobQueue(SPOOL)
+    submit_workload(q)
+    # flap rule: a nonzero rc with the tunnel dead right after means
+    # the job never ran against a live tunnel — refund the attempt
+    w = Worker(q, devices=1, log=lambda m: print(m, file=sys.stderr),
+               shell_retry_gate=lambda job, rc: probe_tpu(90) <= 0)
     deadline = time.time() + float(
         os.environ.get("TPU_QUEUE_MAX_HOURS", "12")) * 3600
     while time.time() < deadline:
-        pending = [j for j in JOBS
-                   if not st.get(j[0], {}).get("done")
-                   and st.get(j[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
-        if not pending:
-            log({"event": "queue-drained"})
+        if not [j for j in q.jobs() if j.state not in TERMINAL]:
             break
-        n = probe_tpu(90)
-        if n <= 0:
-            log({"event": "tunnel-down"})
+        if probe_tpu(90) <= 0:
             time.sleep(180)
             continue
-        name, argv, timeout, extra_env = pending[0]
-        log({"event": "start", "job": name})
-        rc, tail, el = run_job(name, argv, timeout, extra_env)
-        info = st.setdefault(name, {"attempts": 0})
-        # a failure with the tunnel dead afterwards is a flap, not a
-        # job failure: the conftest probe-refusal, a -9 hard timeout,
-        # or a mid-job RPC hang all leave rc!=0 without the job ever
-        # running against a live tunnel — don't burn an attempt
-        flap = rc != 0 and probe_tpu(90) <= 0
-        if not flap:
-            info["attempts"] += 1
-        info["rc"] = rc
-        info["tail"] = tail
-        info["elapsed_s"] = round(el, 1)
-        info["done"] = (rc == 0)
-        save_state(st)
-        update_tests_json(st)
-        log({"event": "finish", "job": name, "rc": rc, "flap": flap,
-             "elapsed_s": round(el, 1), "tail": tail[-400:]})
-    log({"event": "queue-exit"})
+        w.drain(max_jobs=1)
+        update_tests_json(q)
+    update_tests_json(q)
 
 
 if __name__ == "__main__":

@@ -1,10 +1,11 @@
-"""Tunnel-watch loop (VERDICT r3 item 1).
+"""Tunnel-watch loop — DEPRECATED thin wrapper.
 
-Re-probes the axon TPU tunnel every few minutes for the whole round,
-appending one JSON line per attempt to ``scripts/tpu_probe_log.jsonl``
-so the tunnel's availability (or absence) is auditable.  When a probe
-sees >0 devices it drops ``scripts/TPU_UP`` as a flag file and keeps
-watching (the tunnel can flap).
+The probe loop moved into the dispatch service as its
+backend-availability input: ``tpuvsr.service.scheduler.watch_backend``
+(ISSUE 6 absorbed this script; the scheduler's cpu-vs-tpu placement
+advisory reads the same availability signal).  This wrapper keeps the
+historical entry point and artifact paths
+(``scripts/tpu_probe_log.jsonl`` / ``scripts/TPU_UP``) alive:
 
 Run detached:  python scripts/tpu_watch.py --interval 300
 """
@@ -12,17 +13,17 @@ Run detached:  python scripts/tpu_watch.py --interval 300
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-from tpuvsr.platform_select import probe_tpu
+from tpuvsr.service.scheduler import watch_backend  # noqa: E402
 
-LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_probe_log.jsonl")
-FLAG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_UP")
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(HERE, "tpu_probe_log.jsonl")
+FLAG = os.path.join(HERE, "TPU_UP")
 
 
 def main():
@@ -31,24 +32,8 @@ def main():
     ap.add_argument("--timeout", type=float, default=75.0)
     ap.add_argument("--max-hours", type=float, default=13.0)
     args = ap.parse_args()
-
-    t0 = time.time()
-    while time.time() - t0 < args.max_hours * 3600:
-        t = time.time()
-        n = probe_tpu(args.timeout)
-        rec = {
-            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)),
-            "probe_s": round(time.time() - t, 1),
-            "devices": n,
-        }
-        with open(LOG, "a") as f:
-            f.write(json.dumps(rec) + "\n")
-        if n > 0:
-            with open(FLAG, "w") as f:
-                f.write(json.dumps(rec) + "\n")
-        elif os.path.exists(FLAG):
-            os.remove(FLAG)
-        time.sleep(max(0.0, args.interval - (time.time() - t)))
+    watch_backend(LOG, FLAG, interval=args.interval,
+                  timeout=args.timeout, max_hours=args.max_hours)
 
 
 if __name__ == "__main__":

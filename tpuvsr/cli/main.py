@@ -104,10 +104,25 @@ whose rescue quantum makes fused snapshots possible); -fpset host with
 non-auto -fpset (its fingerprint set is always the mesh-sharded HBM
 table).
 
-Exit codes: 0 ok; 1 speclint errors (-lint); 2 bad flags; 12 safety/
-temporal violation (TLC's code); 75 preempted-but-resumable (a
--supervise run caught SIGTERM/SIGINT and wrote a rescue snapshot —
-rerun with -recover to continue).
+Exit codes (the unified contract in tpuvsr/exitcodes.py): 0 ok;
+1 speclint errors (-lint); 2 bad flags; 12 safety/temporal violation
+(TLC's code); 75 preempted-but-resumable (a -supervise run caught
+SIGTERM/SIGINT and wrote a rescue snapshot — rerun with -recover to
+continue).  The dispatch service maps these to job terminal states
+from the same table.
+
+Service verbs (ISSUE 6; tpuvsr/service — README "Service"):
+
+    python -m tpuvsr submit SPEC.tla [-config F] [--engine E]
+                     [--priority N] [--devices N] [--spool DIR] ...
+    python -m tpuvsr serve  [--spool DIR] [--drain] ...
+    python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
+    python -m tpuvsr cancel JOB [--spool DIR]
+
+turn the checker into a long-running verification dispatcher: a
+durable job queue with speclint admission, a mesh scheduler with
+elastic shrink/grow of live sharded runs, and per-job journals +
+metrics docs as the query surface.
 """
 
 from __future__ import annotations
@@ -117,6 +132,8 @@ import json
 import os
 import sys
 import time
+
+from ..exitcodes import EX_LINT, EX_OK, EX_VIOLATION
 
 
 def build_parser():
@@ -257,6 +274,14 @@ def _pick_engine(requested, fpset, spec):
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # dispatch-service verbs (ISSUE 6): `python -m tpuvsr serve|submit|
+    # status|cancel ...` routes to tpuvsr/service/api.py before the
+    # TLC-compatible parser ever sees the argv (a positional spec named
+    # "serve" is implausible; use ./serve to check a file of that name)
+    if argv and argv[0] in ("serve", "submit", "status", "cancel"):
+        from ..service.api import main as service_main
+        return service_main(argv)
     parser = build_parser()
     args = parser.parse_args(argv)
     validate_args(parser, args)
@@ -317,7 +342,7 @@ def main(argv=None):
         preflight(spec, log=log)
     except LintError as e:
         print(f"[tpuvsr] {e}", file=sys.stderr)
-        return 1
+        return EX_LINT
 
     # observability: one RunObserver rides the whole engine run —
     # journal (JSONL event stream), metrics collector, profiler hooks.
@@ -529,7 +554,8 @@ def main(argv=None):
     else:
         for k, v in summary.items():
             print(f"{k}: {v}")
-    return 0 if res.ok else 12        # TLC exit code 12 = safety violation
+    # TLC's code 12 = safety violation (tpuvsr/exitcodes.py table)
+    return EX_OK if res.ok else EX_VIOLATION
 
 
 if __name__ == "__main__":

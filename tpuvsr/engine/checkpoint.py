@@ -174,6 +174,23 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         _fsync_path(parent)
 
 
+def snapshot_info(path):
+    """Cheap manifest-only summary of a snapshot directory — the
+    checkpoint handoff record the dispatch service attaches to a
+    requeued job (ISSUE 6): ``{path, depth, distinct, elapsed}`` or
+    None when `path` holds no readable manifest.  Reads no payloads,
+    so a worker can stamp a rescue onto the queue without touching
+    multi-GB npz files."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            mf = json.load(f)
+        return {"path": path, "depth": int(mf["depth"]),
+                "distinct": int(mf["fp_count"]),
+                "elapsed": float(mf["elapsed"])}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def prior_elapsed(path) -> float:
     """Cumulative wall-clock recorded in a snapshot's manifest (0.0
     when absent/unreadable).  Resumable window scripts add this to

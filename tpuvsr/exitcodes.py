@@ -1,0 +1,59 @@
+"""The one exit-code contract (ISSUE 6 satellite).
+
+Every process boundary in the system speaks the same five codes; they
+were previously scattered as literals across ``tpuvsr/cli/main.py``,
+``tpuvsr/resilience/supervisor.py`` and ``scripts/supervise.py``:
+
+    EX_OK          0   clean run (safety + temporal properties hold)
+    EX_LINT        1   speclint errors (``-lint`` report mode, or the
+                       engines' fail-fast pre-flight gate)
+    EX_USAGE       2   bad flags (argparse's usage-error code)
+    EX_VIOLATION  12   safety/temporal violation (TLC's code)
+    EX_RESUMABLE  75   preempted-but-resumable (BSD EX_TEMPFAIL): a
+                       supervised run caught SIGTERM/SIGINT (or a
+                       scheduler preemption) and wrote a rescue
+                       snapshot — rerun with ``-recover`` to continue
+    EX_SOFTWARE   70   internal engine error (BSD EX_SOFTWARE) — the
+                       library-mode outcome code for a run that died
+                       on a non-retryable exception
+
+``JOB_STATE`` is the single table the verification dispatch service
+(``tpuvsr/service``) maps these to job terminal states with: the
+worker never interprets an exit code ad hoc, and an unknown code is a
+``failed`` job, never a silently-dropped one.
+"""
+
+from __future__ import annotations
+
+EX_OK = 0
+EX_LINT = 1
+EX_USAGE = 2
+EX_SOFTWARE = 70
+EX_VIOLATION = 12
+EX_RESUMABLE = 75
+
+#: exit code -> service job terminal state (tpuvsr/service/queue.py
+#: state machine).  EX_RESUMABLE is the one NON-terminal mapping: a
+#: preempted-requeued job goes back onto the queue with its rescue
+#: checkpoint attached and runs again.
+JOB_STATE = {
+    EX_OK: "done",
+    EX_VIOLATION: "violated",
+    EX_LINT: "failed",
+    EX_USAGE: "failed",
+    EX_SOFTWARE: "failed",
+    EX_RESUMABLE: "preempted-requeued",
+}
+
+
+def job_state(code) -> str:
+    """Service job state for a process exit code; any code outside the
+    contract is a plain failure."""
+    return JOB_STATE.get(int(code), "failed")
+
+
+def describe(code) -> str:
+    names = {EX_OK: "ok", EX_LINT: "lint-errors", EX_USAGE: "bad-flags",
+             EX_SOFTWARE: "internal-error", EX_VIOLATION: "violation",
+             EX_RESUMABLE: "preempted-resumable"}
+    return names.get(int(code), f"unknown({code})")

@@ -49,6 +49,15 @@ EVENT_REQUIRED = {
     # elastic sharded resume (ISSUE 5): an N-shard snapshot was
     # re-hash-partitioned onto an M-device mesh at load time
     "reshard": ("from_shards", "to_shards", "distinct", "elapsed_s"),
+    # verification dispatch service (ISSUE 6): job lifecycle events,
+    # appended by the service worker to each job's OWN journal (the
+    # engine/supervisor events of every attempt interleave in the same
+    # file, so one journal tells a job's whole story)
+    "job_submitted": ("job_id", "spec", "engine"),
+    "job_admitted": ("job_id", "elapsed_s"),
+    "job_started": ("job_id", "attempt", "devices"),
+    "job_requeued": ("job_id", "reason", "elapsed_s"),
+    "job_done": ("job_id", "state", "elapsed_s"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

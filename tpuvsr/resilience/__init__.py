@@ -32,10 +32,11 @@ from .faults import (FaultPlan, InjectedExchangeDrop, InjectedFault,
                      InjectedOOM, fault_point)
 from .faults import clear as clear_faults
 from .faults import install as install_faults
-from .supervisor import (DEFAULT_MIN_TILE, EXIT_RESUMABLE, Preempted,
-                         PreemptionGuard, Supervisor, clear_preemption,
-                         is_device_loss, is_oom, preempt_signal,
-                         request_preemption)
+from .supervisor import (DEFAULT_MIN_TILE, EXIT_RESUMABLE, Outcome,
+                         Preempted, PreemptionGuard, Supervisor,
+                         clear_preemption, is_device_loss, is_oom,
+                         preempt_signal, request_preemption,
+                         run_supervised)
 
 __all__ = [
     "FaultPlan", "InjectedFault", "InjectedOOM", "InjectedExchangeDrop",
@@ -43,4 +44,5 @@ __all__ = [
     "Supervisor", "PreemptionGuard", "Preempted", "EXIT_RESUMABLE",
     "DEFAULT_MIN_TILE", "is_oom", "is_device_loss", "preempt_signal",
     "request_preemption", "clear_preemption",
+    "Outcome", "run_supervised",
 ]

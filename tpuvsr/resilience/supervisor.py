@@ -42,14 +42,18 @@ from __future__ import annotations
 import os
 import signal
 import time
+from dataclasses import dataclass, field
 
+from ..exitcodes import (EX_OK, EX_RESUMABLE, EX_SOFTWARE, EX_VIOLATION,
+                         job_state)
 from ..obs import Journal, RunObserver
 from .faults import InjectedFault, InjectedOOM
 
 #: exit code of a preempted-but-resumable supervised run (EX_TEMPFAIL:
-#: rerun with -recover to continue).  Distinct from 0 (ok), 12 (TLC
-#: safety violation), 1 (lint errors), 2 (bad flags).
-EXIT_RESUMABLE = 75
+#: rerun with -recover to continue).  The value lives in the unified
+#: exit-code table (tpuvsr/exitcodes.py, ISSUE 6 satellite); this name
+#: is kept as the historical alias every caller imports.
+EXIT_RESUMABLE = EX_RESUMABLE
 
 #: smallest tile the degrade ladder will retry before falling back to
 #: the paged engine
@@ -200,7 +204,8 @@ class Supervisor:
                  min_tile=DEFAULT_MIN_TILE, max_retries=6,
                  backoff_base=0.5, backoff_cap=30.0,
                  engine_kwargs=None, engine_factory=None, fused=False,
-                 mesh_devices=None, min_devices=1, sleep=time.sleep):
+                 mesh_devices=None, min_devices=1, sleep=time.sleep,
+                 observer_factory=None, on_event=None):
         if engine not in ("device", "paged", "sharded"):
             raise ValueError(f"Supervisor supervises the device/paged/"
                              f"sharded engines, not {engine!r}")
@@ -237,6 +242,14 @@ class Supervisor:
         self._factory = engine_factory
         self._sleep = sleep
         self._log = log
+        # per-job hooks (ISSUE 6): `observer_factory` builds the
+        # per-attempt RunObserver (the dispatch service substitutes one
+        # whose level_done ticks the scheduler); `on_event` mirrors
+        # every supervisor journal write as on_event(event, fields) so
+        # a host process can track degrades/retries without re-reading
+        # the journal file
+        self._observer_factory = observer_factory or RunObserver
+        self._on_event = on_event
         self.engine = None          # last engine instance (CLI liveness)
         self.attempts = 0           # engine runs started
         self.degrades = []          # [(what, from, to), ...]
@@ -251,6 +264,8 @@ class Supervisor:
     def _jwrite(self, event, **fields):
         self._journal.write(
             event, elapsed_s=round(time.time() - self._t0, 3), **fields)
+        if self._on_event is not None:
+            self._on_event(event, dict(fields))
 
     def _agree(self, flag):
         """Rank-agreed boolean: rank 0's verdict, broadcast, so every
@@ -309,9 +324,10 @@ class Supervisor:
                 while True:
                     self.attempts += 1
                     self.engine = self._make_engine()
-                    obs = RunObserver(journal_path=self.journal_path,
-                                      metrics_path=self.metrics_path,
-                                      log=self._log)
+                    obs = self._observer_factory(
+                        journal_path=self.journal_path,
+                        metrics_path=self.metrics_path,
+                        log=self._log)
                     use_fused = self.fused and self.kind == "device"
                     if use_fused and resume is not None \
                             and not self._fused_degraded:
@@ -472,3 +488,72 @@ class Supervisor:
                  f"in {backoff:.1f}s")
         if backoff > 0:
             self._sleep(backoff)
+
+    # ------------------------------------------------------------------
+    # library mode (ISSUE 6 satellite): a worker process hosting MANY
+    # jobs cannot let one preemption own the process exit — run() still
+    # raises Preempted for the CLI (byte-identical behavior), while
+    # run_to_outcome() folds every ending into an Outcome value.
+    # ------------------------------------------------------------------
+    def run_to_outcome(self, **run_kwargs) -> "Outcome":
+        """``run()`` with every ending reified as an :class:`Outcome`
+        instead of an exception/exit-code side channel:
+
+        * clean fixpoint          -> ``done`` (EX_OK)
+        * invariant/deadlock      -> ``violated`` (EX_VIOLATION)
+        * ``Preempted``           -> ``preempted-requeued``
+          (EX_RESUMABLE) with the rescue snapshot attached
+        * anything non-retryable  -> ``failed`` (EX_SOFTWARE)
+
+        The state strings ARE the service job terminal states — the
+        mapping lives in ``tpuvsr.exitcodes.JOB_STATE`` and nowhere
+        else."""
+        try:
+            res = self.run(**run_kwargs)
+        except Preempted as p:
+            return Outcome(
+                state=job_state(EX_RESUMABLE), exit_code=EX_RESUMABLE,
+                rescue={"path": p.path, "depth": p.depth,
+                        "distinct": p.distinct, "signal": p.signal},
+                summary=self.summary())
+        except Exception as e:  # noqa: BLE001 — reified, not swallowed
+            return Outcome(state=job_state(EX_SOFTWARE),
+                           exit_code=EX_SOFTWARE,
+                           error=f"{type(e).__name__}: {e}",
+                           summary=self.summary())
+        code = EX_OK if res.ok else EX_VIOLATION
+        return Outcome(state=job_state(code), exit_code=code,
+                       result=res, error=res.error,
+                       summary=self.summary())
+
+
+@dataclass
+class Outcome:
+    """The reified ending of a supervised run (library mode).
+
+    ``state`` is a service job state (``done`` / ``violated`` /
+    ``failed`` / ``preempted-requeued``) and ``exit_code`` the matching
+    entry of the unified contract (tpuvsr/exitcodes.py) — the pair is
+    always consistent by construction."""
+
+    state: str
+    exit_code: int
+    result: object = None    # CheckResult when the run finished
+    error: str = None
+    rescue: dict = None      # {path, depth, distinct, signal} on preemption
+    summary: dict = field(default_factory=dict)
+
+    @property
+    def resumable(self):
+        return self.exit_code == EX_RESUMABLE
+
+
+def run_supervised(spec, *, run_kwargs=None, **supervisor_kwargs):
+    """One-call library entry: build a :class:`Supervisor` over `spec`
+    and run it to an :class:`Outcome` — the worker-process twin of the
+    CLI's ``-supervise`` path, returning instead of ``sys.exit``-ing so
+    one process can host many jobs (tpuvsr/service/worker.py)."""
+    sup = Supervisor(spec, **supervisor_kwargs)
+    out = sup.run_to_outcome(**(run_kwargs or {}))
+    out.supervisor = sup
+    return out

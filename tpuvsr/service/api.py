@@ -1,0 +1,263 @@
+"""Service CLI verbs: ``serve`` / ``submit`` / ``status`` / ``cancel``.
+
+The query surface of the dispatch service is deliberately thin: the
+queue spool IS the database and each job's journal + metrics doc ARE
+its API records — these verbs only fold and print them.
+
+    python -m tpuvsr submit SPEC.tla [-config F] [--engine E]
+                     [--priority N] [--devices N] [--spool DIR] ...
+    python -m tpuvsr serve  [--spool DIR] [--drain] [--devices N] ...
+    python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
+    python -m tpuvsr cancel JOB [--spool DIR]
+
+``submit`` / ``status`` / ``cancel`` import neither jax nor the
+engines — they are milliseconds against a live spool.  ``serve``
+hosts a :class:`tpuvsr.service.worker.Worker` (one process, many
+jobs); ``--drain`` exits when nothing is claimable (the smoke/demo
+mode), without it the worker polls for new submissions until
+``--max-seconds``.
+
+The spool location resolves as ``--spool`` > ``TPUVSR_SPOOL`` >
+``./.tpuvsr-spool``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..exitcodes import EX_USAGE
+from .queue import JobQueue, QueueError
+
+VERBS = ("serve", "submit", "status", "cancel")
+
+
+def default_spool():
+    return os.environ.get("TPUVSR_SPOOL", ".tpuvsr-spool")
+
+
+def _flag_pairs(items):
+    """--flag KEY=VALUE (repeatable) -> dict, values parsed as JSON
+    scalars when possible."""
+    out = {}
+    for item in items or []:
+        if "=" not in item:
+            raise ValueError(f"--flag wants KEY=VALUE, got {item!r}")
+        k, v = item.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="tpuvsr", description="verification dispatch service")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    sp = sub.add_parser("submit", help="enqueue a verification job")
+    sp.add_argument("spec", nargs="?", default=None,
+                    help="path to the .tla module (omit with --stub)")
+    sp.add_argument("-config", "--config", default=None)
+    sp.add_argument("--engine", default="auto",
+                    choices=["auto", "device", "paged", "sharded"])
+    sp.add_argument("--priority", type=int, default=0)
+    sp.add_argument("--devices", type=int, default=1)
+    sp.add_argument("--devices-min", type=int, default=None,
+                    help="elastic floor (sharded): the scheduler may "
+                         "shrink the mesh to this")
+    sp.add_argument("--devices-max", type=int, default=None,
+                    help="elastic ceiling (sharded): grow bound")
+    sp.add_argument("--maxstates", type=int, default=None)
+    sp.add_argument("--maxseconds", type=float, default=None)
+    sp.add_argument("--pipeline", type=int, default=None)
+    sp.add_argument("--inject", default=None,
+                    help="deterministic fault plan for this job "
+                         "(tpuvsr/resilience/faults.py grammar)")
+    sp.add_argument("--stub", action="store_true",
+                    help="run the inline counter spec on the stub "
+                         "kernel (tier-1 smoke path, no reference "
+                         "mount)")
+    sp.add_argument("--flag", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="extra job flag (repeatable; JSON values)")
+    sp.add_argument("--spool", default=None)
+    sp.add_argument("--json", action="store_true")
+
+    sv = sub.add_parser("serve", help="run the dispatch worker")
+    sv.add_argument("--spool", default=None)
+    sv.add_argument("--drain", action="store_true",
+                    help="exit when nothing is claimable")
+    sv.add_argument("--devices", type=int, default=None,
+                    help="device pool size (default: every visible "
+                         "device)")
+    sv.add_argument("--max-jobs", type=int, default=None)
+    sv.add_argument("--max-seconds", type=float, default=None)
+    sv.add_argument("--tpu-devices", type=int, default=None,
+                    help="reachable TPU devices for the cpu-vs-tpu "
+                         "placement advisory (default: "
+                         "TPUVSR_TPU_DEVICES env, else the TPU_UP "
+                         "flag file scripts/tpu_watch.py maintains, "
+                         "else 0)")
+    sv.add_argument("--bench-dir", default=None,
+                    help="directory of BENCH_r*.json docs for the "
+                         "cross-backend throughput advisory "
+                         "(default: the repo root)")
+    sv.add_argument("--quiet", action="store_true")
+
+    st = sub.add_parser("status", help="queue / per-job status")
+    st.add_argument("job_id", nargs="?", default=None)
+    st.add_argument("--spool", default=None)
+    st.add_argument("--json", action="store_true")
+    st.add_argument("--tail", type=int, default=0, metavar="N",
+                    help="with a JOB: print the last N journal events")
+
+    ca = sub.add_parser("cancel", help="cancel a job")
+    ca.add_argument("job_id")
+    ca.add_argument("--spool", default=None)
+    ca.add_argument("--json", action="store_true")
+    return p
+
+
+def _queue(args):
+    return JobQueue(args.spool or default_spool())
+
+
+def cmd_submit(args):
+    if not args.spec and not args.stub:
+        print("submit: a SPEC path (or --stub) is required",
+              file=sys.stderr)
+        return EX_USAGE
+    try:
+        flags = _flag_pairs(args.flag)
+    except ValueError as e:
+        print(f"submit: {e}", file=sys.stderr)
+        return EX_USAGE
+    q = _queue(args)
+    for k in ("maxstates", "maxseconds", "pipeline", "inject"):
+        v = getattr(args, k)
+        if v is not None:
+            flags[k] = v
+    if args.stub:
+        flags["stub"] = True
+    job = q.submit(args.spec or "<stub:ObsCounter>",
+                   cfg=args.config, engine=args.engine, flags=flags,
+                   priority=args.priority, devices=args.devices,
+                   devices_min=args.devices_min,
+                   devices_max=args.devices_max)
+    if args.json:
+        print(json.dumps(job.to_dict(), default=str))
+    else:
+        print(f"submitted {job.job_id} ({job.spec}, engine "
+              f"{job.engine}, priority {job.priority})")
+    return 0
+
+
+def cmd_status(args):
+    q = _queue(args)
+    if args.job_id:
+        try:
+            job = q.get(args.job_id)
+        except QueueError as e:
+            print(f"status: {e}", file=sys.stderr)
+            return EX_USAGE
+        doc = job.to_dict()
+        jp = q.journal_path(job.job_id)
+        mp = q.metrics_path(job.job_id)
+        doc["journal"] = jp if os.path.exists(jp) else None
+        doc["metrics"] = mp if os.path.exists(mp) else None
+        tail = []
+        if args.tail and os.path.exists(jp):
+            with open(jp) as f:
+                for line in f.readlines()[-args.tail:]:
+                    try:
+                        tail.append(json.loads(line))
+                    except ValueError:
+                        pass
+            doc["journal_tail"] = tail
+        if args.json:
+            print(json.dumps(doc, default=str))
+        else:
+            for k in ("job_id", "state", "spec", "engine", "priority",
+                      "devices", "attempts", "reason"):
+                print(f"{k}: {doc.get(k)}")
+            if doc.get("rescue"):
+                print(f"rescue: {doc['rescue']}")
+            if doc.get("result"):
+                r = {k: v for k, v in doc["result"].items()
+                     if k != "trace"}
+                print(f"result: {json.dumps(r, default=str)}")
+            for ev in tail:
+                print(f"  {ev.get('event')}: "
+                      + ", ".join(f"{k}={v}" for k, v in ev.items()
+                                  if k not in ("event", "ts",
+                                               "run_id")))
+        return 0
+    jobs = [j.to_dict() for j in q.jobs()]
+    if args.json:
+        print(json.dumps({"stats": q.stats(), "jobs": jobs},
+                         default=str))
+    else:
+        st = q.stats()
+        print("queue: " + ", ".join(f"{k}={v}" for k, v in st.items()
+                                    if v and k != "total")
+              + f" (total {st['total']})")
+        for j in jobs:
+            print(f"  {j['job_id']:>18} {j['state']:>20} "
+                  f"prio={j['priority']} dev={j['devices']} "
+                  f"attempts={j['attempts']} {j['spec']}")
+    return 0
+
+
+def cmd_cancel(args):
+    q = _queue(args)
+    try:
+        job = q.cancel(args.job_id)
+    except QueueError as e:
+        print(f"cancel: {e}", file=sys.stderr)
+        return EX_USAGE
+    note = ("cancel requested (running job rescues at the next level "
+            "boundary)" if job.state == "running" else "cancelled")
+    if args.json:
+        print(json.dumps({"job_id": job.job_id, "state": job.state,
+                          "note": note}))
+    else:
+        print(f"{job.job_id}: {note}")
+    return 0
+
+
+def cmd_serve(args):
+    from .worker import Worker
+    q = _queue(args)
+    log = (None if args.quiet
+           else lambda m: print(f"[tpuvsr] {m}", file=sys.stderr))
+    t0 = time.time()
+    tpu = args.tpu_devices
+    if tpu is None:
+        from .scheduler import detect_tpu_devices
+        tpu = detect_tpu_devices()
+    w = Worker(q, devices=args.devices, log=log,
+               tpu_devices=tpu, bench_dir=args.bench_dir)
+    runs = w.drain(max_jobs=args.max_jobs,
+                   max_seconds=args.max_seconds,
+                   idle_exit=args.drain)
+    stats = q.stats()
+    print(json.dumps({"runs": runs, "stats": stats,
+                      "processed": w.processed,
+                      "elapsed_s": round(time.time() - t0, 3)}))
+    return 0
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    return {"submit": cmd_submit, "status": cmd_status,
+            "cancel": cmd_cancel, "serve": cmd_serve}[args.verb](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,496 @@
+"""Durable on-disk job queue for the verification dispatch service.
+
+One spool directory holds the whole queue state, in two pieces chosen
+so that EVERY mutation is crash-safe without a database:
+
+* ``jobs.jsonl`` — an append-only, fsync-per-line JSONL spool of job
+  records and state transitions.  The queue's in-memory view is a pure
+  fold over this log, so a killed worker (or a killed submitter)
+  leaves a valid prefix and the next ``JobQueue(spool)`` reconstructs
+  exactly the surviving state — the same crash contract as the run
+  journal (``tpuvsr/obs/journal.py``).
+* ``claims/<job_id>.claim`` — atomic claim files.  A worker takes a
+  job by creating its claim file with ``O_CREAT|O_EXCL`` (the POSIX
+  mutual-exclusion primitive: exactly one creator wins), records its
+  pid inside, and deletes it when the job leaves ``running``.  A claim
+  whose pid is dead is a tombstone of a killed worker;
+  ``recover_stale`` turns those back into claimable jobs — with the
+  job's latest snapshot attached as a rescue, so the next attempt
+  RESUMES instead of restarting (``checkpoint.snapshot_info``).
+
+Job lifecycle (ISSUE 6; the legal-transition table below is enforced,
+an illegal transition is a bug, not a log line):
+
+    queued ──admit──> admitted ──claim──> running ──> done
+       │                 │                   │    ├─> violated
+       │(lint reject)    │                   │    ├─> failed
+       └───> failed      └──> cancelled      │    └─> cancelled
+                                             │
+                              preempted-requeued <──┘ (exit 75 /
+                                    │    rescue checkpoint attached)
+                                    └──claim──> running   (again)
+
+Admission (``queued -> admitted``) is where the speclint gate runs —
+before any device time is spent (the worker performs it, because only
+the worker can load specs; the queue just records the verdict).  The
+terminal states are exactly the images of the unified exit-code table
+(``tpuvsr/exitcodes.py``).
+
+This module deliberately imports neither jax nor the engines, so the
+``submit`` / ``status`` / ``cancel`` CLI verbs stay milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+
+#: every state a job can be in
+STATES = ("queued", "admitted", "running", "done", "violated",
+          "failed", "preempted-requeued", "cancelled")
+#: states a job never leaves
+TERMINAL = frozenset(("done", "violated", "failed", "cancelled"))
+#: states a worker may claim from
+CLAIMABLE = frozenset(("admitted", "preempted-requeued"))
+
+#: the legal-transition table; queue.transition enforces it
+LEGAL = {
+    "queued": {"admitted", "failed", "cancelled"},
+    "admitted": {"running", "cancelled"},
+    "running": {"done", "violated", "failed", "preempted-requeued",
+                "cancelled"},
+    "preempted-requeued": {"running", "cancelled"},
+}
+
+
+@dataclass
+class Job:
+    """One verification job: a (spec, cfg, engine, flags) tuple plus
+    its lifecycle bookkeeping.  ``flags`` carries everything the worker
+    threads through to the engines (maxstates, pipeline, inject,
+    supervisor knobs, the tier-1 ``stub`` family); ``devices`` is the
+    CURRENT device allocation (the scheduler rewrites it on an elastic
+    requeue), ``devices_min``/``devices_max`` bound what elastic
+    placement may shrink/grow it to."""
+
+    job_id: str
+    spec: str
+    cfg: str = None
+    engine: str = "auto"
+    kind: str = "check"          # "check" (engine run) | "shell" (argv)
+    flags: dict = field(default_factory=dict)
+    priority: int = 0
+    devices: int = 1
+    devices_min: int = None
+    devices_max: int = None
+    state: str = "queued"
+    seq: int = 0
+    attempts: int = 0
+    rescue: dict = None          # latest rescue-checkpoint handoff
+    result: dict = None          # terminal result summary
+    reason: str = None           # why failed/requeued/cancelled
+    submitted_ts: float = 0.0
+    updated_ts: float = 0.0
+
+    @property
+    def elastic(self):
+        """True when the scheduler may reshape this job's mesh."""
+        return (self.engine == "sharded"
+                and (self.devices_min is not None
+                     or self.devices_max is not None))
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in (
+            "job_id", "spec", "cfg", "engine", "kind", "flags",
+            "priority", "devices", "devices_min", "devices_max",
+            "state", "seq", "attempts", "rescue", "result", "reason",
+            "submitted_ts", "updated_ts")}
+
+
+class QueueError(RuntimeError):
+    """An illegal queue operation (unknown job, illegal transition)."""
+
+
+def _fsync_append(path, rec):
+    """Append one JSON line durably (the jobs.jsonl write primitive).
+
+    Repairs a torn tail first: a writer killed mid-append leaves a
+    partial line with no trailing newline, and appending straight onto
+    it would MERGE two records into one garbage line (losing the valid
+    one).  Terminating the torn fragment turns it into its own
+    invalid, skipped line instead."""
+    data = (json.dumps(rec, sort_keys=True, default=str)
+            + "\n").encode()
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    try:
+        # torn-tail check via the same fd's file: a crashed writer's
+        # partial record is STATIC (every live writer appends with one
+        # O_APPEND write syscall, which local filesystems apply
+        # atomically — no mid-flight interleaving to race with)
+        try:
+            with open(path, "rb") as rf:
+                rf.seek(0, os.SEEK_END)
+                if rf.tell() > 0:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        os.write(fd, b"\n")
+        except OSError:
+            pass
+        # ONE write syscall: concurrent appenders (submit while serve)
+        # can never interleave inside each other's records
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+class JobQueue:
+    """The durable queue over one spool directory (see module doc).
+
+    All mutators append to the spool BEFORE updating the in-memory
+    view, so a crash between the two loses nothing (the next load
+    replays the log).  Claim files are the only non-log state, and
+    they are self-healing via ``recover_stale``."""
+
+    def __init__(self, spool):
+        self.spool = os.path.abspath(spool)
+        self.log_path = os.path.join(self.spool, "jobs.jsonl")
+        self.claims_dir = os.path.join(self.spool, "claims")
+        self.journals_dir = os.path.join(self.spool, "journals")
+        self.metrics_dir = os.path.join(self.spool, "metrics")
+        self.ckpt_dir = os.path.join(self.spool, "ckpt")
+        for d in (self.spool, self.claims_dir, self.journals_dir,
+                  self.metrics_dir, self.ckpt_dir):
+            os.makedirs(d, exist_ok=True)
+        self._jobs = {}
+        self._seq = 0
+        self._log_pos = 0
+        self.refresh()
+
+    # -- log fold ------------------------------------------------------
+    def refresh(self):
+        """Fold any spool lines appended since the last read — how a
+        long-running worker sees jobs submitted by OTHER processes
+        (the CLI ``submit`` verb against a live ``serve``).  Re-applies
+        this process's own appends too; that is harmless because the
+        fold of a log prefix in order is deterministic.  A torn final
+        line (a writer killed mid-append) is left un-consumed until it
+        is completed."""
+        try:
+            size = os.path.getsize(self.log_path)
+        except OSError:
+            return
+        if size <= self._log_pos:
+            return
+        with open(self.log_path) as f:
+            f.seek(self._log_pos)
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                if not line.endswith("\n"):
+                    break        # torn tail: re-read next refresh
+                self._log_pos = f.tell()
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                self._apply(rec)
+
+    def _apply(self, rec):
+        op = rec.get("op")
+        if op == "submit":
+            d = dict(rec["job"])
+            job = Job(**d)
+            self._jobs[job.job_id] = job
+            self._seq = max(self._seq, job.seq)
+        elif op == "state":
+            job = self._jobs.get(rec["job_id"])
+            if job is None:
+                return
+            job.state = rec["state"]
+            job.updated_ts = rec.get("ts", job.updated_ts)
+            for k in ("attempts", "devices", "rescue", "result",
+                      "reason"):
+                if k in rec:
+                    setattr(job, k, rec[k])
+
+    # -- paths ---------------------------------------------------------
+    def journal_path(self, job_id):
+        return os.path.join(self.journals_dir, f"{job_id}.jsonl")
+
+    def metrics_path(self, job_id):
+        return os.path.join(self.metrics_dir, f"{job_id}.json")
+
+    def checkpoint_path(self, job_id):
+        return os.path.join(self.ckpt_dir, job_id)
+
+    def _claim_path(self, job_id):
+        return os.path.join(self.claims_dir, f"{job_id}.claim")
+
+    def _cancel_marker(self, job_id):
+        return os.path.join(self.claims_dir, f"{job_id}.cancel")
+
+    # -- reads ---------------------------------------------------------
+    def jobs(self):
+        return sorted(self._jobs.values(), key=lambda j: j.seq)
+
+    def get(self, job_id):
+        job = self._jobs.get(job_id)
+        if job is None:
+            raise QueueError(f"unknown job {job_id!r}")
+        return job
+
+    def stats(self):
+        """Queue-level gauges: job count per state (the service's
+        ``status`` verb surfaces these)."""
+        out = {s: 0 for s in STATES}
+        for j in self._jobs.values():
+            out[j.state] += 1
+        out["total"] = len(self._jobs)
+        return out
+
+    def cancel_requested(self, job_id):
+        return os.path.exists(self._cancel_marker(job_id))
+
+    # -- mutators ------------------------------------------------------
+    def submit(self, spec, *, cfg=None, engine="auto", kind="check",
+               flags=None, priority=0, devices=1, devices_min=None,
+               devices_max=None, job_id=None):
+        self.refresh()
+        if job_id is None:
+            job_id = f"j{self._seq + 1:04d}-{uuid.uuid4().hex[:6]}"
+        if job_id in self._jobs:
+            raise QueueError(f"job id {job_id!r} already exists")
+        self._seq += 1
+        flags = dict(flags or {})
+        # the ORIGINAL device request survives elastic reshaping (the
+        # scheduler rewrites job.devices on shrink/grow requeues; grow
+        # decisions compare against what was asked for)
+        flags.setdefault("devices_requested", int(devices))
+        job = Job(job_id=job_id, spec=str(spec), cfg=cfg, engine=engine,
+                  kind=kind, flags=flags,
+                  priority=int(priority), devices=int(devices),
+                  devices_min=devices_min, devices_max=devices_max,
+                  seq=self._seq, submitted_ts=round(time.time(), 3),
+                  updated_ts=round(time.time(), 3))
+        _fsync_append(self.log_path, {"op": "submit",
+                                      "job": job.to_dict(),
+                                      "ts": job.submitted_ts})
+        self._jobs[job.job_id] = job
+        # a job's journal opens with its submission — the first line
+        # of the story every later attempt appends to (obs.journal is
+        # jax-free, so submit stays milliseconds)
+        from ..obs import Journal
+        j = Journal(self.journal_path(job.job_id), run_id="svc-submit")
+        try:
+            j.write("job_submitted", job_id=job.job_id, spec=job.spec,
+                    engine=job.engine, priority=job.priority,
+                    devices=job.devices)
+        finally:
+            j.close()
+        return job
+
+    def transition(self, job_id, state, **fields):
+        """Move a job to `state`, recording extra fields (attempts /
+        devices / rescue / result / reason).  Raises QueueError on an
+        illegal move — the state machine is the API contract."""
+        self.refresh()
+        job = self.get(job_id)
+        if state not in STATES:
+            raise QueueError(f"unknown state {state!r}")
+        if state not in LEGAL.get(job.state, frozenset()):
+            raise QueueError(
+                f"illegal transition {job.state!r} -> {state!r} "
+                f"for job {job_id}")
+        rec = {"op": "state", "job_id": job_id, "state": state,
+               "ts": round(time.time(), 3)}
+        rec.update(fields)
+        _fsync_append(self.log_path, rec)
+        self._apply(rec)
+        return job
+
+    # -- claims --------------------------------------------------------
+    def claim(self, job_id, owner="worker"):
+        """Atomically claim a CLAIMABLE job: O_CREAT|O_EXCL on the
+        claim file decides races; the winner transitions the job to
+        running (attempt count bumped).  Returns the Job, or None on
+        ANY lost race — another holder's claim file, or the job left
+        the claimable states between our look and our claim (a
+        concurrent worker or a ``cancel``).  A lost race is normal
+        multi-worker traffic, never an error."""
+        self.refresh()
+        job = self.get(job_id)
+        if job.state not in CLAIMABLE:
+            return None
+        path = self._claim_path(job_id)
+        # write-then-LINK: the claim file appears fully written or not
+        # at all, so a concurrent recover_stale can never read a
+        # half-written (pid-less) claim and mistake it for an orphan
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "owner": owner,
+                       "ts": round(time.time(), 3)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)      # EEXIST decides the race, like O_EXCL
+        except FileExistsError:
+            return None
+        finally:
+            os.unlink(tmp)
+        # the claim file is ours; re-read the log before announcing —
+        # a transition that landed while we were writing (e.g. a
+        # cancel, a concurrent worker) wins, and we back out
+        self.refresh()
+        job = self.get(job_id)
+        try:
+            if job.state not in CLAIMABLE:
+                raise QueueError("lost the claim race")
+            self.transition(job_id, "running",
+                            attempts=job.attempts + 1)
+        except QueueError:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return None
+        return job
+
+    def claim_next(self, owner="worker"):
+        """Claim the best claimable job: highest priority first, then
+        submission order (the greedy head of the bin-pack)."""
+        self.refresh()
+        order = sorted(
+            (j for j in self._jobs.values() if j.state in CLAIMABLE),
+            key=lambda j: (-j.priority, j.seq))
+        for job in order:
+            got = self.claim(job.job_id, owner=owner)
+            if got is not None:
+                return got
+        return None
+
+    def release(self, job_id):
+        for p in (self._claim_path(job_id), self._cancel_marker(job_id)):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+
+    # -- endings -------------------------------------------------------
+    def finish(self, job_id, state, *, result=None, reason=None):
+        if state not in TERMINAL:
+            raise QueueError(f"finish wants a terminal state, "
+                             f"not {state!r}")
+        job = self.transition(job_id, state, result=result,
+                              reason=reason)
+        self.release(job_id)
+        return job
+
+    def requeue(self, job_id, *, reason, rescue=None, devices=None,
+                uncount=False):
+        """running -> preempted-requeued: the job goes back on the
+        queue with its rescue-checkpoint handoff attached (the next
+        attempt resumes, not restarts).  ``devices`` lets the scheduler
+        reshape an elastic job's next mesh; ``uncount`` refunds the
+        attempt (a failure that never really ran, e.g. a tunnel
+        flap)."""
+        job = self.get(job_id)
+        fields = {"reason": reason}
+        if rescue is not None:
+            fields["rescue"] = rescue
+        if devices is not None:
+            fields["devices"] = int(devices)
+        if uncount:
+            fields["attempts"] = max(0, job.attempts - 1)
+        job = self.transition(job_id, "preempted-requeued", **fields)
+        self.release(job_id)
+        return job
+
+    def cancel(self, job_id):
+        """Cancel a job.  Non-running jobs cancel immediately; a
+        RUNNING job gets a cancel marker the worker polls at level
+        boundaries (it preempts the run, then finishes the job as
+        cancelled) — so cancel is honored without killing the worker
+        mid-level.  Returns the (possibly still-running) Job."""
+        self.refresh()
+        job = self.get(job_id)
+        if job.state in TERMINAL:
+            raise QueueError(f"job {job_id} is already terminal "
+                             f"({job.state})")
+        if job.state == "running" or \
+                os.path.exists(self._claim_path(job_id)):
+            # a claim holder (running, or mid-claim in another
+            # process) owns this job's transitions — leave a marker
+            # it polls instead of yanking the state out from under it
+            marker = self._cancel_marker(job_id)
+            with open(marker, "w") as f:
+                f.write(json.dumps({"ts": round(time.time(), 3)}))
+            return job
+        return self.finish(job_id, "cancelled", reason="cancelled")
+
+    # -- crash recovery ------------------------------------------------
+    def recover_stale(self, log=None):
+        """Requeue running jobs whose claiming worker died (claim file
+        missing, or its pid is gone).  The job's latest snapshot — a
+        periodic checkpoint or the rescue the dying worker managed to
+        write — is attached as the rescue handoff, so the next attempt
+        resumes bit-identically instead of restarting (the PR 4/5
+        equivalence contract)."""
+        from ..engine.checkpoint import snapshot_info
+        self.refresh()
+        recovered = []
+        for job in list(self._jobs.values()):
+            path = self._claim_path(job.job_id)
+            alive = False
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        alive = _pid_alive(json.load(f).get("pid"))
+                except (OSError, ValueError):
+                    alive = False
+            if job.state in CLAIMABLE and os.path.exists(path) \
+                    and not alive:
+                # a worker died in the window between creating the
+                # claim file and appending the `running` transition:
+                # the orphan claim would block every future claim()
+                # forever — clear it (the job itself never started)
+                os.unlink(path)
+                if log:
+                    log(f"queue: cleared orphan claim of "
+                        f"{job.job_id} (worker died before the "
+                        f"running transition)")
+                continue
+            if job.state != "running":
+                continue
+            if alive:
+                continue
+            rescue = snapshot_info(self.checkpoint_path(job.job_id))
+            try:
+                self.requeue(job.job_id, reason="worker-died",
+                             rescue=rescue)
+            except QueueError:
+                # another recovering worker got there first — a lost
+                # race, same as a lost claim
+                continue
+            recovered.append(job.job_id)
+            if log:
+                log(f"queue: job {job.job_id} had a dead claim; "
+                    f"requeued"
+                    + (f" with rescue at depth {rescue['depth']}"
+                       if rescue else " (no snapshot — restart)"))
+        return recovered

@@ -1,0 +1,330 @@
+"""Mesh scheduler + elastic placement for the dispatch service.
+
+Three concerns (ISSUE 6 tentpole, ROADMAP item 3):
+
+* **Device ledger** (:class:`DevicePool`) — how many accelerator
+  devices exist and which job holds how many.  The worker allocates at
+  claim time and releases at job end; sharded jobs' allocations ARE
+  their mesh sizes.
+* **Packing + elasticity** (:class:`Scheduler`) — greedy bin-pack by
+  requested device count (priority first, then submission order —
+  exactly the order ``JobQueue.claim_next`` pops), plus the two LIVE
+  reshape rules evaluated at every level boundary of a running job
+  (the worker's observer tick):
+
+  - *shrink / yield*: a higher-priority job arrived.  The running job
+    is preempted through the ordinary rescue-checkpoint path
+    (``request_preemption`` — the engines poll the same flag SIGTERM
+    sets), and, when the arrival does not fit beside it, an elastic
+    sharded job is requeued with a SMALLER mesh so both eventually
+    pack.  The resume re-hash-partitions the snapshot onto the new
+    mesh (PR 5 reshard-on-load) — nothing is lost but the in-flight
+    level.
+  - *grow*: a previously-shrunken elastic job is running below its
+    requested device count and devices have freed up.  Preempt-to-grow
+    requeues it with the bigger mesh; the elastic resume grows the
+    same way it shrank.
+
+* **Cross-backend placement advisory** (:func:`advise_backend`) — the
+  cpu-vs-tpu call, using the same logic ``scripts/compare_bench.py``
+  applies across backends: measured ``distinct_per_s`` from the
+  newest usable bench documents decides, and tiny jobs stay on CPU
+  (device compile time dominates them).  Advisory because every tier-1
+  environment is CPU-only; the decision is recorded on the job's
+  ``job_started`` event either way.
+
+``watch_backend`` absorbs ``scripts/tpu_watch.py``: the probe loop
+that audits tunnel availability is just the scheduler's
+backend-availability input running detached.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from .queue import CLAIMABLE
+
+
+def pow2_floor(n):
+    """Largest power of two <= n (n >= 1)."""
+    n = int(n)
+    if n < 1:
+        return 1
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _clamp(n, lo, hi):
+    return max(lo, min(hi, n))
+
+
+class DevicePool:
+    """Slot ledger over `total` devices.  Allocation is bookkeeping —
+    jax device selection happens in the worker (`jax.devices()[:n]`) —
+    but the ledger is what packing decisions read."""
+
+    def __init__(self, total):
+        self.total = int(total)
+        self._alloc = {}
+
+    @property
+    def free(self):
+        return self.total - sum(self._alloc.values())
+
+    def held(self, job_id):
+        return self._alloc.get(job_id, 0)
+
+    def alloc(self, job_id, n):
+        self._alloc[job_id] = int(n)
+
+    def release(self, job_id):
+        self._alloc.pop(job_id, None)
+
+    def snapshot(self):
+        return {"total": self.total, "free": self.free,
+                "alloc": dict(self._alloc)}
+
+
+@dataclass
+class Decision:
+    """One live-reshape decision for the currently running job."""
+    action: str          # "shrink" | "grow" | "yield"
+    devices: int         # the job's NEXT mesh size (requeue devices)
+    reason: str
+
+
+class Scheduler:
+    def __init__(self, pool, elastic_grow=True):
+        self.pool = pool
+        self.elastic_grow = elastic_grow
+
+    # -- claim-time placement -----------------------------------------
+    def alloc_for(self, job):
+        """Device count for a job being claimed: its current
+        ``devices`` (the scheduler rewrites that field on elastic
+        requeues), clamped to the pool and — for sharded jobs — to a
+        power of two (the mesh-shrink contract)."""
+        n = _clamp(int(job.devices or 1), 1, self.pool.total)
+        if job.engine == "sharded":
+            n = pow2_floor(n)
+        return n
+
+    def _bounds(self, job):
+        lo = int(job.devices_min or 1)
+        # the grow ceiling must NOT read job.devices — the scheduler
+        # itself rewrites that on a shrink requeue; the preserved
+        # original request is the fallback ceiling
+        hi = int(job.devices_max
+                 or job.flags.get("devices_requested")
+                 or job.devices or 1)
+        return max(1, lo), _clamp(hi, 1, self.pool.total)
+
+    # -- level-boundary reshape ---------------------------------------
+    def rebalance(self, running, jobs):
+        """The live grow/shrink call, evaluated at a running job's
+        level boundaries.  Returns a :class:`Decision` (the worker
+        preempts and requeues with ``decision.devices``) or None.
+
+        Shrink/yield: the highest-priority CLAIMABLE job outranking
+        `running` preempts it; if that job cannot fit beside the
+        current allocation, an elastic victim also gives up devices —
+        down to the largest power of two that leaves room, floored at
+        ``devices_min``.  Grow: an elastic job running BELOW its
+        requested mesh (an earlier shrink) reclaims freed devices up
+        to ``devices_max``."""
+        cur = self.pool.held(running.job_id) or running.devices or 1
+        waiting = sorted(
+            (j for j in jobs
+             if j.state in CLAIMABLE and j.job_id != running.job_id),
+            key=lambda j: (-j.priority, j.seq))
+        for j in waiting:
+            if j.priority <= running.priority:
+                break
+            new = cur
+            if j.devices > self.pool.total - cur and running.elastic:
+                lo, hi = self._bounds(running)
+                new = _clamp(
+                    pow2_floor(max(1, self.pool.total - j.devices)),
+                    lo, hi)
+            if new < cur:
+                return Decision("shrink", new,
+                                f"make room for {j.job_id} "
+                                f"(priority {j.priority})")
+            return Decision("yield", cur,
+                            f"yield to {j.job_id} "
+                            f"(priority {j.priority})")
+        if self.elastic_grow and running.elastic:
+            lo, hi = self._bounds(running)
+            requested = int(running.flags.get("devices_requested")
+                            or running.devices or 1)
+            # reserve capacity for everything still waiting at >= our
+            # priority before taking the rest of the pool
+            reserved = sum(j.devices for j in waiting
+                           if j.priority >= running.priority)
+            target = _clamp(pow2_floor(max(1, self.pool.total
+                                           - reserved)), lo, hi)
+            if cur < requested and target > cur:
+                return Decision("grow", target,
+                                f"devices freed up ({cur} -> {target})")
+        return None
+
+    # -- queue-level packing view -------------------------------------
+    def plan(self, jobs):
+        """Greedy bin-pack preview for ``status``: which claimable
+        jobs fit the free pool right now, in pop order."""
+        free = self.pool.free
+        placed, waiting = [], []
+        for j in sorted((j for j in jobs if j.state in CLAIMABLE),
+                        key=lambda j: (-j.priority, j.seq)):
+            need = self.alloc_for(j)
+            if need <= free:
+                placed.append((j.job_id, need))
+                free -= need
+            else:
+                waiting.append((j.job_id, need))
+        return {"placed": placed, "waiting": waiting, "free": free}
+
+
+# ---------------------------------------------------------------------
+# cross-backend placement advisory (compare_bench logic)
+# ---------------------------------------------------------------------
+
+#: below this many states a run is compile-dominated on an accelerator
+SMALL_JOB_STATES = 50_000
+
+
+def _doc_throughput(doc):
+    """distinct_per_s of one bench/metrics document — the same lookup
+    order ``scripts/compare_bench.py`` uses (gauges.distinct_per_s,
+    then distinct/elapsed, then the legacy bench ``value``).  The
+    repo's BENCH_r*.json files wrap the bench RESULT line under a
+    ``parsed`` key ({n, cmd, rc, tail, parsed}); unwrap it first."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    m = doc if doc.get("schema") == "tpuvsr-metrics/1" else None
+    if m is None and isinstance(doc.get("metrics"), dict) \
+            and doc["metrics"].get("schema") == "tpuvsr-metrics/1":
+        m = doc["metrics"]
+    if m is not None:
+        g = m.get("gauges", {})
+        if "distinct_per_s" in g:
+            return float(g["distinct_per_s"])
+        if m.get("elapsed_s") and m.get("distinct") is not None:
+            return float(m["distinct"]) / float(m["elapsed_s"])
+    if "value" in doc:
+        try:
+            return float(doc["value"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def bench_throughputs(bench_dir):
+    """Newest usable per-backend distinct/s from the repo's BENCH_r*
+    documents: ``{"cpu": x, "tpu": y}`` (either may be absent)."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        tp = _doc_throughput(doc)
+        if tp is None:
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        backend = str(doc.get("backend", "")).lower()
+        key = "tpu" if "tpu" in backend and "fallback" not in backend \
+            else "cpu"
+        out[key] = tp              # sorted order: newest round wins
+    return out
+
+
+def advise_backend(job, *, tpu_devices=0, bench_dir=None):
+    """cpu-vs-tpu placement for one job: ``(backend, reason)``.
+
+    TPU only when it is actually reachable AND the job is big enough
+    to amortize device compile AND the measured cross-backend
+    throughput (newest bench documents, compare_bench semantics)
+    favors it; cross-backend numbers are ADVISORY, like
+    ``compare_bench`` treats them, so ties and missing data fall back
+    to CPU."""
+    if tpu_devices <= 0:
+        return "cpu", "no tpu devices reachable"
+    est = job.flags.get("maxstates") or job.flags.get("est_states")
+    if est is not None and int(est) < SMALL_JOB_STATES:
+        return "cpu", (f"small job ({est} states < "
+                       f"{SMALL_JOB_STATES}): compile-dominated")
+    if bench_dir is None:
+        bench_dir = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    tps = bench_throughputs(bench_dir)
+    if "tpu" in tps and "cpu" in tps and tps["tpu"] > tps["cpu"]:
+        return "tpu", (f"bench advisory: {tps['tpu']:.0f} vs "
+                       f"{tps['cpu']:.0f} distinct/s")
+    if "tpu" in tps and "cpu" not in tps:
+        return "tpu", "bench advisory: only tpu rounds recorded"
+    return "cpu", "bench advisory: no measured tpu advantage"
+
+
+def detect_tpu_devices(flag_path=None):
+    """TPU device count for the placement advisory, cheapest signal
+    first: ``TPUVSR_TPU_DEVICES`` env, else the ``TPU_UP`` flag file
+    the ``watch_backend`` loop maintains (its JSON line carries the
+    probed device count).  0 when neither says the tunnel is up — no
+    blocking probe here; `serve` must stay responsive."""
+    env = os.environ.get("TPUVSR_TPU_DEVICES")
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    if flag_path is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        flag_path = os.path.join(repo, "scripts", "TPU_UP")
+    try:
+        with open(flag_path) as f:
+            return max(0, int(json.load(f).get("devices", 0)))
+    except (OSError, ValueError, TypeError):
+        return 0
+
+
+# ---------------------------------------------------------------------
+# backend availability watch (absorbs scripts/tpu_watch.py)
+# ---------------------------------------------------------------------
+def watch_backend(log_path, flag_path, *, interval=300.0, timeout=75.0,
+                  max_hours=13.0, probe=None, sleep=time.sleep,
+                  clock=time.time):
+    """Re-probe the TPU tunnel on a cadence for ``max_hours``,
+    appending one JSON line per attempt to `log_path` and maintaining
+    `flag_path` as an up/down flag file — the scheduler's
+    backend-availability input, auditable after the fact.  `probe`
+    defaults to ``tpuvsr.platform_select.probe_tpu``."""
+    if probe is None:
+        from ..platform_select import probe_tpu as probe
+    t0 = clock()
+    while clock() - t0 < max_hours * 3600:
+        t = clock()
+        n = probe(timeout)
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                   time.gmtime(t)),
+               "probe_s": round(clock() - t, 1), "devices": n}
+        with open(log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if n > 0:
+            with open(flag_path, "w") as f:
+                f.write(json.dumps(rec) + "\n")
+        elif os.path.exists(flag_path):
+            os.remove(flag_path)
+        sleep(max(0.0, interval - (clock() - t)))

@@ -1,0 +1,496 @@
+"""The dispatch worker: claims jobs, runs them supervised, maps
+outcomes back onto the queue.
+
+One worker process hosts MANY jobs (the ``run_supervised`` library
+mode, ISSUE 6 satellite — nothing in a job's ending may own the
+process exit).  Per job the worker:
+
+1. **admits** — loads the spec and runs the speclint gate
+   (``queued -> admitted``, or ``failed`` with the lint findings as
+   the reason: a rejected job never costs device time);
+2. **claims** (atomic claim file), allocates devices from the
+   scheduler's pool (a sharded job's allocation IS its mesh size),
+   journals ``job_started``;
+3. **runs** under ``resilience.Supervisor`` via ``run_supervised`` —
+   OOM degrades (tile halving / mesh shrink / paged fallback) stay
+   per-job, the job's journal and metrics doc collect every attempt,
+   and a rescue handoff on the queue makes the run resume from its
+   snapshot;
+4. at every level boundary (a :class:`JobObserver` tick) it polls for
+   cancellation and asks the scheduler to **rebalance** — a
+   higher-priority arrival or freed devices preempts the run through
+   the ordinary rescue-checkpoint path (``request_preemption``: the
+   same flag SIGTERM sets, so the machinery is identical to a real
+   preemption) and requeues it with the scheduler's new mesh size;
+5. **maps the outcome** to a terminal state through the ONE table in
+   ``tpuvsr/exitcodes.py`` — exit 75 / ``Preempted`` means
+   ``preempted-requeued`` with the rescue checkpoint attached, never
+   a dead job.
+
+Jobs with ``flags.stub`` run the inline counter spec through the REAL
+device/paged/sharded engines on the stub kernel
+(``tpuvsr/testing.py``) — the tier-1 path every service test and
+``scripts/serve_demo.py`` exercises without the reference mount.
+
+``kind="shell"`` jobs (argv + timeout) exist for the absorbed
+``scripts/tpu_queue.py`` workload driver: same spool, same claim
+discipline, same exit-code table — one queue implementation.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+from ..exitcodes import EX_RESUMABLE, job_state
+from ..obs import Journal, RunObserver
+from .scheduler import DevicePool, Scheduler, advise_backend
+
+
+def trace_to_jsonable(trace):
+    """Serialize a violation trace for the job-result record — the
+    stable form the service's bit-identity checks compare (two runs
+    are equivalent iff these lists are equal)."""
+    from ..core.values import fmt
+    out = []
+    for e in trace:
+        out.append({"position": int(e.position),
+                    "action": e.action_name,
+                    "state": {k: fmt(v)
+                              for k, v in sorted(e.state.items())}})
+    return out
+
+
+def result_summary(res):
+    """CheckResult -> the JSON-able summary stored on the job."""
+    out = {"ok": bool(res.ok),
+           "distinct": int(res.distinct_states),
+           "generated": int(res.states_generated),
+           "diameter": int(res.diameter),
+           "levels": ([int(x) for x in res.levels]
+                      if res.levels else None),
+           "violated": res.violated_invariant,
+           "error": res.error,
+           "elapsed_s": round(float(res.elapsed or 0.0), 3)}
+    if res.trace:
+        out["trace"] = trace_to_jsonable(res.trace)
+    return out
+
+
+class JobObserver(RunObserver):
+    """RunObserver whose ``level_done`` also ticks the worker — the
+    hook that makes scheduling LIVE: cancellation and rebalance
+    decisions land at level boundaries, exactly where the engines
+    poll the preemption flag."""
+
+    def __init__(self, *args, tick=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._tick = tick
+
+    def level_done(self, depth, **kw):
+        super().level_done(depth, **kw)
+        if self._tick is not None:
+            self._tick(int(depth))
+
+
+class Worker:
+    """Serial drain loop over one :class:`JobQueue` (see module doc).
+
+    `on_level(worker, job, depth)` is the test/demo hook invoked at
+    every level boundary of a running job BEFORE the scheduler looks —
+    the deterministic stand-in for "a job arrives mid-run"."""
+
+    def __init__(self, queue, *, devices=None, scheduler=None,
+                 log=None, on_level=None, owner=None, poll=0.25,
+                 bench_dir=None, tpu_devices=0, shell_retry_gate=None):
+        self.queue = queue
+        if devices is None:
+            import jax
+            devices = len(jax.devices())
+        self.pool = (scheduler.pool if scheduler
+                     else DevicePool(devices))
+        self.scheduler = scheduler or Scheduler(self.pool)
+        self.on_level = on_level
+        self.owner = owner or f"worker-{os.getpid()}"
+        self.poll = poll
+        self.bench_dir = bench_dir
+        self.tpu_devices = tpu_devices
+        # shell jobs only: gate(job, rc) -> True means the failure
+        # never really ran (e.g. a dead tunnel) — refund the attempt
+        # and requeue instead of burning one (tpu_queue flap logic)
+        self.shell_retry_gate = shell_retry_gate
+        self._log = log
+        self._specs = {}             # job_id -> loaded spec (admission)
+        self._current = None
+        self._preempt_sent = False
+        self._cancelled = False
+        self._requeue_devices = None
+        self._requeue_reason = None
+        self._shutdown = False       # external SIGTERM/SIGINT landed
+        self.processed = []          # [(job_id, state), ...] this drain
+
+    def log(self, msg):
+        if self._log:
+            self._log(f"service: {msg}")
+
+    def _journal(self, job, event, **fields):
+        """Append one job_* event to the JOB'S OWN journal (the same
+        file the engine/supervisor attempts write to)."""
+        j = Journal(self.queue.journal_path(job.job_id),
+                    run_id=f"svc-{self.owner}")
+        try:
+            j.write(event, job_id=job.job_id,
+                    elapsed_s=round(time.time() - job.submitted_ts, 3),
+                    **fields)
+        finally:
+            j.close()
+
+    # -- admission (the speclint gate) ---------------------------------
+    def _load_spec(self, job):
+        if job.flags.get("stub"):
+            from ..testing import bad_counter_spec, counter_spec
+            if job.flags.get("stub_bad"):
+                return bad_counter_spec()
+            return counter_spec(
+                inv_bound=job.flags.get("inv_bound"),
+                inv_x_bound=job.flags.get("inv_x_bound"))
+        from ..engine.spec import load_spec
+        cfg = job.cfg or os.path.splitext(job.spec)[0] + ".cfg"
+        return load_spec(job.spec, cfg)
+
+    def admit_pending(self):
+        """queued -> admitted (or failed): load each new job's spec
+        and run the full speclint report — rejection happens HERE,
+        before any device time is spent.  A QueueError from any
+        transition is a lost race against a concurrent worker (same as
+        a lost claim): skip, never crash."""
+        from .queue import QueueError
+        for job in [j for j in self.queue.jobs()
+                    if j.state == "queued"]:
+            try:
+                self._admit_one(job)
+            except QueueError:
+                continue
+
+    def _admit_one(self, job):
+        from ..analysis import lint_enabled, run_lint
+        if job.kind == "shell":
+            self.queue.transition(job.job_id, "admitted")
+            self._journal(job, "job_admitted")
+            return
+        try:
+            spec = self._load_spec(job)
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self.queue.finish(job.job_id, "failed",
+                              reason=f"spec-load: "
+                                     f"{type(e).__name__}: {e}")
+            self._journal(job, "job_done", state="failed",
+                          reason="spec-load")
+            return
+        if not job.flags.get("stub"):
+            # the worker's engines (device/paged/sharded) all need
+            # a compiled kernel; saying so at admission beats a
+            # KeyError out of the model registry mid-claim
+            from ..models.registry import has_device_model
+            if not has_device_model(spec):
+                self.queue.finish(
+                    job.job_id, "failed",
+                    reason=f"no device kernel for module "
+                           f"{spec.module.name!r} "
+                           f"(models/registry)")
+                self._journal(job, "job_done", state="failed",
+                              reason="no-device-kernel")
+                return
+        if lint_enabled():
+            report = run_lint(spec)
+            if report.exit_code:
+                findings = [f"{f.passname}: {f.message}"
+                            for f in report.errors]
+                self.queue.finish(job.job_id, "failed",
+                                  reason="speclint",
+                                  result={"speclint": findings})
+                self._journal(job, "job_done", state="failed",
+                              reason="speclint")
+                self.log(f"job {job.job_id} rejected by speclint "
+                         f"({len(findings)} error(s))")
+                return
+        self._specs[job.job_id] = spec
+        self.queue.transition(job.job_id, "admitted")
+        self._journal(job, "job_admitted")
+
+    # -- the level-boundary tick ---------------------------------------
+    def _tick(self, job, depth):
+        if self._preempt_sent:
+            return
+        from ..resilience.supervisor import request_preemption
+        # fold spool lines appended by OTHER processes since the last
+        # look — live admission/rebalance must see a `submit` from a
+        # second terminal, not just jobs entered through this object
+        self.queue.refresh()
+        if self.queue.cancel_requested(job.job_id):
+            self._cancelled = True
+            self._preempt_sent = True
+            request_preemption("CANCEL")
+            self.log(f"job {job.job_id}: cancel requested; rescuing "
+                     f"at the level boundary")
+            return
+        if self.on_level is not None:
+            self.on_level(self, job, depth)
+        self.admit_pending()
+        dec = self.scheduler.rebalance(job, self.queue.jobs())
+        if dec is not None:
+            self._requeue_devices = dec.devices
+            self._requeue_reason = f"{dec.action}: {dec.reason}"
+            self._preempt_sent = True
+            request_preemption("SCHED")
+            self.log(f"job {job.job_id}: {self._requeue_reason}; "
+                     f"preempting at the level boundary "
+                     f"(next mesh {dec.devices})")
+
+    # -- one job -------------------------------------------------------
+    def run_one(self, job):
+        self._current = job
+        self._preempt_sent = False
+        self._cancelled = False
+        self._requeue_devices = None
+        self._requeue_reason = None
+        try:
+            if job.kind == "shell":
+                return self._run_shell(job)
+            return self._run_check(job)
+        finally:
+            self.pool.release(job.job_id)
+            self._current = None
+            self._specs.pop(job.job_id, None)
+
+    def _finish(self, job, state, **kw):
+        self.queue.finish(job.job_id, state, **kw)
+        self._journal(job, "job_done", state=state,
+                      reason=kw.get("reason"))
+        self.processed.append((job.job_id, state))
+        self.log(f"job {job.job_id}: {state}"
+                 + (f" ({kw.get('reason')})" if kw.get("reason")
+                    else ""))
+
+    def _run_check(self, job):
+        from ..resilience import faults
+        from ..resilience.supervisor import run_supervised
+        spec = self._specs.get(job.job_id) or self._load_spec(job)
+        kind = job.engine if job.engine in ("device", "paged",
+                                            "sharded") else "device"
+        alloc = self.scheduler.alloc_for(job)
+        self.pool.alloc(job.job_id, alloc)
+        backend, why = advise_backend(job, tpu_devices=self.tpu_devices,
+                                      bench_dir=self.bench_dir)
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=alloc, backend=backend,
+                      placement=why)
+        flags = job.flags
+        injected = None
+        try:
+            # everything from here to the outcome is THIS JOB's
+            # problem: malformed flags (bad supervisor kwargs, a bad
+            # -inject grammar) fail the job, never the worker
+            factory = None
+            if flags.get("stub"):
+                from ..testing import stub_service_factory
+                engine_kw = {}
+                if flags.get("pipeline"):
+                    engine_kw["pipeline"] = int(flags["pipeline"])
+                factory = stub_service_factory(
+                    spec, inv_bound=flags.get("inv_bound"),
+                    inv_x_bound=flags.get("inv_x_bound"), **engine_kw)
+            sup_kw = dict(flags.get("supervisor") or {})
+            sup_kw.setdefault("backoff_base", 0.0)
+
+            def observer_factory(**kw):
+                return JobObserver(
+                    tick=lambda depth: self._tick(job, depth), **kw)
+
+            injected = flags.get("inject")
+            if injected:
+                faults.install(injected)
+            out = run_supervised(
+                spec, engine=kind,
+                checkpoint_path=self.queue.checkpoint_path(job.job_id),
+                journal_path=self.queue.journal_path(job.job_id),
+                metrics_path=self.queue.metrics_path(job.job_id),
+                log=self._log, engine_factory=factory,
+                observer_factory=observer_factory,
+                mesh_devices=(alloc if kind == "sharded" else None),
+                engine_kwargs=(
+                    {"pipeline": int(flags["pipeline"])}
+                    if flags.get("pipeline") and not factory else None),
+                **sup_kw,
+                run_kwargs={
+                    "max_states": flags.get("maxstates"),
+                    "max_depth": flags.get("maxdepth"),
+                    "max_seconds": flags.get("maxseconds"),
+                    "check_deadlock": bool(flags.get("deadlock")),
+                    "resume_from": (job.rescue or {}).get("path"),
+                })
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self._finish(job, "failed",
+                         reason=f"job-setup: {type(e).__name__}: {e}")
+            return
+        finally:
+            if injected:
+                faults.clear()
+
+        if out.state == "preempted-requeued":
+            if self._cancelled:
+                self._finish(job, "cancelled", reason="cancelled",
+                             result={"rescue": out.rescue})
+                return
+            reason = self._requeue_reason or \
+                f"preempted ({(out.rescue or {}).get('signal')})"
+            self.queue.requeue(
+                job.job_id, reason=reason, rescue=out.rescue,
+                devices=self._requeue_devices)
+            self._journal(job, "job_requeued", reason=reason,
+                          rescue=out.rescue,
+                          devices=self._requeue_devices or job.devices)
+            self.processed.append((job.job_id, "preempted-requeued"))
+            self.log(f"job {job.job_id}: requeued ({reason})")
+            # a REAL operator signal (not our scheduler/cancel tick,
+            # not the job's own injected kill drill) means the whole
+            # worker was asked to stop: requeue-and-exit, or the drain
+            # loop would instantly re-claim the job and `serve` could
+            # never be stopped gracefully
+            sig = (out.rescue or {}).get("signal")
+            simulated = "kill" in str(flags.get("inject") or "")
+            if sig in ("SIGTERM", "SIGINT") and not self._preempt_sent \
+                    and not simulated:
+                self._shutdown = True
+                self.log(f"{sig} received: job requeued; stopping the "
+                         f"drain loop (rerun `serve` to resume)")
+            return
+        result = (result_summary(out.result)
+                  if out.result is not None else None)
+        if result is not None:
+            result["supervisor"] = out.summary
+        self._finish(job, out.state, result=result, reason=out.error)
+
+    # -- shell jobs (the absorbed tpu_queue workload driver) -----------
+    def _run_shell(self, job):
+        flags = job.flags
+        argv = flags.get("argv") or []
+        timeout = float(flags.get("timeout") or 3600)
+        env = dict(os.environ)
+        env.update(flags.get("env") or {})
+        cwd = flags.get("cwd")
+        self.pool.alloc(job.job_id, self.scheduler.alloc_for(job))
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=job.devices)
+        t0 = time.time()
+        cancelled = False
+        try:
+            p = subprocess.Popen(argv, cwd=cwd, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True,
+                                 start_new_session=True)
+            # poll in short slices so a `cancel` lands mid-run (shell
+            # jobs have no level boundaries — SIGTERM the process
+            # group and let it exit; a well-behaved tpuvsr child
+            # rescues and exits 75 on its own)
+            rc = None
+            while True:
+                remaining = timeout - (time.time() - t0)
+                try:
+                    out, _ = p.communicate(
+                        timeout=max(0.1, min(2.0, remaining)))
+                    rc = p.returncode
+                    break
+                except subprocess.TimeoutExpired:
+                    if remaining <= 0:
+                        os.killpg(p.pid, signal.SIGKILL)
+                        out, _ = p.communicate()
+                        rc = -9
+                        break
+                    self.queue.refresh()
+                    if not cancelled and \
+                            self.queue.cancel_requested(job.job_id):
+                        cancelled = True
+                        os.killpg(p.pid, signal.SIGTERM)
+                        # one more slice to exit, then hard-kill
+                        timeout = min(timeout,
+                                      (time.time() - t0) + 10.0)
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            rc, out = -1, f"launcher error: {e}"
+        tail = "\n".join((out or "").strip().splitlines()[-6:])
+        result = {"rc": rc, "tail": tail,
+                  "elapsed_s": round(time.time() - t0, 1)}
+        if cancelled:
+            self._finish(job, "cancelled", reason="cancelled",
+                         result=result)
+            return
+        state = job_state(rc) if rc >= 0 else "failed"
+        if rc == EX_RESUMABLE:
+            # resumable, but bounded: a child that exits 75 forever
+            # without progressing must not hot-loop (the attempt
+            # budget the absorbed tpu_queue enforced)
+            if job.attempts < int(flags.get("max_attempts") or 1):
+                self.queue.requeue(job.job_id, reason="exit-75",
+                                   rescue=None)
+                self._journal(job, "job_requeued", reason="exit-75")
+                self.processed.append((job.job_id,
+                                       "preempted-requeued"))
+                return
+            self._finish(job, "failed", result=result,
+                         reason=f"exit-75 after {job.attempts} "
+                                f"attempts (budget exhausted)")
+            return
+        if state == "failed" and self.shell_retry_gate is not None \
+                and self.shell_retry_gate(job, rc):
+            # the failure never really ran (e.g. a tunnel flap):
+            # refund the attempt and requeue
+            self.queue.requeue(job.job_id, reason="retry-uncounted",
+                               uncount=True)
+            self._journal(job, "job_requeued", reason="retry-uncounted")
+            self.processed.append((job.job_id, "preempted-requeued"))
+            return
+        if state == "failed" and job.attempts < int(
+                flags.get("max_attempts") or 1):
+            self.queue.requeue(job.job_id, reason=f"retry rc={rc}")
+            self._journal(job, "job_requeued", reason=f"retry rc={rc}")
+            self.processed.append((job.job_id, "preempted-requeued"))
+            return
+        self._finish(job, state, result=result,
+                     reason=None if state == "done" else f"rc={rc}")
+
+    # -- the drain loop ------------------------------------------------
+    def drain(self, *, max_jobs=None, max_seconds=None,
+              idle_exit=True):
+        """Process jobs until the queue has nothing claimable (or the
+        bounds hit).  Returns the number of job runs executed."""
+        t0 = time.time()
+        runs = 0
+        while True:
+            if max_jobs is not None and runs >= max_jobs:
+                break
+            if max_seconds is not None \
+                    and time.time() - t0 >= max_seconds:
+                break
+            self.queue.recover_stale(log=self._log)
+            self.admit_pending()
+            # evict cached specs of jobs this worker will never run
+            # (cancelled before claim, drained by another worker) —
+            # the cache must not grow with the spool's history
+            for jid in list(self._specs):
+                j = self.queue._jobs.get(jid)
+                if j is None or j.state not in (
+                        "admitted", "preempted-requeued", "running"):
+                    self._specs.pop(jid, None)
+            job = self.queue.claim_next(owner=self.owner)
+            if job is None:
+                if idle_exit:
+                    break
+                time.sleep(self.poll)
+                continue
+            runs += 1
+            self.run_one(job)
+            if self._shutdown:
+                break
+        return runs

@@ -218,6 +218,47 @@ def stub_sharded_engine(n_devices=2, spec=None, inv_x_bound=None,
         fpset_capacity=kw.pop("fpset_capacity", 1 << 8), **kw)
 
 
+def bad_counter_spec():
+    """A counter-spec variant that FAILS the speclint frames pass
+    (IncX leaves ``y`` unframed) — the admission-rejection fixture for
+    the dispatch service: a job over this spec must die at the lint
+    gate, before any device time (ISSUE 6)."""
+    src = COUNTER.replace(
+        "IncX ==\n    /\\ x < Limit\n    /\\ x' = x + 1\n"
+        "    /\\ UNCHANGED y",
+        "IncX ==\n    /\\ x < Limit\n    /\\ x' = x + 1")
+    assert "UNCHANGED y" not in src.split("IncY")[0]
+    return SpecModel(parse_module_text(src),
+                     parse_cfg_text(COUNTER_CFG))
+
+
+def stub_service_factory(spec, inv_bound=None, inv_x_bound=None,
+                         **engine_kw):
+    """The dispatch-service engine factory over the stub kernel: one
+    factory covering all three supervised kinds — device/paged at the
+    requested tile, sharded at the requested (tile, n_devices) mesh —
+    with the tightened-invariant knobs threaded through so violation
+    jobs stay kernel/interpreter-consistent.  This is what the service
+    worker installs for ``stub: true`` jobs (tier-1: real engine
+    loops, no reference mount)."""
+    from .engine.device_bfs import DeviceBFS
+    from .engine.paged_bfs import PagedBFS
+
+    def make(kind, tile, n_devices=None):
+        if kind == "sharded":
+            return stub_sharded_engine(
+                n_devices=n_devices or 2, spec=spec,
+                inv_x_bound=inv_x_bound, tile=tile, **dict(engine_kw))
+        cls = PagedBFS if kind == "paged" else DeviceBFS
+        return cls(spec,
+                   model_factory=stub_model_factory(
+                       inv_bound=inv_bound, inv_x_bound=inv_x_bound),
+                   hash_mode="full", tile_size=max(tile, 2),
+                   fpset_capacity=1 << 8, next_capacity=1 << 6,
+                   **dict(engine_kw))
+    return make
+
+
 def stub_sharded_factory(spec, **engine_kw):
     """A ``Supervisor`` engine factory for the MESH degrade ladder:
     builds the sharded engine at the requested (tile, n_devices) and
