@@ -395,6 +395,20 @@ def main():
                 for k, _f in ATTACHMENTS:
                     loaded.pop(k, None)
             RESULT[key] = loaded
+    # walker-fleet simulation headline (ISSUE 7): walkers / walks/s /
+    # split mode of the fleet-rebuilt sim_scale probe lifted to the
+    # round-doc top level, so scripts/compare_bench.py's walks/s gate
+    # diffs rounds directly (cross-walker-count drops are advisory)
+    sc = RESULT.get("sim_scale")
+    if isinstance(sc, dict) and sc.get("walks_per_s") is not None:
+        RESULT["sim_walkers"] = sc.get("walkers")
+        RESULT["sim_walks_per_s"] = sc.get("walks_per_s")
+        RESULT["sim_split_enabled"] = bool(sc.get("split_enabled"))
+    hr = RESULT.get("defect_hunt")
+    if isinstance(hr, dict) and hr.get("split_enabled") is not None:
+        RESULT["hunt_split_enabled"] = bool(hr.get("split_enabled"))
+        RESULT["hunt_time_to_violation_s"] = hr.get(
+            "time_to_violation_s")
     # headline the defect-scale number when a TPU window ran (the r4
     # verdict's graded target: >= 10x the CPU window's 1,160 distinct/s)
     dw = RESULT.get("defect_bfs_window")

@@ -69,6 +69,66 @@ def fmt_delta(base, cand):
     return f"{100.0 * (cand - base) / base:+.1f}%"
 
 
+def sim_stats(doc):
+    """Simulation throughput of a document (ISSUE 7): ``(walks_per_s,
+    walkers, split_enabled)`` or ``(None, None, None)``.  Reads the
+    round doc's ``sim_scale`` attachment / top-level ``sim_*`` keys,
+    a raw ``sim_scale.json``, or a fleet metrics doc's
+    ``gauges.walks_per_s``."""
+    if not isinstance(doc, dict):
+        return None, None, None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    sc = doc.get("sim_scale") if isinstance(doc.get("sim_scale"),
+                                            dict) else None
+    if sc is None and "walks_per_s" in doc:
+        sc = doc
+    if sc is not None and sc.get("walks_per_s") is not None:
+        return (float(sc["walks_per_s"]), sc.get("walkers"),
+                sc.get("split_enabled"))
+    if doc.get("sim_walks_per_s") is not None:
+        return (float(doc["sim_walks_per_s"]), doc.get("sim_walkers"),
+                doc.get("sim_split_enabled"))
+    m = find_metrics(doc)
+    if m is not None and "walks_per_s" in m.get("gauges", {}):
+        return (float(m["gauges"]["walks_per_s"]),
+                m["gauges"].get("walkers"), None)
+    return None, None, None
+
+
+def gate_sim(base_doc, cand_doc, max_regression):
+    """The walks/s regression gate: 0 ok/advisory/absent, 1 on a
+    regression beyond tolerance at COMPARABLE walker counts (a
+    cross-walker-count or cross-split-mode drop measures a different
+    fleet configuration — advisory, like pipeline depth)."""
+    base, bw, bs = sim_stats(base_doc)
+    cand, cw, cs = sim_stats(cand_doc)
+    if base is None or cand is None:
+        return 0
+    print(f"walks_per_s: baseline {base:.1f} -> candidate {cand:.1f}"
+          f"  [{fmt_delta(base, cand)}]")
+    advisory = False
+    if bw is not None and cw is not None and bw != cw:
+        advisory = True
+        print(f"  walkers: {bw} -> {cw} (different fleet sizes — "
+              f"comparison is advisory)")
+    if bs is not None and cs is not None and bs != cs:
+        advisory = True
+        print(f"  split_enabled: {bs} -> {cs} (different splitting "
+              f"modes — comparison is advisory)")
+    if base > 0 and cand < base * (1.0 - max_regression / 100.0):
+        if advisory:
+            print(f"compare_bench: walks/s drop beyond "
+                  f"{max_regression:.1f}% tolerance, but the fleets "
+                  f"differ — advisory, not a regression",
+                  file=sys.stderr)
+            return 0
+        print(f"compare_bench: walks/s REGRESSION beyond "
+              f"{max_regression:.1f}% tolerance", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("baseline")
@@ -140,6 +200,10 @@ def main(argv=None):
                   f"{cl[-1].get('distinct')} (NOT the same exploration"
                   f" — throughput comparison may be meaningless)")
 
+    # simulation throughput rides the same gate (ISSUE 7): walks/s
+    # regressions fail, cross-walker-count comparisons are advisory
+    sim_rc = gate_sim(base_doc, cand_doc, args.max_regression)
+
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch:
             what = ("pipeline depths" if pipe_mismatch
@@ -148,10 +212,12 @@ def main(argv=None):
                   f"{args.max_regression:.1f}% tolerance, but the "
                   f"documents ran different {what} — "
                   f"advisory, not a regression", file=sys.stderr)
-            return 0
+            return sim_rc
         print(f"compare_bench: REGRESSION beyond "
               f"{args.max_regression:.1f}% tolerance", file=sys.stderr)
         return 1
+    if sim_rc:
+        return sim_rc
     print("compare_bench: within tolerance")
     return 0
 

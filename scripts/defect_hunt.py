@@ -1,22 +1,24 @@
 """Defect-reproduction hunt: find the state-transfer data-loss
 violation (reference README:11-18, state_transfer_violation_trace.txt)
-with the device simulator on the defect fixture config.
+with the SHARDED WALKER FLEET (tpuvsr/sim, ISSUE 7) on the defect
+fixture config.
 
 Uses weighted two-stage action sampling + swarm scheduler noise
-(DeviceSimulator action_weights/swarm_sigma) — uniform-over-successors
-walks are dominated by message-delivery lanes and essentially never
-thread the SendGetState truncation window.
+(uniform-over-successors walks are dominated by message-delivery lanes
+and essentially never thread the SendGetState truncation window), and
+— in guided mode — fingerprint-novelty importance splitting with the
+VSR kernel's ``hunt_score`` blended in (``tpuvsr/sim/splitting.py``).
 
 Usage: python scripts/defect_hunt.py [walkers] [depth] [max_seconds]
        [seed] [swarm_sigma] [mode]
 
 Modes (the r4 ablation axis, VERDICT item 6):
   uniform  — TLC's uniform-over-successors draw (no action weighting)
-  flat     — two-stage sampling, uniform over enabled ACTIONS (the
-             round-3 default: action_weights={} resolves to all-ones)
+  flat     — two-stage sampling, uniform over enabled ACTIONS
   weighted — two-stage sampling with real weights biased toward the
              defect path (SendGetState truncation + view changes)
-  guided   — weighted + importance splitting (hunt_score resampling)
+  guided   — weighted + importance splitting (novelty + hunt_score
+             kill/clone resampling)
 """
 
 import json
@@ -55,17 +57,18 @@ WEIGHTS = {
 }
 
 MODES = {
-    "uniform": dict(action_weights=None, guided=False, swarm=0.0),
-    "flat": dict(action_weights={}, guided=False, swarm=sigma),
-    "weighted": dict(action_weights=WEIGHTS, guided=False, swarm=sigma),
-    "guided": dict(action_weights=WEIGHTS, guided=True, swarm=sigma),
+    "uniform": dict(action_weights=None, split=False, swarm=0.0),
+    "flat": dict(action_weights={}, split=False, swarm=sigma),
+    "weighted": dict(action_weights=WEIGHTS, split=False, swarm=sigma),
+    "guided": dict(action_weights=WEIGHTS, split=True, swarm=sigma),
 }
 mcfg = MODES[mode]
 
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
-from tpuvsr.engine.device_sim import DeviceSimulator
+from tpuvsr.sim.fleet import FleetSimulator
+from tpuvsr.sim.splitting import NoveltySplitter
 
 REFERENCE = os.environ.get(
     "TPUVSR_REFERENCE", "/root/reference/vsr-revisited/paper")
@@ -77,13 +80,15 @@ spec = SpecModel(mod, cfg)
 import jax
 print(f"backend: {jax.default_backend()}", file=sys.stderr)
 
-guided = mcfg["guided"]
+split = (NoveltySplitter(frac=0.25, decay=0.5, hunt_beta=1.5)
+         if mcfg["split"] else None)
 t0 = time.time()
-sim = DeviceSimulator(spec, walkers=walkers, chunk_steps=8, max_msgs=48,
-                      action_weights=mcfg["action_weights"],
-                      swarm_sigma=mcfg["swarm"], guided=guided)
-print(f"build: {time.time()-t0:.1f}s mode={mode} "
-      f"(compile on first chunk)", file=sys.stderr, flush=True)
+sim = FleetSimulator(spec, walkers=walkers, chunk_steps=8, max_msgs=48,
+                     action_weights=mcfg["action_weights"],
+                     swarm_sigma=mcfg["swarm"], split=split)
+print(f"build: {time.time()-t0:.1f}s mode={mode} walkers={walkers} "
+      f"mesh={sim.D} (compile on first chunk)",
+      file=sys.stderr, flush=True)
 
 t0 = time.time()
 res = sim.run(num=10**9, depth=depth, seed=seed,
@@ -102,8 +107,11 @@ if res.trace:
     print("acked:", last["aux_client_acked"])
     result = {"time_to_violation_s": round(ttv, 1),
               "violated": res.violated_invariant,
-              "walkers": walkers, "depth": depth, "seed": seed,
-              "swarm_sigma": mcfg["swarm"], "guided": guided,
+              "engine": "fleet-sim",
+              "walkers": walkers, "mesh_devices": sim.D,
+              "depth": depth, "seed": seed,
+              "swarm_sigma": mcfg["swarm"],
+              "split_enabled": bool(mcfg["split"]),
               "mode": mode,
               "walks": res.walks, "steps": res.steps,
               "trace_len": len(res.trace),

@@ -43,6 +43,14 @@ reference mount, no TPU, seconds on the CPU backend:
   service-oom-degrade injected OOM under the dispatcher -> the
                      per-job supervisor degrades the tile inside ONE
                      job run (no requeue), exact fixpoint
+  sim-oom-shrink     injected OOM inside a walker-fleet chunk
+                     (ISSUE 7) -> the fleet halves its walker count
+                     (degrade {what:"walkers"}), redraws the round,
+                     and the trace matches the degraded-count oracle
+  kill-hunt-resume   SIGTERM mid-hunt -> walker-frontier rescue
+                     snapshot + Preempted; the resumed hunt's deduped
+                     violation set and headline trace are
+                     bit-identical to an uninterrupted oracle hunt
 
 Prints one JSON object; exit 0 iff every scenario passed.  Run by
 tests/test_resilience.py under tier-1 and standalone:
@@ -486,6 +494,86 @@ def scenario_service_oom_degrade(tmp):
     }
 
 
+def scenario_sim_oom_shrink(tmp):
+    """Injected OOM inside a fleet chunk (ISSUE 7): the fleet's own
+    degrade ladder halves the walker count, journals
+    ``degrade {what: "walkers"}`` + ``retry``, redraws the round, and
+    the run still completes — per-walk determinism makes the redraw
+    exact."""
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.testing import stub_fleet
+    jp = os.path.join(tmp, "sim-oom.jsonl")
+    faults.install("oom@level=2")
+    try:
+        sim = stub_fleet(walkers=32, n_devices=2, inv_x_bound=2)
+        res = sim.run(num=64, depth=8, seed=3,
+                      obs=RunObserver(journal_path=jp))
+    finally:
+        faults.clear()
+    # oracle at the DEGRADED walker count: the redraw must match it
+    oracle = stub_fleet(walkers=16, n_devices=2, inv_x_bound=2).run(
+        num=64, depth=8, seed=3)
+    ev = [e["event"] for e in read_journal(jp)]
+    degr = [(e["what"], e["from"], e["to"])
+            for e in read_journal(jp) if e["event"] == "degrade"]
+    same = (res.violated_invariant == oracle.violated_invariant
+            and [(t.action_name, t.state) for t in res.trace]
+            == [(t.action_name, t.state) for t in oracle.trace])
+    return {
+        "ok": (not res.ok and sim.walkers == 16 and same
+               and ("walkers", 32, 16) in degr
+               and "fault" in ev and "retry" in ev),
+        "walkers": sim.walkers, "degrades": degr,
+        "trace_matches_degraded_oracle": same,
+    }
+
+
+def scenario_kill_hunt_resume(tmp):
+    """SIGTERM mid-hunt under the fleet (ISSUE 7): rescue snapshot of
+    the walker frontier at the committed chunk boundary, exit-75-style
+    Preempted; the resumed hunt's unique-violation set and headline
+    trace are bit-identical to an uninterrupted oracle hunt."""
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.sim.hunt import run_hunt, sim_result_summary
+    from tpuvsr.testing import counter_spec, stub_model_factory
+    spec = counter_spec(inv_x_bound=2)
+    factory = stub_model_factory(inv_x_bound=2)
+    kw = dict(walkers=32, n_devices=2, depth=8, seed=5, num=64,
+              chunk_steps=4, model_factory=factory)
+    oracle = sim_result_summary(run_hunt(spec, **kw))
+    ck = os.path.join(tmp, "hunt-ck")
+    jp = os.path.join(tmp, "hunt.jsonl")
+    faults.install("kill@level=1")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                run_hunt(spec, checkpoint_path=ck,
+                         obs=RunObserver(journal_path=jp), **kw)
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    res2 = sim_result_summary(run_hunt(
+        spec, resume_from=ck, obs=RunObserver(journal_path=jp), **kw))
+    ev = [e["event"] for e in read_journal(jp)]
+    return {
+        "ok": (res2["violations"] == oracle["violations"]
+               and res2["trace"] == oracle["trace"]
+               and res2["walks"] == oracle["walks"]
+               and "rescue_checkpoint" in ev and "fault" in ev
+               and "sim_chunk" in ev and "hunt_violation" in ev),
+        "unique_violations": len(res2["violations"]),
+        "walks": res2["walks"],
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -499,6 +587,8 @@ SCENARIOS = [
     ("pipeline-faults", scenario_pipeline_faults),
     ("service-preempt-requeue", scenario_service_preempt_requeue),
     ("service-oom-degrade", scenario_service_oom_degrade),
+    ("sim-oom-shrink", scenario_sim_oom_shrink),
+    ("kill-hunt-resume", scenario_kill_hunt_resume),
 ]
 
 

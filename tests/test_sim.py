@@ -1,0 +1,699 @@
+"""Walker-fleet simulation tests (tpuvsr/sim, ISSUE 7).
+
+Everything runs tier-1 on the stub harness (``tpuvsr/testing.py``) —
+the REAL fleet chunk kernel / splitting / hunt / service paths on the
+inline counter spec, virtual 8-device CPU mesh (conftest).
+
+The load-bearing battery is the determinism contract: same seed =>
+bit-identical violation trace across walker counts (4096 vs 65536),
+mesh sizes (1/2/4 stub devices), and across a rescue/resume seam —
+the ISSUE 7 acceptance restated on the stub spec.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpuvsr.obs import (RunObserver, read_journal,
+                        validate_journal_line)
+from tpuvsr.resilience import faults
+from tpuvsr.resilience.supervisor import Preempted, PreemptionGuard
+from tpuvsr.service.queue import JobQueue
+from tpuvsr.service.worker import Worker
+from tpuvsr.sim import NoveltySplitter, run_hunt, sim_result_summary
+from tpuvsr.sim.fleet import fleet_snapshot_info, load_fleet_snapshot
+from tpuvsr.testing import counter_spec, stub_fleet, stub_model_factory
+
+
+def sig(res):
+    """Comparable identity of a violation trace."""
+    return [(e.position, e.action_name, tuple(sorted(e.state.items())))
+            for e in res.trace]
+
+
+# ---------------------------------------------------------------------
+# fleet basics
+# ---------------------------------------------------------------------
+def test_fleet_clean_walks_and_counts():
+    sim = stub_fleet(walkers=16, n_devices=2)
+    res = sim.run(num=32, depth=6, seed=0)
+    assert res.ok and res.walks == 32
+    # the counter spec always has an enabled action while x+y < 6, so
+    # every depth-6 walk takes exactly 6 steps (host-sim parity below)
+    assert res.steps == 32 * 6
+    assert res.deadlocks == 0
+    assert res.walkers == 16
+    assert res.metrics["gauges"]["walkers"] == 16
+
+
+def test_fleet_matches_host_sim_semantics():
+    """TLC-semantics parity against engine/simulate.py: on the stub
+    spec both simulators take exactly depth steps per walk (every
+    pre-fixpoint state has an enabled action) and agree on the
+    violated invariant when the bound tightens."""
+    from tpuvsr.engine.simulate import simulate
+    spec = counter_spec()
+    host = simulate(spec, num=4, depth=6, seed=5)
+    flt = stub_fleet(walkers=8, n_devices=2).run(num=8, depth=6,
+                                                 seed=5)
+    assert host.ok and flt.ok
+    assert host.steps == 4 * 6 and flt.steps == 8 * 6
+    bad_host = simulate(counter_spec(inv_bound=3), num=8, depth=6,
+                        seed=5)
+    bad_flt = stub_fleet(walkers=8, n_devices=2,
+                         inv_bound=3).run(num=8, depth=6, seed=5)
+    assert (not bad_host.ok) and (not bad_flt.ok)
+    assert bad_host.violated_invariant \
+        == bad_flt.violated_invariant == "Bound"
+
+
+def test_fleet_walks_are_interpreter_legal():
+    """Every recorded fleet transition must be a legal interpreter
+    successor, and the replayed states must satisfy the invariant
+    exactly where the kernel said they did — the standing
+    kernel-vs-interpreter differential, applied to walks."""
+    spec = counter_spec()
+    sim = stub_fleet(walkers=8, n_devices=2, spec=spec)
+    (violated, dead, hists, init_states, steps, completed,
+     chunks) = sim.run_round(base=0, active=8, depth=6,
+                             key=jax.random.PRNGKey(0),
+                             obs=RunObserver())
+    assert completed and steps == 8 * 6
+    inits = list(spec.init_states())
+    for slot in range(8):
+        trace = sim.replay({k: v[slot] for k, v in
+                            init_states.items()}, hists, slot, 6)
+        assert len(trace) == 7
+        prev = trace[0].state
+        assert prev in inits
+        for e in trace[1:]:
+            legal = [(a.name, s) for a, s in spec.successors(prev)]
+            assert (e.action_name, e.state) in legal
+            assert spec.check_invariants(e.state) is None
+            prev = e.state
+
+
+# ---------------------------------------------------------------------
+# the determinism contract (ISSUE 7 acceptance, stub-spec form)
+# ---------------------------------------------------------------------
+def test_violation_trace_identical_across_walker_counts():
+    """Same seed => bit-identical violation trace at 4096 vs 65536
+    walkers (walk i is a pure function of (seed, i); the reported
+    violation is the minimum violating walk id)."""
+    runs = {}
+    for W in (4096, 65536):
+        res = stub_fleet(walkers=W, n_devices=2, inv_x_bound=2).run(
+            num=65536, depth=8, seed=7)
+        assert not res.ok and res.violated_invariant == "Bound"
+        runs[W] = sig(res)
+    assert runs[4096] == runs[65536]
+
+
+def test_violation_trace_identical_across_mesh_sizes():
+    base = None
+    for D in (1, 2, 4):
+        res = stub_fleet(walkers=64, n_devices=D, inv_x_bound=2).run(
+            num=1024, depth=8, seed=7)
+        assert not res.ok
+        base = base or sig(res)
+        assert sig(res) == base
+    # the reported violation is on the MINIMUM violating walk id: the
+    # hunt scans the same walk ids and its first unique violation (in
+    # walk-id order) must be the very trace the simulator reported
+    from tpuvsr.sim.hunt import trace_json
+    res64 = stub_fleet(walkers=64, n_devices=1, inv_x_bound=2).run(
+        num=1024, depth=8, seed=7)
+    hunt = run_hunt(
+        counter_spec(inv_x_bound=2), walkers=64, n_devices=1, depth=8,
+        seed=7, num=res64.walks,
+        model_factory=stub_model_factory(inv_x_bound=2))
+    assert hunt.violations[0]["trace"] == trace_json(res64.trace)
+
+
+def test_rescue_resume_trace_identical(tmp_path):
+    """kill mid-round -> rescue snapshot of the walker frontier ->
+    resume replays the identical violation trace, even on a different
+    mesh size."""
+    ck = str(tmp_path / "ck")
+    jp = str(tmp_path / "j.jsonl")
+    oracle = stub_fleet(walkers=32, n_devices=2, inv_x_bound=2).run(
+        num=64, depth=8, seed=3)
+    faults.install("kill@level=1")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                stub_fleet(walkers=32, n_devices=2,
+                           inv_x_bound=2).run(
+                    num=64, depth=8, seed=3, checkpoint_path=ck,
+                    obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    assert preempted is not None and preempted.path == ck
+    info = fleet_snapshot_info(ck)
+    assert info and info["step"] == preempted.depth
+    # engine-checkpoint snapshot_info reads fleet manifests too (the
+    # service's cheap rescue handoff)
+    from tpuvsr.engine.checkpoint import snapshot_info
+    assert snapshot_info(ck)["depth"] == preempted.depth
+    r2 = stub_fleet(walkers=32, n_devices=2, inv_x_bound=2).run(
+        num=64, depth=8, seed=3, resume_from=ck,
+        obs=RunObserver(journal_path=jp))
+    assert sig(r2) == sig(oracle)
+    r4 = stub_fleet(walkers=32, n_devices=4, inv_x_bound=2).run(
+        num=64, depth=8, seed=3, resume_from=ck)
+    assert sig(r4) == sig(oracle)
+    ev = [e["event"] for e in read_journal(jp)]
+    assert "rescue_checkpoint" in ev and "sim_chunk" in ev
+    assert "violation" in ev and "fault" in ev
+
+
+def test_elastic_grow_regains_capped_mesh_devices():
+    """A fleet built with fewer walkers than requested devices caps
+    the mesh; a later elastic grow must win those devices back (the
+    mesh rebuild keys on != target size, not > walkers)."""
+    sim = stub_fleet(walkers=4, n_devices=8)
+    assert sim.D == 4
+    sim._set_walkers(64)
+    assert sim.D == 8
+    r = sim.run(num=64, depth=8, seed=3)
+    assert r.ok and r.walks == 64
+    # and the grown fleet still matches the determinism contract
+    assert stub_fleet(walkers=64, n_devices=8).run(
+        num=64, depth=8, seed=3).walks == 64
+
+
+def test_rescue_resume_preserves_deadlock_count(tmp_path):
+    """The rescue manifest carries the deadlock total of completed
+    rounds, so a resumed run's summary matches the uninterrupted
+    oracle.  At Limit=3 / default invariant every walk freezes at
+    (3, 3), so each 16-walk round banks 16 deadlocks; the kill fires
+    in round 2, after round 1's count is only in the manifest."""
+    ck = str(tmp_path / "ck")
+    oracle = stub_fleet(walkers=16, n_devices=1).run(
+        num=48, depth=8, seed=5)
+    assert oracle.ok and oracle.deadlocks == 48
+    faults.install("kill@level=3")
+    try:
+        with PreemptionGuard():
+            with pytest.raises(Preempted):
+                stub_fleet(walkers=16, n_devices=1).run(
+                    num=48, depth=8, seed=5, checkpoint_path=ck)
+    finally:
+        faults.clear()
+    r2 = stub_fleet(walkers=16, n_devices=1).run(
+        num=48, depth=8, seed=5, resume_from=ck)
+    assert r2.ok and r2.deadlocks == oracle.deadlocks
+    # the hunt driver restores the same manifest key
+    h = run_hunt(counter_spec(), walkers=16, n_devices=1, depth=8,
+                 seed=5, num=48, resume_from=ck,
+                 model_factory=stub_model_factory())
+    assert h.deadlocks == oracle.deadlocks
+
+
+def test_snapshot_crc_guard(tmp_path):
+    ck = str(tmp_path / "ck")
+    faults.install("kill@level=1")
+    try:
+        with PreemptionGuard():
+            with pytest.raises(Preempted):
+                stub_fleet(walkers=16, n_devices=1,
+                           inv_x_bound=2).run(num=32, depth=8,
+                                              seed=3,
+                                              checkpoint_path=ck)
+    finally:
+        faults.clear()
+    victim = os.path.join(ck, "walkers.npz")
+    with open(victim, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(ValueError, match="CRC32"):
+        load_fleet_snapshot(ck)
+
+
+# ---------------------------------------------------------------------
+# importance splitting
+# ---------------------------------------------------------------------
+def test_guided_fleet_finds_violation_and_journals_splits(tmp_path):
+    jp = str(tmp_path / "s.jsonl")
+    sim = stub_fleet(walkers=32, n_devices=2, inv_x_bound=2,
+                     split=NoveltySplitter(frac=0.25, hunt_beta=1.0))
+    res = sim.run(num=64, depth=8, seed=1,
+                  obs=RunObserver(journal_path=jp))
+    assert not res.ok and res.violated_invariant == "Bound"
+    evs = read_journal(jp)
+    assert any(e["event"] == "split" for e in evs)
+    g = res.metrics["gauges"]
+    assert 0.0 <= g["split_efficiency"] <= 1.0
+    assert g["novelty_best"] > 0
+
+
+def test_guided_deterministic_across_mesh_and_resume(tmp_path):
+    """Splitting trades walker-count independence for hit rate, but
+    stays bit-identical across mesh sizes and a rescue/resume seam
+    for a fixed (seed, walkers)."""
+    def guided(n_dev, **kw):
+        return stub_fleet(walkers=32, n_devices=n_dev, inv_x_bound=2,
+                          split=NoveltySplitter(frac=0.25,
+                                                hunt_beta=1.0))
+    oracle = guided(2).run(num=64, depth=8, seed=2)
+    assert not oracle.ok
+    for D in (1, 4):
+        assert sig(guided(D).run(num=64, depth=8, seed=2)) \
+            == sig(oracle)
+    ck = str(tmp_path / "ck")
+    faults.install("kill@level=1")
+    try:
+        with PreemptionGuard():
+            with pytest.raises(Preempted):
+                guided(2).run(num=64, depth=8, seed=2,
+                              checkpoint_path=ck)
+    finally:
+        faults.clear()
+    r2 = guided(2).run(num=64, depth=8, seed=2, resume_from=ck)
+    assert sig(r2) == sig(oracle)
+
+
+def test_guided_hunt_resume_bit_identical_past_split_seam(tmp_path):
+    """The hard case of the guided-resume contract: the rescue seam
+    lands at a boundary the splitter resamples at, with live walkers
+    continuing past it (small chunks, deep rounds) — the snapshot
+    must carry the POST-split population or the resumed hunt
+    diverges from the uninterrupted oracle."""
+    from tpuvsr.sim import run_hunt, sim_result_summary
+    spec = counter_spec(inv_x_bound=2)
+
+    def kw():
+        return dict(walkers=32, n_devices=2, depth=16, seed=5, num=64,
+                    chunk_steps=2, min_walkers=8,
+                    split=NoveltySplitter(frac=0.25, hunt_beta=1.0),
+                    model_factory=stub_model_factory(inv_x_bound=2))
+
+    oracle = sim_result_summary(run_hunt(spec, **kw()))
+    ck = str(tmp_path / "ck")
+    faults.install("kill@level=1")
+    try:
+        with PreemptionGuard():
+            with pytest.raises(Preempted):
+                run_hunt(spec, checkpoint_path=ck, **kw())
+    finally:
+        faults.clear()
+    res2 = sim_result_summary(run_hunt(spec, resume_from=ck, **kw()))
+    assert res2["violations"] == oracle["violations"]
+    assert res2["trace"] == oracle["trace"]
+    assert res2["walks"] == oracle["walks"]
+
+
+def test_splitting_never_clones_over_event_slots():
+    """A violated walker's slot (and recorded history) must survive
+    every resample — otherwise the round could lose its own
+    counterexample evidence."""
+    import jax.numpy as jnp
+    spl = NoveltySplitter(frac=0.5)
+    spl.bind(stub_model_factory()(None)[1])
+    spl.reset(8)
+    states = {"x": jnp.arange(8), "y": jnp.zeros(8, jnp.int32),
+              "status": jnp.zeros(8, jnp.int32),
+              "err": jnp.zeros(8, jnp.int32)}
+    alive = jnp.asarray(
+        np.array([1, 1, 1, 1, 0, 0, 1, 1], bool))   # 4,5 frozen
+    violated = jnp.asarray(np.array([-1, -1, -1, -1, 3, -1, -1, -1],
+                                    np.int32))
+    dead = jnp.asarray(np.array([-1, -1, -1, -1, -1, 2, -1, -1],
+                                np.int32))
+    hists = [(jnp.tile(jnp.arange(8, dtype=jnp.int32), (2, 1)),
+              jnp.zeros((2, 8), jnp.int32))]
+    init = {"x": np.zeros(8, np.int32)}
+    s2, a2, h2, i2 = spl.resample(states, alive, violated, dead,
+                                  hists, init)
+    # slots 4 and 5 (the event carriers) are untouched
+    assert int(np.asarray(s2["x"])[4]) == 4
+    assert int(np.asarray(s2["x"])[5]) == 5
+    assert np.asarray(h2[0][0])[:, 4].tolist() == [4, 4]
+    assert np.asarray(h2[0][0])[:, 5].tolist() == [5, 5]
+    assert not bool(np.asarray(a2)[4]) and not bool(np.asarray(a2)[5])
+
+
+# ---------------------------------------------------------------------
+# OOM walker-shrink ladder
+# ---------------------------------------------------------------------
+def test_oom_halves_walkers_and_redraws(tmp_path):
+    jp = str(tmp_path / "oom.jsonl")
+    faults.install("oom@level=2")
+    try:
+        sim = stub_fleet(walkers=32, n_devices=2, inv_x_bound=2)
+        res = sim.run(num=64, depth=8, seed=3,
+                      obs=RunObserver(journal_path=jp))
+    finally:
+        faults.clear()
+    assert sim.walkers == 16 and not res.ok
+    oracle = stub_fleet(walkers=16, n_devices=2, inv_x_bound=2).run(
+        num=64, depth=8, seed=3)
+    assert sig(res) == sig(oracle)
+    evs = read_journal(jp)
+    degr = [(e["what"], e["from"], e["to"]) for e in evs
+            if e["event"] == "degrade"]
+    assert ("walkers", 32, 16) in degr
+    assert any(e["event"] == "retry" for e in evs)
+
+
+def test_hunt_oom_degrade_settles_at_shrunken_count(tmp_path):
+    """After the OOM ladder halves the fleet, the hunt's elastic
+    target follows it down — no regrow at the next round boundary
+    (which would just re-trip a real recurring OOM)."""
+    jp = str(tmp_path / "j.jsonl")
+    faults.install("oom@level=1")
+    try:
+        res = run_hunt(counter_spec(), walkers=32, n_devices=2,
+                       depth=6, seed=0, num=96, min_walkers=8,
+                       model_factory=stub_model_factory(),
+                       obs=RunObserver(journal_path=jp))
+    finally:
+        faults.clear()
+    assert res.ok and res.walks == 96 and res.walkers == 16
+    evs = read_journal(jp)
+    assert ("walkers", 32, 16) in [
+        (e["what"], e["from"], e["to"]) for e in evs
+        if e["event"] == "degrade"]
+    assert not any(e["event"] == "hunt_elastic" for e in evs)
+
+
+def test_deadline_cut_round_does_not_count_walks():
+    """A max_seconds stop mid-round must not credit the aborted
+    round's walks — walks/s is the sim_scale headline and has to stay
+    honest (walks is always a whole number of completed rounds)."""
+    res = stub_fleet(walkers=16, n_devices=1).run(
+        num=10**9, depth=6, seed=0, max_seconds=0.5)
+    assert res.walks % 16 == 0
+
+
+def test_constructor_group_caps_survive_first_build():
+    sim = stub_fleet(walkers=16, n_devices=2, group_caps=[7, 7])
+    assert sim.group_caps == [7, 7]
+    assert sim.run(num=16, depth=6, seed=0).ok
+
+
+def test_oom_ladder_is_bounded():
+    faults.install(",".join(["oom@level=1"] * 8))
+    try:
+        sim = stub_fleet(walkers=16, n_devices=1, min_walkers=8)
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            sim.run(num=16, depth=6, seed=0)
+    finally:
+        faults.clear()
+    assert sim.walkers == 8        # stopped at the floor, not below
+
+
+# ---------------------------------------------------------------------
+# the hunt (continuous mode + dedup)
+# ---------------------------------------------------------------------
+def test_hunt_collects_unique_violations(tmp_path):
+    jp = str(tmp_path / "h.jsonl")
+    spec = counter_spec(inv_x_bound=2)
+    res = run_hunt(spec, walkers=32, n_devices=2, depth=8, seed=1,
+                   num=96, chunk_steps=4,
+                   model_factory=stub_model_factory(inv_x_bound=2),
+                   obs=RunObserver(journal_path=jp))
+    assert not res.ok and res.walks == 96
+    assert len(res.violations) > 1
+    keys = [v["dedup"] for v in res.violations]
+    assert len(keys) == len(set(keys))
+    walks = [v["walk"] for v in res.violations]
+    assert walks == sorted(walks)          # walk-id order
+    for v in res.violations:
+        assert v["name"] == "Bound"
+        assert v["trace"][0]["action"] is None
+        assert v["trace"][-1]["state"]["x"] == "3"
+    evs = read_journal(jp)
+    assert sum(e["event"] == "hunt_violation" for e in evs) \
+        == len(res.violations)
+    assert res.metrics["counters"]["hunt_duplicates"] > 0
+    assert res.metrics["gauges"]["hunt_unique_violations"] \
+        == len(res.violations)
+
+
+def test_hunt_max_violations_stops_early():
+    spec = counter_spec(inv_x_bound=2)
+    res = run_hunt(spec, walkers=32, n_devices=2, depth=8, seed=1,
+                   num=512, max_violations=3,
+                   model_factory=stub_model_factory(inv_x_bound=2))
+    assert len(res.violations) >= 3
+    assert res.walks < 512
+
+
+def test_hunt_elastic_reshapes_at_round_boundary(tmp_path):
+    jp = str(tmp_path / "e.jsonl")
+    spec = counter_spec()
+    res = run_hunt(spec, walkers=32, n_devices=2, depth=6, seed=0,
+                   num=96, model_factory=stub_model_factory(),
+                   elastic=lambda r: 16 if r == 1 else None,
+                   obs=RunObserver(journal_path=jp))
+    assert res.ok and res.walks == 96
+    el = [(e["from"], e["to"]) for e in read_journal(jp)
+          if e["event"] == "hunt_elastic"]
+    assert el == [(32, 16)]
+    assert res.walkers == 16
+
+
+# ---------------------------------------------------------------------
+# journal schema
+# ---------------------------------------------------------------------
+def test_new_sim_journal_events_validate(tmp_path):
+    jp = str(tmp_path / "v.jsonl")
+    stub_fleet(walkers=16, n_devices=2, inv_x_bound=2,
+               split=True).run(num=32, depth=8, seed=1,
+                               obs=RunObserver(journal_path=jp))
+    seen = set()
+    for ev in read_journal(jp):        # read_journal validates lines
+        seen.add(validate_journal_line(ev))
+    assert {"run_start", "sim_chunk", "split", "violation",
+            "run_end"} <= seen
+    for bad in ({"event": "sim_chunk", "ts": 1, "run_id": "x",
+                 "depth": 1},
+                {"event": "hunt_violation", "ts": 1, "run_id": "x",
+                 "name": "I", "walk": 3, "elapsed_s": 0.1},
+                {"event": "hunt_elastic", "ts": 1, "run_id": "x",
+                 "from": 8, "elapsed_s": 0.1}):
+        with pytest.raises(ValueError):
+            validate_journal_line(bad)
+
+
+# ---------------------------------------------------------------------
+# service integration (kind="sim")
+# ---------------------------------------------------------------------
+def test_sim_job_lifecycle_and_kill_resume_bit_identical(tmp_path):
+    q = JobQueue(str(tmp_path / "spool"))
+    flags = {"stub": True, "inv_x_bound": 2, "walkers": 32,
+             "depth": 8, "num": 64, "seed": 1, "chunk_steps": 4}
+    clean = q.submit("<stub:hunt>", kind="sim", flags=dict(flags))
+    kill = q.submit("<stub:kill>", kind="sim",
+                    flags=dict(flags, inject="kill@level=1"))
+    bad = q.submit("<stub:bad>", kind="sim",
+                   flags={"stub": True, "stub_bad": True})
+    Worker(q, devices=2).drain()
+    jc, jk, jb = (q.get(j.job_id) for j in (clean, kill, bad))
+    assert jc.state == "violated" and jc.attempts == 1
+    assert jk.state == "violated" and jk.attempts == 2
+    assert jb.state == "failed" and jb.reason == "speclint" \
+        and jb.attempts == 0
+    assert jk.result["violations"] == jc.result["violations"]
+    assert jk.result["trace"] == jc.result["trace"]
+    assert jk.result["walks"] == jc.result["walks"] == 64
+    evs = [e["event"]
+           for e in read_journal(q.journal_path(jk.job_id))]
+    assert "job_requeued" in evs and "rescue_checkpoint" in evs
+    assert "sim_chunk" in evs and "hunt_violation" in evs
+    assert evs[-1] == "job_done"
+
+
+def test_dead_worker_sim_job_recovers_with_fleet_rescue(tmp_path):
+    """recover_stale reads the FLEET snapshot manifest through the
+    same checkpoint.snapshot_info handoff BFS jobs use."""
+    q = JobQueue(str(tmp_path / "spool"))
+    flags = {"stub": True, "inv_x_bound": 2, "walkers": 32,
+             "depth": 8, "num": 64, "seed": 1, "chunk_steps": 4}
+    j = q.submit("<stub>", kind="sim", flags=dict(flags))
+    oracle = q.submit("<stub:oracle>", kind="sim", flags=dict(flags))
+    # write a mid-round fleet rescue into the job's ckpt dir, then
+    # fake the dead claim
+    ck = q.checkpoint_path(j.job_id)
+    faults.install("kill@level=1")
+    try:
+        with PreemptionGuard():
+            with pytest.raises(Preempted):
+                stub_fleet(walkers=32, n_devices=2,
+                           inv_x_bound=2).run(
+                    num=64, depth=8, seed=1, checkpoint_path=ck)
+    finally:
+        faults.clear()
+    q.transition(j.job_id, "admitted")
+    q.transition(j.job_id, "running", attempts=1)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    with open(os.path.join(q.claims_dir, f"{j.job_id}.claim"),
+              "w") as f:
+        json.dump({"pid": p.pid, "owner": "gone"}, f)
+    assert q.recover_stale() == [j.job_id]
+    job = q.get(j.job_id)
+    assert job.rescue and job.rescue["path"] == ck
+    Worker(q, devices=2).drain()
+    job, oj = q.get(j.job_id), q.get(oracle.job_id)
+    assert job.state == oj.state == "violated"
+    assert job.result["violations"] == oj.result["violations"]
+    assert job.result["trace"] == oj.result["trace"]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices")
+def test_scheduler_shrinks_live_sim_job(tmp_path):
+    """A higher-priority arrival mid-hunt preempts the elastic sim
+    job through the ordinary rescue path; it resumes on the smaller
+    allocation (walker count rescaled at the round boundary) and its
+    deduped violation set stays bit-identical to an undisturbed
+    oracle job."""
+    q = JobQueue(str(tmp_path / "spool"))
+    flags = {"stub": True, "inv_x_bound": 2, "walkers_per_device": 8,
+             "depth": 8, "num": 96, "seed": 1, "chunk_steps": 4}
+    # devices_max pins the post-shrink allocation (no grow-back mid
+    # test) so the walker reshape deterministically lands at the
+    # first round boundary after the elastic resume
+    a = q.submit("<stub:A>", kind="sim", devices=4, devices_min=2,
+                 devices_max=2, flags=dict(flags))
+    state = {"submitted": False}
+
+    def on_level(worker, job, depth):
+        if job.job_id == a.job_id and not state["submitted"]:
+            state["submitted"] = True
+            q.submit("<stub:B>", engine="device", priority=10,
+                     devices=6, flags={"stub": True})
+
+    Worker(q, devices=8, on_level=on_level).drain()
+    job = q.get(a.job_id)
+    assert job.state == "violated"
+    evs = read_journal(q.journal_path(a.job_id))
+    kinds = [e["event"] for e in evs]
+    assert "job_requeued" in kinds and "rescue_checkpoint" in kinds
+    allocs = [e["devices"] for e in evs
+              if e["event"] == "job_started"]
+    assert allocs == [4, 2]
+    # walker-count elasticity journaled at the round boundary: the
+    # resumed hunt finishes the in-flight round at the snapshot's 32
+    # walkers (the determinism contract), then reshapes to 8 * 2
+    reshapes = [(e["from"], e["to"]) for e in evs
+                if e["event"] == "hunt_elastic"]
+    assert reshapes == [(32, 16)]
+    b = [x for x in q.jobs() if x.job_id != a.job_id][0]
+    assert b.state == "done"
+    # undisturbed oracle: same hunt at the original walker count
+    oracle = sim_result_summary(run_hunt(
+        counter_spec(inv_x_bound=2), walkers=32, n_devices=4,
+        depth=8, seed=1, num=96, chunk_steps=4,
+        model_factory=stub_model_factory(inv_x_bound=2)))
+    assert job.result["violations"] == oracle["violations"]
+    assert job.result["walks"] == oracle["walks"]
+
+
+def test_hunt_demo_smoke(capsys):
+    """The 3-job sim-queue drill (clean hunt / speclint-reject /
+    SIGTERM-requeue-bit-identical) under tier-1 — serve_demo's fleet
+    twin."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import hunt_demo
+    assert hunt_demo.main() == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and all(out["checks"].values())
+    assert out["unique_violations"] > 1
+
+
+def test_status_surfaces_sim_progress(tmp_path, capsys):
+    from tpuvsr.service import api
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    j = q.submit("<stub:hunt>", kind="sim",
+                 flags={"stub": True, "inv_x_bound": 2, "walkers": 32,
+                        "depth": 8, "num": 64, "seed": 1,
+                        "chunk_steps": 4})
+    Worker(q, devices=2).drain()
+    rc = api.main(["status", j.job_id, "--spool", spool, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "sim"
+    assert doc["sim"]["walks"] > 0
+    assert doc["sim"]["unique_violations"] > 0
+    rc = api.main(["status", j.job_id, "--spool", spool])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sim:" in out and "unique violation" in out
+
+
+def test_submit_sim_flag_contract(tmp_path, capsys):
+    from tpuvsr.service import api
+    spool = str(tmp_path / "spool")
+    rc = api.main(["submit", "--stub", "--walkers", "64",
+                   "--spool", spool])
+    assert rc == 2              # --walkers without --sim
+    rc = api.main(["submit", "--stub", "--sim", "--walkers", "64",
+                   "--num", "32", "--spool", spool, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["kind"] == "sim" and doc["flags"]["walkers"] == 64
+
+
+# ---------------------------------------------------------------------
+# CLI flag contract (exit 2 at parse time, no spec load)
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("bad", [
+    ["-walkers", "64"],
+    ["-split"],
+    ["-hunt"],
+    ["-simulate", "-walkers", "0"],
+    ["-simulate", "-engine", "interp", "-walkers", "64"],
+    ["-simulate", "-fpset", "host", "-hunt"],
+], ids=["walkers-no-simulate", "split-no-simulate", "hunt-no-simulate",
+        "zero-walkers", "interp-walkers", "fpset-host-hunt"])
+def test_cli_sim_flag_conflicts_exit_2(bad):
+    r = subprocess.run(
+        [sys.executable, "-m", "tpuvsr", "X.tla", *bad],
+        capture_output=True, text=True, timeout=120,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": "/root/repo", "HOME": "/root"})
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
+def test_compare_bench_gates_walks_per_s(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import compare_bench
+
+    def doc(walks_per_s, walkers, value=100.0):
+        return {"value": value,
+                "sim_scale": {"walks_per_s": walks_per_s,
+                              "walkers": walkers,
+                              "split_enabled": False}}
+
+    def run(base, cand):
+        bp, cp = str(tmp_path / "b.json"), str(tmp_path / "c.json")
+        with open(bp, "w") as f:
+            json.dump(base, f)
+        with open(cp, "w") as f:
+            json.dump(cand, f)
+        return compare_bench.main([bp, cp, "--max-regression", "10"])
+
+    assert run(doc(100.0, 4096), doc(95.0, 4096)) == 0   # in tolerance
+    assert run(doc(100.0, 4096), doc(50.0, 4096)) == 1   # regression
+    # cross-walker-count drop: advisory, like pipeline depth
+    assert run(doc(100.0, 4096), doc(50.0, 65536)) == 0

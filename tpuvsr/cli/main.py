@@ -8,10 +8,26 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
   -config FILE     model file (default: SPEC base name + .cfg)
   -workers N|auto  accepted for TLC compatibility (the device engine
                    parallelizes across lanes/devices instead of threads)
-  -simulate        simulation mode (random walks) instead of BFS
+  -simulate        simulation mode (random walks) instead of BFS —
+                   runs on the sharded walker fleet (tpuvsr/sim) for
+                   specs with a device kernel, the interpreter
+                   otherwise
   -depth N         walk depth in simulation mode (default 100)
   -num N           number of walks (default 10000; TLC runs forever)
-  -seed N          simulation RNG seed
+  -seed N          simulation RNG seed.  Fleet walks are a pure
+                   function of (seed, walk id): a violation replays
+                   bit-identically at any -walkers count, any mesh
+                   shape, and across a rescue/resume seam
+  -walkers N       fleet size (default 1024; 10^5+ is the intended
+                   scale — walkers are vmapped and shard_mapped
+                   across every visible device)
+  -split           importance splitting: fingerprint-novelty
+                   kill/clone at chunk boundaries (deep-defect hunts;
+                   trades walker-count replay-independence for hit
+                   rate)
+  -hunt            continuous defect hunt: collect every violation
+                   (deduped fleet-wide, each replayed to a TRACE
+                   counterexample) instead of stopping at the first
   -engine E        auto | device | interp | sharded (default auto:
                    the jit+vmap device engine for specs with a
                    compiled kernel, the interpreter otherwise;
@@ -102,7 +118,8 @@ whose rescue quantum makes fused snapshots possible); -fpset host with
 -simulate/-engine interp/-fpset host; -engine sharded with
 -simulate/-fused (the sharded engine has no fused fixpoint) or any
 non-auto -fpset (its fingerprint set is always the mesh-sharded HBM
-table).
+table); -walkers/-split/-hunt without -simulate, or with
+-engine interp/-fpset host (the fleet is a device backend).
 
 Exit codes (the unified contract in tpuvsr/exitcodes.py): 0 ok;
 1 speclint errors (-lint); 2 bad flags; 12 safety/temporal violation
@@ -152,6 +169,23 @@ def build_parser():
                    default="auto")
     p.add_argument("-fpset", choices=["auto", "hbm", "paged", "host"],
                    default="auto")
+    p.add_argument("-walkers", type=int, default=None, metavar="N",
+                   help="simulation: walker-fleet size (default 1024; "
+                        "the fleet replays any violation identically "
+                        "for a fixed -seed at ANY walker count/mesh "
+                        "shape — tpuvsr/sim)")
+    p.add_argument("-split", action="store_true",
+                   help="simulation: importance splitting — walkers "
+                        "carry a fingerprint-novelty score; low-"
+                        "novelty walkers are killed and respawned as "
+                        "clones of high-novelty ones at chunk "
+                        "boundaries (deep-defect hunts)")
+    p.add_argument("-hunt", action="store_true",
+                   help="simulation: continuous defect hunt — collect "
+                        "EVERY violation (deduped fleet-wide, each "
+                        "replayed to a TRACE counterexample) instead "
+                        "of stopping at the first; bounded by "
+                        "-num/-maxseconds")
     p.add_argument("-maxstates", type=int, default=None)
     p.add_argument("-deadlock", action="store_true")
     p.add_argument("-checkpoint", type=float, default=None,
@@ -240,6 +274,23 @@ def validate_args(parser, args):
                          f"{args.fpset}")
     if args.supervise and args.simulate:
         parser.error("-supervise supervises BFS runs, not simulation")
+    for flag, given in (("-walkers", args.walkers is not None),
+                        ("-split", args.split),
+                        ("-hunt", args.hunt)):
+        if given and not args.simulate:
+            parser.error(f"{flag} needs -simulate (it configures the "
+                         f"walker fleet)")
+        if given and (args.engine == "interp"
+                      or args.fpset == "host"):
+            parser.error(f"{flag} needs the device fleet backend; it "
+                         f"cannot be combined with -engine interp/"
+                         f"-fpset host")
+    if args.walkers is not None and args.walkers < 1:
+        parser.error(f"-walkers must be >= 1 (got {args.walkers})")
+    if args.hunt and args.deadlock:
+        parser.error("-hunt collects invariant violations only (it "
+                     "has no deadlock counterexample path); use plain "
+                     "-simulate -deadlock")
     if args.supervise and (args.engine == "interp"
                            or args.fpset == "host"):
         parser.error("-supervise needs the device/paged/sharded "
@@ -319,6 +370,11 @@ def main(argv=None):
         log("-supervise needs the device/paged engine; this spec "
             "resolved to the interpreter — running unsupervised")
         args.supervise = False
+    if args.simulate and engine == "interp" and (
+            args.walkers is not None or args.split or args.hunt):
+        log("-walkers/-split/-hunt need a compiled device kernel "
+            "(the walker fleet); this spec resolved to the "
+            "interpreter — running plain host simulation")
 
     if engine in ("device", "paged", "sharded"):
         if engine == "sharded":
@@ -363,11 +419,27 @@ def main(argv=None):
 
     if args.simulate:
         if engine in ("device", "paged"):
-            from ..engine.device_sim import device_simulate
-            res = device_simulate(spec, num=args.num, depth=args.depth,
-                                  seed=args.seed, log=log,
-                                  check_deadlock=args.deadlock,
-                                  max_seconds=args.maxseconds, obs=obs)
+            # the walker fleet (tpuvsr/sim) is the simulation backend
+            # (it supersedes engine/device_sim's scan loop): sharded
+            # across every visible device, deterministic per
+            # (seed, walk id) at any walker count/mesh shape
+            from ..sim import fleet_simulate, run_hunt
+            walkers = args.walkers or 1024
+            split = True if args.split else None
+            if args.hunt:
+                res = run_hunt(spec, walkers=walkers,
+                               depth=args.depth, seed=args.seed,
+                               num=args.num, split=split,
+                               pipeline=args.pipeline,
+                               max_seconds=args.maxseconds,
+                               obs=obs, log=log)
+            else:
+                res = fleet_simulate(
+                    spec, num=args.num, depth=args.depth,
+                    seed=args.seed, walkers=walkers, split=split,
+                    pipeline=args.pipeline,
+                    check_deadlock=args.deadlock, log=log,
+                    max_seconds=args.maxseconds, obs=obs)
         else:
             from ..engine.simulate import simulate
             res = simulate(spec, num=args.num, depth=args.depth,
@@ -379,6 +451,10 @@ def main(argv=None):
                    "violated": res.violated_invariant,
                    "elapsed_s": round(res.elapsed, 3),
                    "metrics": summary_metrics(res.metrics)}
+        if getattr(res, "walkers", 0):
+            summary["walkers"] = res.walkers
+        if getattr(res, "violations", None) is not None:
+            summary["unique_violations"] = len(res.violations)
     else:
         if engine in ("device", "paged", "sharded"):
             from ..engine.device_bfs import DeviceBFS

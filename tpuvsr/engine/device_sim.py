@@ -2,6 +2,17 @@
 README:22, rebuilt as a scan-based XLA program; BASELINE.json
 configs[2]).
 
+SUPERSEDED as the simulation backend by the sharded walker fleet
+(``tpuvsr/sim``, ISSUE 7): the CLI ``-simulate`` path, ``bench.py``'s
+``sim_scale``/``defect_hunt`` probes and the service ``kind="sim"``
+jobs all run the fleet — per-(seed, walk-id) deterministic draws,
+shard_map across the mesh, the ``engine/pipeline.py`` dispatch window,
+and importance splitting over a fingerprint-novelty seen-set.  This
+class remains the single-device scan oracle (its chunk kernel is the
+shape the fleet's was grown from) and the backend for callers that
+want the legacy shared-key-stream draw; ``device_simulate(...,
+fleet=True)`` delegates to the fleet.
+
 Semantics match TLC's SimulationWorker: each walk starts at the initial
 state and repeatedly jumps to a successor chosen uniformly at random
 from the full (action x binding) successor list — which is exactly the
@@ -45,6 +56,42 @@ from .spec import SpecModel
 from .trace import TraceEntry
 
 I32 = jnp.int32
+
+
+def materialize_walk(kern, codec, spec, st0, aids, prms, n_steps,
+                     cache=None):
+    """Re-execute a recorded (action id, lane param) choice sequence
+    from dense state `st0` through the materialize kernel into a
+    TRACE-format counterexample — the ONE replay used by both the
+    single-device simulator and the walker fleet (tpuvsr/sim).  Stops
+    at `n_steps` or the first ``-1`` action (a frozen walker).
+    `cache` maps action id -> jitted single-state materializer (pass
+    the caller's dict to reuse compilations across replays)."""
+    cache = {} if cache is None else cache
+    loc = {a.name: a.location for a in spec.actions}
+    st = {k: np.asarray(v) for k, v in st0.items()}
+    out = [TraceEntry(position=1, action_name=None, location=None,
+                      state=codec.decode(st))]
+    for i in range(min(int(n_steps), len(aids))):
+        aid = int(aids[i])
+        if aid < 0:
+            break
+        fn = cache.get(aid)
+        if fn is None:
+            fn = jax.jit(jax.vmap(kern._action_fns()[aid],
+                                  in_axes=(0, 0)))
+            cache[aid] = fn
+        batch = {k: np.asarray(v)[None] for k, v in st.items()}
+        succ, en = fn(batch, jnp.asarray([int(prms[i])], jnp.int32))
+        if not bool(np.asarray(en)[0]):
+            raise AssertionError("replay chose a disabled lane")
+        st = {k: np.asarray(v)[0] for k, v in succ.items()
+              if not k.startswith("_")}
+        name = kern.action_names[aid]
+        out.append(TraceEntry(position=i + 2, action_name=name,
+                              location=loc.get(name),
+                              state=codec.decode(st)))
+    return out
 
 
 class DeviceSimulator:
@@ -294,18 +341,6 @@ class DeviceSimulator:
         self._build(old * 2)
         return [self.codec.pad_msgs(b, old) for b in batches]
 
-    def _materialize_one(self, st, aid, param):
-        fn = self._mat.get(aid)
-        if fn is None:
-            fn = jax.jit(jax.vmap(self.kern._action_fns()[aid],
-                                  in_axes=(0, 0)))
-            self._mat[aid] = fn
-        batch = {k: np.asarray(v)[None] for k, v in st.items()}
-        succ, en = fn(batch, jnp.asarray([param], jnp.int32))
-        assert bool(np.asarray(en)[0]), "replay chose a disabled lane"
-        return {k: np.asarray(v)[0] for k, v in succ.items()
-                if not k.startswith("_")}
-
     @closes_observer
     def run(self, num=1000, depth=100, seed=0, check_deadlock=False,
             log=None, max_seconds=None, obs=None) -> SimResult:
@@ -441,25 +476,28 @@ class DeviceSimulator:
         aids = np.concatenate([np.asarray(ha)[:, w] for ha, _hp in hists])
         prms = np.concatenate([np.asarray(hp)[:, w] for _ha, hp in hists])
         st = {k: np.asarray(v[w]) for k, v in init.items()}
-        loc = {a.name: a.location for a in self.spec.actions}
-        out = [TraceEntry(position=1, action_name=None, location=None,
-                          state=self.codec.decode(st))]
-        for i in range(min(n_steps, len(aids))):
-            if aids[i] < 0:
-                break
-            st = self._materialize_one(st, int(aids[i]), int(prms[i]))
-            name = self.kern.action_names[aids[i]]
-            out.append(TraceEntry(position=i + 2, action_name=name,
-                                  location=loc.get(name),
-                                  state=self.codec.decode(st)))
-        return out
+        return materialize_walk(self.kern, self.codec, self.spec, st,
+                                aids, prms, n_steps, cache=self._mat)
 
 
 def device_simulate(spec: SpecModel, num=1000, depth=100, seed=0,
                     walkers=256, max_msgs=None, check_deadlock=False,
                     log=None, max_seconds=None, chunk_steps=32,
                     action_weights=None, swarm_sigma=0.0,
-                    guided=False, split_beta=1.5, obs=None) -> SimResult:
+                    guided=False, split_beta=1.5, obs=None,
+                    fleet=False) -> SimResult:
+    if fleet:
+        # delegate to the sharded walker fleet (tpuvsr/sim): guided
+        # maps onto fingerprint-novelty importance splitting
+        from ..sim import fleet_simulate
+        return fleet_simulate(spec, num=num, depth=depth, seed=seed,
+                              walkers=walkers, max_msgs=max_msgs,
+                              chunk_steps=chunk_steps,
+                              action_weights=action_weights,
+                              swarm_sigma=swarm_sigma,
+                              split=True if guided else None,
+                              check_deadlock=check_deadlock, log=log,
+                              max_seconds=max_seconds, obs=obs)
     sim = DeviceSimulator(spec, max_msgs=max_msgs, walkers=walkers,
                           chunk_steps=chunk_steps,
                           action_weights=action_weights,

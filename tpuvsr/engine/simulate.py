@@ -29,10 +29,16 @@ class SimResult:
     elapsed: float = 0.0
     deadlocks: int = 0
     metrics: dict = None      # tpuvsr-metrics/1 document for this run
+    walkers: int = 0          # fleet size of the run (tpuvsr/sim)
+    violations: list = None   # hunt mode: unique-violation records
 
     @property
     def steps_per_sec(self):
         return self.steps / self.elapsed if self.elapsed > 0 else 0.0
+
+    @property
+    def walks_per_sec(self):
+        return self.walks / self.elapsed if self.elapsed > 0 else 0.0
 
 
 def simulate(spec: SpecModel, num: int = 100, depth: int = 100,

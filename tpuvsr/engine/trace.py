@@ -36,6 +36,21 @@ def reconstruct_trace(sid, parents, states):
     return out
 
 
+def trace_to_jsonable(trace):
+    """Serialize a trace for job-result records — the ONE stable form
+    every service/hunt bit-identity check compares (two runs are
+    equivalent iff these lists are equal).  Shared by the dispatch
+    worker and the fleet hunt; jax-free, so the service's fast verbs
+    keep their no-jax import property."""
+    out = []
+    for e in trace:
+        out.append({"position": int(e.position),
+                    "action": e.action_name,
+                    "state": {k: fmt(v)
+                              for k, v in sorted(e.state.items())}})
+    return out
+
+
 def format_trace_te(trace, varnames=None) -> str:
     """Emit a trace in the reference's ``_TEAction`` record format
     (state_transfer_violation_trace.txt:3-26) — the format

@@ -58,6 +58,15 @@ EVENT_REQUIRED = {
     "job_started": ("job_id", "attempt", "devices"),
     "job_requeued": ("job_id", "reason", "elapsed_s"),
     "job_done": ("job_id", "state", "elapsed_s"),
+    # walker-fleet simulation (ISSUE 7): the chunk boundary is the
+    # sim analog of level_done (walks/steps cumulative); `split` is an
+    # importance-splitting resample; `hunt_violation` a UNIQUE
+    # deduped violation found by the continuous hunt; `hunt_elastic`
+    # a walker-count reshape at a round boundary
+    "sim_chunk": ("depth", "walks", "steps", "elapsed_s"),
+    "split": ("killed", "novelty_best", "elapsed_s"),
+    "hunt_violation": ("name", "walk", "depth", "elapsed_s"),
+    "hunt_elastic": ("from", "to", "elapsed_s"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

@@ -256,6 +256,44 @@ class RunObserver:
                            distinct=int(distinct),
                            elapsed_s=round(self.elapsed(), 3))
 
+    # -- walker-fleet simulation events (ISSUE 7) ----------------------
+    def sim_chunk(self, depth, *, walks, steps, **extra):
+        """A committed fleet chunk boundary — the sim analog of
+        ``level_done`` (where service ticks, rescues and splits
+        land).  `depth` is the committed walk step within the round;
+        `walks`/`steps` are cumulative across the run."""
+        self.count("sim_chunks")
+        self.journal.write("sim_chunk", depth=int(depth),
+                           walks=int(walks), steps=int(steps),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def split(self, *, killed, novelty_best, **extra):
+        """An importance-splitting resample at a chunk boundary:
+        `killed` low-novelty walkers were respawned as clones of the
+        best ones (0 = the population was score-flat)."""
+        self.count("splits")
+        if killed:
+            self.count("split_killed", int(killed))
+        self.journal.write("split", killed=int(killed),
+                           novelty_best=float(novelty_best),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def hunt_violation(self, name, walk, depth, **extra):
+        """A UNIQUE (fleet-deduped) violation collected by the
+        continuous hunt, replayed to a TRACE-format counterexample."""
+        self.count("hunt_violations")
+        self.journal.write("hunt_violation", name=str(name),
+                           walk=int(walk), depth=int(depth),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def hunt_elastic(self, from_, to):
+        """A walker-count reshape at a round boundary (elastic
+        shrink/grow under the scheduler, or an elastic resume)."""
+        self.count("hunt_elastics")
+        self.journal.write("hunt_elastic",
+                           elapsed_s=round(self.elapsed(), 3),
+                           **{"from": int(from_), "to": int(to)})
+
     def rescue(self, path, depth, distinct, signal_name):
         """A preemption rescue snapshot written at a level boundary
         (the run exits with the resumable code right after)."""
@@ -340,8 +378,11 @@ class RunObserver:
                            diameter=int(res.diameter))
         elif hasattr(res, "walks"):                     # SimResult
             self.gauge("steps_per_s", res.steps / el)
+            self.gauge("walks_per_s", res.walks / el)
             summary.update(walks=int(res.walks), steps=int(res.steps),
                            deadlocks=int(res.deadlocks))
+            if getattr(res, "violations", None) is not None:
+                summary["unique_violations"] = len(res.violations)
         elif hasattr(res, "property_name"):             # LivenessResult
             summary.update(distinct=int(res.distinct_states))
         summary["violated"] = violated

@@ -78,6 +78,27 @@ def build_parser():
     sp.add_argument("--inject", default=None,
                     help="deterministic fault plan for this job "
                          "(tpuvsr/resilience/faults.py grammar)")
+    sp.add_argument("--sim", action="store_true",
+                    help="submit a kind=\"sim\" job: a walker-fleet "
+                         "defect hunt (tpuvsr/sim) instead of a BFS "
+                         "check")
+    sp.add_argument("--walkers", type=int, default=None,
+                    help="sim jobs: fleet size (default 512)")
+    sp.add_argument("--depth", type=int, default=None,
+                    help="sim jobs: walk depth bound (default 100)")
+    sp.add_argument("--num", type=int, default=None,
+                    help="sim jobs: stop after N walks (default "
+                         "10000; --hunt for the continuous mode)")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="sim jobs: fleet RNG seed (walk i replays "
+                         "identically for any walker count/mesh)")
+    sp.add_argument("--split", action="store_true",
+                    help="sim jobs: importance splitting (fingerprint-"
+                         "novelty kill/clone at chunk boundaries)")
+    sp.add_argument("--hunt", action="store_true",
+                    help="sim jobs: continuous hunt — run until "
+                         "cancelled/preempted, collecting deduped "
+                         "violations")
     sp.add_argument("--stub", action="store_true",
                     help="run the inline counter spec on the stub "
                          "kernel (tier-1 smoke path, no reference "
@@ -138,14 +159,30 @@ def cmd_submit(args):
         print(f"submit: {e}", file=sys.stderr)
         return EX_USAGE
     q = _queue(args)
-    for k in ("maxstates", "maxseconds", "pipeline", "inject"):
+    for k in ("maxstates", "maxseconds", "pipeline", "inject",
+              "walkers", "depth", "num", "seed"):
         v = getattr(args, k)
         if v is not None:
             flags[k] = v
     if args.stub:
         flags["stub"] = True
+    if args.split:
+        flags["split"] = True
+    if args.hunt:
+        flags["hunt"] = True
+    kind = "sim" if args.sim else "check"
+    if not args.sim and (args.split or args.hunt
+                         or args.walkers is not None
+                         or args.depth is not None
+                         or args.num is not None
+                         or args.seed is not None):
+        print("submit: --walkers/--depth/--num/--seed/--split/--hunt "
+              "need --sim (they describe a walker-fleet job; check "
+              "jobs take --maxstates/--maxseconds)", file=sys.stderr)
+        return EX_USAGE
     job = q.submit(args.spec or "<stub:ObsCounter>",
-                   cfg=args.config, engine=args.engine, flags=flags,
+                   cfg=args.config, engine=args.engine, kind=kind,
+                   flags=flags,
                    priority=args.priority, devices=args.devices,
                    devices_min=args.devices_min,
                    devices_max=args.devices_max)
@@ -155,6 +192,38 @@ def cmd_submit(args):
         print(f"submitted {job.job_id} ({job.spec}, engine "
               f"{job.engine}, priority {job.priority})")
     return 0
+
+
+def _sim_progress(journal_path):
+    """Sim-specific per-job progress folded from the journal: the
+    latest chunk's walks/steps/depth, best novelty, and the unique
+    violation count — the fleet's analog of the BFS level rows
+    (ISSUE 7 satellite)."""
+    out = {"walks": 0, "steps": 0, "depth": 0, "novelty_best": None,
+           "unique_violations": 0, "walkers": None}
+    try:
+        with open(journal_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                e = ev.get("event")
+                if e == "sim_chunk":
+                    out["walks"] = ev.get("walks", out["walks"])
+                    out["steps"] = ev.get("steps", out["steps"])
+                    out["depth"] = ev.get("depth", out["depth"])
+                elif e == "split" and ev.get("novelty_best") \
+                        is not None:
+                    out["novelty_best"] = ev["novelty_best"]
+                elif e == "hunt_violation":
+                    out["unique_violations"] += 1
+                elif e == "hunt_elastic":
+                    out["walkers"] = ev.get("to", out["walkers"])
+    except OSError:
+        return None
+    return out if (out["walks"] or out["steps"]
+                   or out["unique_violations"]) else None
 
 
 def cmd_status(args):
@@ -170,6 +239,8 @@ def cmd_status(args):
         mp = q.metrics_path(job.job_id)
         doc["journal"] = jp if os.path.exists(jp) else None
         doc["metrics"] = mp if os.path.exists(mp) else None
+        if job.kind == "sim" and os.path.exists(jp):
+            doc["sim"] = _sim_progress(jp)
         tail = []
         if args.tail and os.path.exists(jp):
             with open(jp) as f:
@@ -182,14 +253,23 @@ def cmd_status(args):
         if args.json:
             print(json.dumps(doc, default=str))
         else:
-            for k in ("job_id", "state", "spec", "engine", "priority",
-                      "devices", "attempts", "reason"):
+            for k in ("job_id", "state", "kind", "spec", "engine",
+                      "priority", "devices", "attempts", "reason"):
                 print(f"{k}: {doc.get(k)}")
             if doc.get("rescue"):
                 print(f"rescue: {doc['rescue']}")
+            if doc.get("sim"):
+                s = doc["sim"]
+                print(f"sim: {s['walks']} walks, {s['steps']} steps, "
+                      f"depth {s['depth']}, "
+                      f"{s['unique_violations']} unique violation(s)"
+                      + (f", best novelty {s['novelty_best']}"
+                         if s["novelty_best"] is not None else ""))
             if doc.get("result"):
                 r = {k: v for k, v in doc["result"].items()
-                     if k != "trace"}
+                     if k not in ("trace", "violations")}
+                if doc["result"].get("violations") is not None:
+                    r["violations"] = len(doc["result"]["violations"])
                 print(f"result: {json.dumps(r, default=str)}")
             for ev in tail:
                 print(f"  {ev.get('event')}: "

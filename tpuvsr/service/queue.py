@@ -80,7 +80,7 @@ class Job:
     spec: str
     cfg: str = None
     engine: str = "auto"
-    kind: str = "check"          # "check" (engine run) | "shell" (argv)
+    kind: str = "check"   # "check" (BFS) | "sim" (fleet hunt) | "shell"
     flags: dict = field(default_factory=dict)
     priority: int = 0
     devices: int = 1
@@ -97,8 +97,12 @@ class Job:
 
     @property
     def elastic(self):
-        """True when the scheduler may reshape this job's mesh."""
-        return (self.engine == "sharded"
+        """True when the scheduler may reshape this job's device
+        allocation: sharded BFS jobs (mesh reshaped through the PR 5
+        reshard-on-load resume) and fleet-sim jobs (walker fleet
+        resumed on the new mesh; walker count rescales at the next
+        round boundary, ISSUE 7)."""
+        return ((self.engine == "sharded" or self.kind == "sim")
                 and (self.devices_min is not None
                      or self.devices_max is not None))
 

@@ -149,9 +149,13 @@ class Scheduler:
             new = cur
             if j.devices > self.pool.total - cur and running.elastic:
                 lo, hi = self._bounds(running)
-                new = _clamp(
-                    pow2_floor(max(1, self.pool.total - j.devices)),
-                    lo, hi)
+                room = max(1, self.pool.total - j.devices)
+                # the power-of-two clamp is the SHARDED mesh-shrink
+                # contract; a walker fleet (kind="sim") runs on any
+                # device count — don't strand devices it could use
+                if running.engine == "sharded":
+                    room = pow2_floor(room)
+                new = _clamp(room, lo, hi)
             if new < cur:
                 return Decision("shrink", new,
                                 f"make room for {j.job_id} "
@@ -167,8 +171,10 @@ class Scheduler:
             # priority before taking the rest of the pool
             reserved = sum(j.devices for j in waiting
                            if j.priority >= running.priority)
-            target = _clamp(pow2_floor(max(1, self.pool.total
-                                           - reserved)), lo, hi)
+            room = max(1, self.pool.total - reserved)
+            if running.engine == "sharded":
+                room = pow2_floor(room)
+            target = _clamp(room, lo, hi)
             if cur < requested and target > cur:
                 return Decision("grow", target,
                                 f"devices freed up ({cur} -> {target})")

@@ -49,18 +49,9 @@ from ..obs import Journal, RunObserver
 from .scheduler import DevicePool, Scheduler, advise_backend
 
 
-def trace_to_jsonable(trace):
-    """Serialize a violation trace for the job-result record — the
-    stable form the service's bit-identity checks compare (two runs
-    are equivalent iff these lists are equal)."""
-    from ..core.values import fmt
-    out = []
-    for e in trace:
-        out.append({"position": int(e.position),
-                    "action": e.action_name,
-                    "state": {k: fmt(v)
-                              for k, v in sorted(e.state.items())}})
-    return out
+# the ONE trace serializer (engine/trace.py), re-exported under the
+# name the service's callers and tests already use
+from ..engine.trace import trace_to_jsonable  # noqa: E402,F401
 
 
 def result_summary(res):
@@ -91,6 +82,14 @@ class JobObserver(RunObserver):
 
     def level_done(self, depth, **kw):
         super().level_done(depth, **kw)
+        if self._tick is not None:
+            self._tick(int(depth))
+
+    def sim_chunk(self, depth, **kw):
+        # fleet chunk boundaries are the sim analog of level
+        # boundaries (ISSUE 7): the same tick drives cancel and
+        # elastic rebalance for kind="sim" jobs
+        super().sim_chunk(depth, **kw)
         if self._tick is not None:
             self._tick(int(depth))
 
@@ -259,6 +258,8 @@ class Worker:
         try:
             if job.kind == "shell":
                 return self._run_shell(job)
+            if job.kind == "sim":
+                return self._run_sim(job)
             return self._run_check(job)
         finally:
             self.pool.release(job.job_id)
@@ -339,6 +340,11 @@ class Worker:
             if injected:
                 faults.clear()
 
+        self._settle(job, out, result_summary)
+
+    def _settle(self, job, out, summarize):
+        """Map a run :class:`Outcome` onto the queue — shared by the
+        check and sim paths."""
         if out.state == "preempted-requeued":
             if self._cancelled:
                 self._finish(job, "cancelled", reason="cancelled",
@@ -360,18 +366,105 @@ class Worker:
             # loop would instantly re-claim the job and `serve` could
             # never be stopped gracefully
             sig = (out.rescue or {}).get("signal")
-            simulated = "kill" in str(flags.get("inject") or "")
+            simulated = "kill" in str(job.flags.get("inject") or "")
             if sig in ("SIGTERM", "SIGINT") and not self._preempt_sent \
                     and not simulated:
                 self._shutdown = True
                 self.log(f"{sig} received: job requeued; stopping the "
                          f"drain loop (rerun `serve` to resume)")
             return
-        result = (result_summary(out.result)
+        result = (summarize(out.result)
                   if out.result is not None else None)
         if result is not None:
             result["supervisor"] = out.summary
         self._finish(job, out.state, result=result, reason=out.error)
+
+    # -- sim jobs (the fleet defect hunt, ISSUE 7) ---------------------
+    def _run_sim(self, job):
+        """``kind="sim"``: a walker-fleet defect hunt (tpuvsr/sim) run
+        through ``run_hunt_job`` — the hunt twin of the supervised
+        check path.  Fleet chunk boundaries tick the scheduler exactly
+        like BFS level boundaries, so cancel and elastic shrink/grow
+        ride the ordinary preempt-requeue machinery; the rescue is the
+        walker-frontier snapshot and a resumed hunt replays
+        bit-identically."""
+        from ..resilience import faults
+        from ..sim.hunt import run_hunt_job, sim_result_summary
+        spec = self._specs.get(job.job_id) or self._load_spec(job)
+        alloc = self.scheduler.alloc_for(job)
+        self.pool.alloc(job.job_id, alloc)
+        backend, why = advise_backend(job, tpu_devices=self.tpu_devices,
+                                      bench_dir=self.bench_dir)
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=alloc, backend=backend, placement=why)
+        flags = job.flags
+        injected = None
+        try:
+            factory = None
+            if flags.get("stub"):
+                from ..testing import stub_model_factory
+                factory = stub_model_factory(
+                    inv_bound=flags.get("inv_bound"),
+                    inv_x_bound=flags.get("inv_x_bound"))
+            split = flags.get("split")
+            if isinstance(split, dict):
+                from ..sim.splitting import NoveltySplitter
+                split = NoveltySplitter(**split)
+            else:
+                split = True if split else None
+
+            def observer_factory(**kw):
+                return JobObserver(
+                    tick=lambda depth: self._tick(job, depth), **kw)
+
+            injected = flags.get("inject")
+            if injected:
+                faults.install(injected)
+            # zero/negative values must fail the job, not silently
+            # become the defaults (the CLI rejects -walkers 0 with
+            # exit 2; the service matches by failing at setup)
+            walkers = flags.get("walkers")
+            walkers = 512 if walkers is None else int(walkers)
+            if flags.get("walkers_per_device"):
+                # walker-count elasticity: the fleet size follows the
+                # device allocation (applied at round boundaries; a
+                # mid-round resume finishes the round at the rescue's
+                # count first — the determinism contract)
+                walkers = max(1, int(flags["walkers_per_device"])
+                              * alloc)
+            depth = flags.get("depth")
+            depth = 100 if depth is None else int(depth)
+            num = flags.get("num")
+            if num is None and not flags.get("maxseconds") \
+                    and not flags.get("max_violations") \
+                    and not flags.get("hunt"):
+                # bounded default so an unparameterized job drains;
+                # flags {"hunt": true} opts into the continuous mode
+                # (runs until cancelled/preempted)
+                num = 10000
+            out = run_hunt_job(
+                spec,
+                checkpoint_path=self.queue.checkpoint_path(job.job_id),
+                journal_path=self.queue.journal_path(job.job_id),
+                metrics_path=self.queue.metrics_path(job.job_id),
+                log=self._log, observer_factory=observer_factory,
+                model_factory=factory, walkers=walkers,
+                n_devices=alloc, depth=depth,
+                seed=int(flags.get("seed") or 0), num=num,
+                max_seconds=flags.get("maxseconds"),
+                max_violations=flags.get("max_violations"),
+                split=split,
+                chunk_steps=int(flags.get("chunk_steps") or 16),
+                pipeline=int(flags.get("pipeline") or 2),
+                resume_from=(job.rescue or {}).get("path"))
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self._finish(job, "failed",
+                         reason=f"job-setup: {type(e).__name__}: {e}")
+            return
+        finally:
+            if injected:
+                faults.clear()
+        self._settle(job, out, sim_result_summary)
 
     # -- shell jobs (the absorbed tpu_queue workload driver) -----------
     def _run_shell(self, job):

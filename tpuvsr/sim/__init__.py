@@ -1,0 +1,41 @@
+"""tpuvsr.sim — sharded walker-fleet simulation (ISSUE 7 tentpole).
+
+The fleet supersedes the scan-loop in ``engine/device_sim.py`` as the
+simulation backend (ROADMAP item 2): 10^5+ concurrent walkers vmapped
+over the per-walker step and shard_mapped across a 1-D device mesh,
+running fused multi-step chunks between host syncs behind the
+``engine/pipeline.py`` dispatch window.
+
+Three modules:
+
+* **fleet.py** — :class:`FleetSimulator`: the sharded fleet itself,
+  with the seed-reproducibility contract (walk ``i`` is a pure
+  function of ``(seed, i)`` — any walker count, mesh shape, or
+  rescue/resume seam replays the identical violation trace from one
+  seed), rescue snapshots of the walker frontier, and an OOM
+  walker-shrink degrade ladder;
+* **splitting.py** — importance splitting: walkers carry a
+  fingerprint-novelty score (``engine/fpset.py`` as the seen-set);
+  low-novelty walkers are periodically killed and respawned by cloning
+  high-novelty ones, so deep defects like the state-transfer data
+  loss fall out in minutes instead of hours;
+* **hunt.py** — the continuous defect-hunt service mode: run rounds
+  forever, dedup identical violations fleet-wide, replay each unique
+  one to a TRACE-format counterexample, and host it all as a
+  ``kind="sim"`` job under ``tpuvsr/service`` (speclint admission,
+  elastic shrink/grow, SIGTERM/exit-75 resume).
+"""
+
+from __future__ import annotations
+
+from .fleet import (FleetSimulator, fleet_simulate, fleet_snapshot_info,
+                    load_fleet_snapshot, save_fleet_snapshot)
+from .hunt import run_hunt, run_hunt_job, sim_result_summary
+from .splitting import NoveltySplitter
+
+__all__ = [
+    "FleetSimulator", "fleet_simulate", "NoveltySplitter",
+    "run_hunt", "run_hunt_job", "sim_result_summary",
+    "save_fleet_snapshot", "load_fleet_snapshot",
+    "fleet_snapshot_info",
+]

@@ -1,0 +1,302 @@
+"""Continuous defect-hunt mode over the walker fleet (ISSUE 7).
+
+Where ``FleetSimulator.run`` is the TLC simulator (stop at the first
+violation), the hunt is the production service workload: run rounds
+indefinitely, collect EVERY violation the fleet trips over, dedup
+identical ones fleet-wide (two walkers that found the same
+counterexample — same invariant, same action/param sequence — count
+once), and replay each unique one into a TRACE-format counterexample.
+The hunt is the ``kind="sim"`` job the dispatch service schedules:
+``run_hunt_job`` mirrors ``resilience.run_supervised`` — it reifies
+every ending as an ``Outcome`` (done / violated / failed /
+preempted-requeued with the walker-frontier rescue attached) so one
+worker process can host many hunts, and the ``on_chunk`` tick gives
+the scheduler its level-boundary analog (cancel and elastic
+shrink/grow land at chunk boundaries).
+
+Elasticity is walker-count elasticity: a resume whose snapshot holds a
+different walker count finishes the in-flight round at the snapshot's
+count (preserving the determinism contract), then reshapes to the new
+target at the round boundary — journaled as a ``hunt_elastic`` event.
+An ``elastic(round_idx) -> walkers | None`` hook reshapes a live hunt
+the same way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import numpy as np
+
+import jax
+
+from ..engine.simulate import SimResult
+from ..exitcodes import (EX_OK, EX_RESUMABLE, EX_SOFTWARE,
+                         EX_VIOLATION, job_state)
+from ..obs import RunObserver
+from ..resilience.supervisor import (Outcome, Preempted,
+                                     PreemptionGuard)
+from .fleet import FleetSimulator
+
+
+# the ONE trace serializer (engine/trace.py) — hunt records and
+# service job results must compare byte-for-byte
+from ..engine.trace import trace_to_jsonable as trace_json  # noqa: E402
+
+
+def _dedup_key(hists, slot, n_steps):
+    """Fleet-level violation identity: sha1 of the violating walk's
+    (action, param) sequence up to its first violating step.  The
+    sequence alone IS the identity — replay is deterministic, so an
+    identical sequence reaches the identical violating state (and the
+    identical confirmed invariant).  Computed from the recorded
+    history columns BEFORE replay, so duplicates cost no replay."""
+    aids = np.concatenate([np.asarray(ha)[:, slot]
+                           for ha, _hp in hists])[:n_steps]
+    prms = np.concatenate([np.asarray(hp)[:, slot]
+                           for _ha, hp in hists])[:n_steps]
+    h = hashlib.sha1()
+    h.update(aids.astype(np.int32).tobytes())
+    h.update(prms.astype(np.int32).tobytes())
+    return h.hexdigest()[:16]
+
+
+def sim_result_summary(res):
+    """SimResult -> the JSON-able summary stored on a sim job."""
+    out = {"ok": bool(res.ok), "walks": int(res.walks),
+           "steps": int(res.steps), "deadlocks": int(res.deadlocks),
+           "walkers": int(res.walkers or 0),
+           "violated": res.violated_invariant,
+           "violations": res.violations or [],
+           "elapsed_s": round(float(res.elapsed or 0.0), 3)}
+    # the headline trace is the FIRST unique violation of the whole
+    # hunt (violations survive a rescue/resume seam inside the
+    # snapshot; res.trace only holds the first one found THIS attempt)
+    if res.violations:
+        out["trace"] = res.violations[0]["trace"]
+        out["violated"] = res.violations[0]["name"]
+    elif res.trace:
+        out["trace"] = trace_json(res.trace)
+    return out
+
+
+def run_hunt(spec, *, walkers=4096, depth=100, seed=0, num=None,
+             max_seconds=None, max_violations=None, split=None,
+             action_weights=None, swarm_sigma=0.0, chunk_steps=16,
+             pipeline=2, n_devices=None, mesh=None, max_msgs=None,
+             model_factory=None, checkpoint_path=None,
+             resume_from=None, obs=None, log=None, on_chunk=None,
+             elastic=None, min_walkers=64, sim=None) -> SimResult:
+    """Drive a defect hunt; returns a :class:`SimResult` whose
+    ``violations`` list holds one record per UNIQUE violation
+    (``{name, walk, depth, dedup, trace}``), with ``trace`` already in
+    the service's JSON trace form.  ``res.trace`` keeps the first
+    unique violation as TraceEntry objects for CLI formatting.
+
+    Stops when ``num`` walks completed, ``max_violations`` unique
+    violations collected, or ``max_seconds`` elapsed — whichever comes
+    first (a hunt with none of the three runs until preempted)."""
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1 (got {depth})")
+    sim = sim or FleetSimulator(
+        spec, walkers=walkers, n_devices=n_devices, mesh=mesh,
+        chunk_steps=chunk_steps, max_msgs=max_msgs,
+        action_weights=action_weights, swarm_sigma=swarm_sigma,
+        split=split, pipeline=pipeline, min_walkers=min_walkers,
+        model_factory=model_factory, log=log)
+    target_walkers = sim.walkers
+    obs = RunObserver.ensure(obs, "fleet-hunt", spec, log=log)
+    sim._obs_active = obs
+    res = SimResult()
+    res.violations = []
+    res.walkers = sim.walkers
+    dedup = set()
+    t0 = time.time()
+    resume = None
+    base = 0
+    round_active = None
+    chunks = 0
+    round_start = 0
+    if resume_from:
+        manifest, resume = sim._load_resume(resume_from)
+        base = int(manifest["base"])
+        res.walks = int(manifest["walks"])
+        res.steps = int(manifest["steps"])
+        res.deadlocks = int(manifest.get("deadlocks", 0))
+        round_active = int(manifest["active"])
+        chunks = int(manifest.get("chunks", 0))
+        t0 -= float(manifest["elapsed"])
+        extra = manifest.get("extra") or {}
+        res.violations = list(extra.get("violations") or [])
+        dedup = set(extra.get("dedup") or [])
+        round_start = int(extra.get("round_idx") or 0)
+    obs.start(t0, backend=jax.default_backend(),
+              resumed=resume_from is not None)
+    obs.gauge("walkers", sim.walkers)
+    obs.gauge("mesh_devices", sim.D)
+    obs.gauge("pipeline_depth", sim.pipeline)
+    bad0 = spec.check_invariants(next(iter(spec.init_states())))
+    if bad0:
+        res.ok = False
+        res.violated_invariant = bad0
+        return obs.finish(res)
+    key = jax.random.PRNGKey(seed)
+    deadline = (t0 + max_seconds) if max_seconds else None
+    retries = 0
+    # round numbering survives a rescue/resume so elastic(round_idx)
+    # schedules don't restart from 0 after a preemption
+    round_idx = round_start
+    try:
+        while True:
+            if num is not None and res.walks >= num:
+                break
+            if max_violations is not None \
+                    and len(res.violations) >= max_violations:
+                break
+            if deadline is not None and time.time() > deadline:
+                break
+            active = (round_active if round_active is not None else
+                      (min(sim.walkers, num - res.walks)
+                       if num is not None else sim.walkers))
+            round_active = None
+            try:
+                (violated, dead, hists, init_states, steps,
+                 completed, chunks) = sim.run_round(
+                    base=base, active=active, depth=depth, key=key,
+                    obs=obs, deadline=deadline, on_chunk=on_chunk,
+                    checkpoint_path=checkpoint_path,
+                    rescue_extra={
+                        "violations": res.violations,
+                        "dedup": sorted(dedup),
+                        "round_idx": round_idx,
+                        "seed": seed, "depth": depth, "num": num},
+                    resume=resume, steps_before=res.steps,
+                    chunks_before=chunks,
+                    deadlocks_before=res.deadlocks)
+            except Exception as e:  # noqa: BLE001 — fleet OOM ladder
+                resume = None
+                if not sim.try_degrade_oom(e, retries, obs):
+                    raise
+                retries += 1
+                # the degraded count IS the new target — regrowing at
+                # the next round boundary would just re-trip the OOM
+                target_walkers = sim.walkers
+                continue
+            resume = None
+            res.steps += steps
+            res.deadlocks += int((dead >= 0).sum())
+            for slot in np.nonzero(violated[:active] >= 0)[0]:
+                n = int(violated[slot])
+                kd = _dedup_key(hists, slot, n)
+                if kd in dedup:
+                    obs.count("hunt_duplicates")
+                    continue
+                trace = sim.replay(
+                    {k: v[slot] for k, v in init_states.items()},
+                    hists, int(slot), n)
+                confirmed = spec.check_invariants(trace[-1].state)
+                if confirmed is None:
+                    from ..core.values import TLAError
+                    err = TLAError(
+                        "device/interpreter divergence: the fleet "
+                        "invariant kernel reported a violation at "
+                        f"walk {base + int(slot)} step {n}, but the "
+                        "interpreter accepts the replayed state")
+                    err.trace = trace
+                    raise err
+                dedup.add(kd)
+                rec = {"name": confirmed, "walk": int(base + slot),
+                       "depth": n, "dedup": kd,
+                       "trace": trace_json(trace)}
+                res.violations.append(rec)
+                obs.hunt_violation(confirmed, int(base + slot), n,
+                                   dedup=kd)
+                if not res.trace:
+                    res.trace = trace
+                    res.violated_invariant = confirmed
+                if max_violations is not None \
+                        and len(res.violations) >= max_violations:
+                    break
+            if not completed:
+                # deadline-cut round: violations found up to the
+                # committed depth are real and kept, but the round's
+                # walks did not complete — walks/s stays honest
+                break
+            res.walks += active
+            base += active
+            round_idx += 1
+            obs.progress(walks=res.walks, steps=res.steps,
+                         extra=(f"{len(res.violations)} unique "
+                                f"violation(s)"
+                                if res.violations else None))
+            # walker-count elasticity, applied at the round boundary
+            # (rounds restart from init states, so reshaping is free)
+            target = elastic(round_idx) if elastic is not None \
+                else target_walkers
+            if target and int(target) != sim.walkers:
+                old = sim.walkers
+                sim._set_walkers(int(target))
+                target_walkers = sim.walkers
+                obs.hunt_elastic(old, sim.walkers)
+                obs.gauge("walkers", sim.walkers)
+                obs.gauge("mesh_devices", sim.D)
+                if log:
+                    log(f"hunt: fleet reshaped {old} -> "
+                        f"{sim.walkers} walkers")
+    except BaseException:
+        # the crash contract: finalize instrumentation (valid journal
+        # prefix, no run_end) on ANY escaping exception — Preempted
+        # included, whose rescue_checkpoint event is already journaled
+        sim._obs_active = None
+        obs.close()
+        raise
+    res.ok = not res.violations
+    res.walkers = sim.walkers
+    if res.violations and res.violated_invariant is None:
+        res.violated_invariant = res.violations[0]["name"]
+    obs.gauge("hunt_unique_violations", len(res.violations))
+    return obs.finish(res)
+
+
+def run_hunt_job(spec, *, checkpoint_path=None, journal_path=None,
+                 metrics_path=None, log=None, observer_factory=None,
+                 run_kwargs=None, **hunt_kwargs) -> Outcome:
+    """The worker-process entry for ``kind="sim"`` jobs — the hunt
+    twin of ``resilience.run_supervised``: run a hunt under a
+    PreemptionGuard and reify every ending as an :class:`Outcome`
+    through the one exit-code table (``tpuvsr/exitcodes.py``):
+
+    * hunt finished, no violations  -> ``done`` (EX_OK)
+    * unique violations collected   -> ``violated`` (EX_VIOLATION)
+    * SIGTERM/cancel/scheduler tick -> ``preempted-requeued``
+      (EX_RESUMABLE) with the walker-frontier rescue attached
+    * anything else                 -> ``failed`` (EX_SOFTWARE)
+    """
+    factory = observer_factory or RunObserver
+    obs = factory(journal_path=journal_path, metrics_path=metrics_path,
+                  log=log)
+    kwargs = dict(hunt_kwargs)
+    kwargs.update(run_kwargs or {})
+    summary = {"engine": "fleet-hunt",
+               "walkers": kwargs.get("walkers")}
+    try:
+        with PreemptionGuard(log=log):
+            res = run_hunt(spec, checkpoint_path=checkpoint_path,
+                           obs=obs, log=log, **kwargs)
+    except Preempted as p:
+        return Outcome(
+            state=job_state(EX_RESUMABLE), exit_code=EX_RESUMABLE,
+            rescue={"path": p.path, "depth": p.depth,
+                    "distinct": p.distinct, "signal": p.signal},
+            summary=summary)
+    except Exception as e:  # noqa: BLE001 — reified, not swallowed
+        return Outcome(state=job_state(EX_SOFTWARE),
+                       exit_code=EX_SOFTWARE,
+                       error=f"{type(e).__name__}: {e}",
+                       summary=summary)
+    summary["walkers"] = res.walkers
+    summary["violations"] = len(res.violations or [])
+    code = EX_OK if res.ok else EX_VIOLATION
+    return Outcome(state=job_state(code), exit_code=code, result=res,
+                   summary=summary)

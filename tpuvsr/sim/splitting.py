@@ -1,0 +1,170 @@
+"""Importance splitting for the walker fleet (ISSUE 7).
+
+Classic multilevel splitting, restated for a fingerprint-novelty
+score: at chunk boundaries each walker's current state is
+fingerprinted and batch-inserted into a device-resident seen-set
+(``engine/fpset.py`` — the TLC FPSet reused as a novelty filter).  A
+walker that landed on a never-seen state earns novelty; one that
+landed somewhere the fleet has already been decays toward zero.  The
+lowest-scoring fraction of the live population is then killed and the
+slots respawned as clones of the highest-scoring walkers — clones
+inherit their parent's full recorded history AND its init state, so a
+violating clone still replays into a complete TRACE-format
+counterexample.  ``kern.hunt_score`` (when the kernel has one, e.g.
+the VSR state-transfer distance score) can be blended in with
+``hunt_beta`` as a domain-guided second term; the fleet's
+``action_weights`` bias is the other knob.
+
+Determinism: the kill/clone selection is a pure sort over
+``(score, slot)`` — no RNG — and the scores are computed from
+per-walker elementwise device ops plus host float arithmetic, so a
+guided run is bit-identical across mesh sizes and across a
+rescue/resume seam for a fixed (seed, walkers).  (Walker-count
+independence is deliberately traded away: the novelty score depends on
+what the whole fleet has seen.)
+
+The seen-set doubles as the hunt's novelty telemetry: the
+``split_efficiency`` gauge is the fraction of inserted fingerprints
+that were fresh (how much new territory each chunk buys), and
+``novelty_best`` tracks the best-scoring walker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import fpset
+
+
+class NoveltySplitter:
+    """Kill-and-clone resampler over a fingerprint-novelty score.
+
+    ``frac``: fraction of the live population killed per split (the
+    same count is cloned from the top); ``every``: split at every
+    N-th chunk boundary; ``decay``: novelty EMA decay per boundary;
+    ``hunt_beta``: weight of ``kern.hunt_score`` blended into the
+    score (0 = pure novelty); ``capacity``: initial seen-set slots
+    (power of two; grows on overflow)."""
+
+    def __init__(self, frac=0.25, every=1, decay=0.5, hunt_beta=0.0,
+                 capacity=1 << 14):
+        self.frac = float(frac)
+        self.every = max(1, int(every))
+        self.decay = float(decay)
+        self.hunt_beta = float(hunt_beta)
+        self.capacity = int(capacity)
+        self.table = None
+        self.novelty = None          # host float64 [W_pad]
+        self.fresh_total = 0
+        self.inserted_total = 0
+        self.best = 0.0
+        self._fp = None
+        self._score = None
+
+    def bind(self, kern):
+        """(Re)bind the kernel-derived jits after a fleet rebuild."""
+        self._fp = jax.jit(kern.fingerprint_batch)
+        self._score = None
+        if self.hunt_beta > 0.0 and hasattr(kern, "hunt_score"):
+            self._score = jax.jit(jax.vmap(kern.hunt_score))
+
+    def due(self, chunk_idx):
+        return chunk_idx % self.every == 0
+
+    def reset(self, w_pad):
+        """Round start: novelty zeroes; the seen-set persists (novelty
+        is relative to everything the fleet has EVER seen — that is
+        what pushes rounds outward)."""
+        self.novelty = np.zeros((w_pad,), np.float64)
+        if self.table is None:
+            self.table = fpset.empty_table(self.capacity)
+
+    # -- snapshot support ---------------------------------------------
+    def state_manifest(self):
+        return {"fresh_total": int(self.fresh_total),
+                "inserted_total": int(self.inserted_total),
+                "best": float(self.best),
+                "frac": self.frac, "every": self.every,
+                "decay": self.decay, "hunt_beta": self.hunt_beta}
+
+    def state_arrays(self):
+        return {"slots": np.asarray(self.table["slots"]),
+                "novelty": self.novelty}
+
+    def load_state(self, state):
+        self.fresh_total = int(state.get("fresh_total", 0))
+        self.inserted_total = int(state.get("inserted_total", 0))
+        self.best = float(state.get("best", 0.0))
+        self.table = {"slots": jnp.asarray(state["slots"])}
+        self.novelty = np.asarray(state["novelty"], np.float64).copy()
+
+    # -- the split ----------------------------------------------------
+    def resample(self, states, alive, violated_at, dead_at, hists,
+                 init_states, obs=None):
+        """Observe the population, update novelty, kill/clone.
+
+        Returns ``(states, alive, hists, init_states)`` with the
+        killed slots overwritten by clones.  Slots carrying an event
+        (violated/dead) are never killed and never cloned from — their
+        recorded histories are the evidence the round will replay."""
+        w_pad = self.novelty.shape[0]
+        # fingerprint + insert on the gathered batch (pulled to the
+        # default device: one deterministic scatter order, so the
+        # fresh verdicts are mesh-shape independent); only LIVE
+        # walkers insert — pad and frozen slots would otherwise inject
+        # mesh-dependent duplicate lanes into the claim race
+        alive_h = np.asarray(jax.device_get(alive))
+        fps = jnp.asarray(np.asarray(jax.device_get(
+            self._fp(states))))
+        mask = jnp.asarray(alive_h)
+        while True:
+            table, fresh, ovf = fpset.insert_core(self.table, fps,
+                                                  mask)
+            if not bool(ovf):
+                self.table = table
+                break
+            self.table = fpset.grow(self.table)
+            if obs is not None:
+                obs.grow("fpset", int(self.table["slots"].shape[0]))
+        fresh = np.asarray(jax.device_get(fresh))
+        self.fresh_total += int(fresh[alive_h].sum())
+        self.inserted_total += int(alive_h.sum())
+        self.novelty = self.novelty * self.decay + fresh
+        score = self.novelty.copy()
+        if self._score is not None:
+            score += self.hunt_beta * np.asarray(
+                jax.device_get(self._score(states)), np.float64)
+        eligible = alive_h          # frozen walkers keep their slots
+        n_el = int(eligible.sum())
+        k = min(int(self.frac * n_el), n_el // 2)
+        self.best = max(self.best,
+                        float(score[eligible].max()) if n_el else 0.0)
+        if obs is not None:
+            eff = (self.fresh_total / self.inserted_total
+                   if self.inserted_total else 0.0)
+            obs.gauge("novelty_best", round(self.best, 4))
+            obs.gauge("split_efficiency", round(eff, 4))
+        if k < 1 or n_el < 2:
+            if obs is not None:
+                obs.split(killed=0, novelty_best=round(self.best, 4))
+            return states, alive, hists, init_states
+        slots = np.nonzero(eligible)[0]
+        order = slots[np.lexsort((slots, score[slots]))]
+        kills = order[:k]
+        sources = order[-k:][::-1]   # best walker seeds the worst slot
+        sel = np.arange(w_pad)
+        sel[kills] = sources
+        self.novelty[kills] = self.novelty[sources]
+        sel_j = jnp.asarray(sel, jnp.int32)
+        states = {key: v[sel_j] for key, v in states.items()}
+        alive2 = jnp.asarray(alive)[sel_j]
+        hists = [(jnp.asarray(ha)[:, sel_j], jnp.asarray(hp)[:, sel_j])
+                 for ha, hp in hists]
+        init_states = {key: np.asarray(v)[sel]
+                       for key, v in init_states.items()}
+        if obs is not None:
+            obs.split(killed=int(k), novelty_best=round(self.best, 4))
+        return states, alive2, hists, init_states

@@ -165,6 +165,12 @@ def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
                 return lambda st: jnp.asarray(True)
             return lambda st: st["x"] + st["y"] <= inv_bound
 
+        def hunt_score(self, st):
+            # guided-simulation fixture: deeper x = closer to the
+            # tightened inv_x_bound violation (mirrors the VSR
+            # kernel's state-transfer distance score)
+            return jnp.asarray(st["x"], jnp.float32)
+
     return lambda spec, max_msgs=None: (StubCodec(), StubKern())
 
 
@@ -216,6 +222,22 @@ def stub_sharded_engine(n_devices=2, spec=None, inv_x_bound=None,
         tile=kw.pop("tile", 4), bucket_cap=kw.pop("bucket_cap", 64),
         next_capacity=kw.pop("next_capacity", 1 << 6),
         fpset_capacity=kw.pop("fpset_capacity", 1 << 8), **kw)
+
+
+def stub_fleet(spec=None, inv_bound=None, inv_x_bound=None,
+               walkers=64, n_devices=1, **kw):
+    """A small walker fleet (tpuvsr/sim) over the counter spec and the
+    stub kernel — the tier-1 harness for fleet determinism, splitting,
+    rescue/resume and hunt tests (ISSUE 7)."""
+    from .sim.fleet import FleetSimulator
+    return FleetSimulator(
+        spec or counter_spec(inv_bound=inv_bound,
+                             inv_x_bound=inv_x_bound),
+        walkers=walkers, n_devices=n_devices,
+        model_factory=stub_model_factory(inv_bound=inv_bound,
+                                         inv_x_bound=inv_x_bound),
+        chunk_steps=kw.pop("chunk_steps", 4),
+        min_walkers=kw.pop("min_walkers", 8), **kw)
 
 
 def bad_counter_spec():
