@@ -40,6 +40,7 @@ DEADLINE = T0 + 0.92 * BUDGET_S
 # bench_capture.py imports this, keep it the single source of truth.
 ATTACHMENTS = (("defect_hunt", "hunt_result.json"),
                ("sim_scale", "sim_scale.json"),
+               ("validate_demo", "validate_demo.json"),
                ("defect_bfs_window", "defect_window.json"),
                ("hunt_ablation", "hunt_ablation.json"),
                ("liveness_speedup", "liveness_speedup.json"),
@@ -404,6 +405,17 @@ def main():
         RESULT["sim_walkers"] = sc.get("walkers")
         RESULT["sim_walks_per_s"] = sc.get("walks_per_s")
         RESULT["sim_split_enabled"] = bool(sc.get("split_enabled"))
+    # batched trace validation headline (ISSUE 8): traces/s, round
+    # size and divergence-localization health of the validate_demo
+    # drill lifted to the round-doc top level, so compare_bench's
+    # traces/s gate diffs rounds directly (cross-backend/batch drops
+    # are advisory)
+    vd = RESULT.get("validate_demo")
+    if isinstance(vd, dict) and vd.get("traces_per_s") is not None:
+        RESULT["validate_traces_per_s"] = vd.get("traces_per_s")
+        RESULT["validate_batch"] = vd.get("batch")
+        RESULT["validate_traces"] = vd.get("traces")
+        RESULT["validate_ok"] = bool(vd.get("ok"))
     hr = RESULT.get("defect_hunt")
     if isinstance(hr, dict) and hr.get("split_enabled") is not None:
         RESULT["hunt_split_enabled"] = bool(hr.get("split_enabled"))

@@ -129,6 +129,68 @@ def gate_sim(base_doc, cand_doc, max_regression):
     return 0
 
 
+def validate_stats(doc):
+    """Trace-validation throughput of a document (ISSUE 8):
+    ``(traces_per_s, batch, backend)`` or ``(None, None, None)``.
+    Reads the round doc's ``validate_demo`` attachment / top-level
+    ``validate_*`` keys, a raw ``validate_demo.json``, or a validator
+    metrics doc's ``gauges.traces_per_s``."""
+    if not isinstance(doc, dict):
+        return None, None, None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    vd = doc.get("validate_demo") \
+        if isinstance(doc.get("validate_demo"), dict) else None
+    if vd is None and "traces_per_s" in doc:
+        vd = doc
+    if vd is not None and vd.get("traces_per_s") is not None:
+        return (float(vd["traces_per_s"]), vd.get("batch"),
+                vd.get("backend"))
+    if doc.get("validate_traces_per_s") is not None:
+        return (float(doc["validate_traces_per_s"]),
+                doc.get("validate_batch"), doc.get("backend"))
+    m = find_metrics(doc)
+    if m is not None and "traces_per_s" in m.get("gauges", {}):
+        return (float(m["gauges"]["traces_per_s"]),
+                m["gauges"].get("validate_batch"), None)
+    return None, None, None
+
+
+def gate_validate(base_doc, cand_doc, max_regression):
+    """The traces/s regression gate (ISSUE 8): 0 ok/advisory/absent,
+    1 on a regression beyond tolerance on the SAME backend and batch
+    shape (a cross-backend or cross-batch drop measures a different
+    machine/configuration — advisory, like walks/s across fleet
+    sizes)."""
+    base, bb, bk = validate_stats(base_doc)
+    cand, cb, ck = validate_stats(cand_doc)
+    if base is None or cand is None:
+        return 0
+    print(f"traces_per_s: baseline {base:.1f} -> candidate "
+          f"{cand:.1f}  [{fmt_delta(base, cand)}]")
+    advisory = False
+    if bk is not None and ck is not None and \
+            str(bk).startswith("cpu") != str(ck).startswith("cpu"):
+        advisory = True
+        print(f"  backend: {bk} -> {ck} (different backends — "
+              f"comparison is advisory)")
+    if bb is not None and cb is not None and bb != cb:
+        advisory = True
+        print(f"  batch: {bb} -> {cb} (different round sizes — "
+              f"comparison is advisory)")
+    if base > 0 and cand < base * (1.0 - max_regression / 100.0):
+        if advisory:
+            print(f"compare_bench: traces/s drop beyond "
+                  f"{max_regression:.1f}% tolerance, but the "
+                  f"configurations differ — advisory, not a "
+                  f"regression", file=sys.stderr)
+            return 0
+        print(f"compare_bench: traces/s REGRESSION beyond "
+              f"{max_regression:.1f}% tolerance", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("baseline")
@@ -203,6 +265,12 @@ def main(argv=None):
     # simulation throughput rides the same gate (ISSUE 7): walks/s
     # regressions fail, cross-walker-count comparisons are advisory
     sim_rc = gate_sim(base_doc, cand_doc, args.max_regression)
+    # trace-validation throughput likewise (ISSUE 8): traces/s
+    # regressions fail, cross-backend/batch comparisons are advisory.
+    # Always evaluated (not short-circuited) so BOTH regressions are
+    # reported in one run
+    val_rc = gate_validate(base_doc, cand_doc, args.max_regression)
+    sim_rc = sim_rc or val_rc
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch:

@@ -51,6 +51,12 @@ reference mount, no TPU, seconds on the CPU backend:
                      snapshot + Preempted; the resumed hunt's deduped
                      violation set and headline trace are
                      bit-identical to an uninterrupted oracle hunt
+  kill-validate-resume  SIGTERM mid-batch on a kind="validate" job
+                     (ISSUE 8) -> candidate-frontier rescue at the
+                     committed chunk boundary, preempt-requeue through
+                     the queue, and the resumed attempt's divergence
+                     report is bit-identical to an undisturbed oracle
+                     job's
 
 Prints one JSON object; exit 0 iff every scenario passed.  Run by
 tests/test_resilience.py under tier-1 and standalone:
@@ -574,6 +580,48 @@ def scenario_kill_hunt_resume(tmp):
     }
 
 
+def scenario_kill_validate_resume(tmp):
+    """SIGTERM mid-batch on a ``kind="validate"`` job (ISSUE 8): the
+    batch validator rescues its committed candidate frontier at the
+    chunk boundary and raises Preempted; the worker maps that to
+    preempted-requeued, the next claim resumes from the rescue, and
+    the final divergence report (trace id, step, enabled set) is
+    bit-identical to an undisturbed oracle job's."""
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import stub_trace_records
+    from tpuvsr.validate.traces import save_traces
+    q = JobQueue(os.path.join(tmp, "spool"))
+    tp = os.path.join(tmp, "traces.jsonl")
+    save_traces(tp, stub_trace_records(n=64, depth=6, seed=5,
+                                       mutate=(40, 3)))
+    flags = {"stub": True, "traces": tp, "batch": 16,
+             "chunk_steps": 2}
+    oracle = q.submit("<stub:v-oracle>", kind="validate",
+                      flags=dict(flags))
+    kill = q.submit("<stub:v-kill>", kind="validate",
+                    flags=dict(flags, inject="kill@level=1"))
+    Worker(q, devices=2).drain()
+    jo, jk = q.get(oracle.job_id), q.get(kill.job_id)
+    if jo.state != "violated" or jk.state != "violated":
+        return {"ok": False, "oracle_state": jo.state,
+                "kill_state": jk.state,
+                "why": (jk.reason or jo.reason)}
+    ev = [e["event"] for e in read_journal(q.journal_path(jk.job_id))]
+    fd = jk.result["first_divergence"]
+    return {
+        "ok": (jk.attempts == 2
+               and jk.result["divergences"] == jo.result["divergences"]
+               and fd["trace"] == "t-0040" and fd["step"] == 3
+               and "rescue_checkpoint" in ev and "job_requeued" in ev
+               and "validate_chunk" in ev and "divergence" in ev),
+        "attempts": jk.attempts,
+        "divergences": len(jk.result["divergences"]),
+        "traces": jk.result["traces"],
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -589,6 +637,7 @@ SCENARIOS = [
     ("service-oom-degrade", scenario_service_oom_degrade),
     ("sim-oom-shrink", scenario_sim_oom_shrink),
     ("kill-hunt-resume", scenario_kill_hunt_resume),
+    ("kill-validate-resume", scenario_kill_validate_resume),
 ]
 
 

@@ -12,6 +12,25 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    runs on the sharded walker fleet (tpuvsr/sim) for
                    specs with a device kernel, the interpreter
                    otherwise
+  -validate FILE   trace-validation mode (tpuvsr/validate, ISSUE 8):
+                   check every recorded implementation trace in FILE
+                   (TRACE.jsonl — one JSON object per line, see the
+                   README "Trace validation" section) against the
+                   spec's next-state relation, partial observations
+                   tracked as candidate-state sets (arxiv 2404.16075).
+                   Runs batched on the device mesh for specs with a
+                   compiled kernel (traces vmapped + shard_mapped, the
+                   fleet idiom), through the interpreter otherwise (or
+                   under -engine interp/-fpset host).  Reports the
+                   first divergence per trace: event index, recorded
+                   event, the spec-side enabled action set at that
+                   point, and invariant metadata.  Divergence reports
+                   are bit-identical across mesh sizes, batch sizes
+                   and rescue/resume seams.  Exit 0 all accepted, 12
+                   divergences found, 75 preempted (rescue snapshot
+                   written to -checkpointdir; rerun with -recover)
+  -batch N         -validate: traces checked per round (default 1024;
+                   the OOM-degrade ladder halves it)
   -depth N         walk depth in simulation mode (default 100)
   -num N           number of walks (default 10000; TLC runs forever)
   -seed N          simulation RNG seed.  Fleet walks are a pure
@@ -119,7 +138,12 @@ whose rescue quantum makes fused snapshots possible); -fpset host with
 -simulate/-fused (the sharded engine has no fused fixpoint) or any
 non-auto -fpset (its fingerprint set is always the mesh-sharded HBM
 table); -walkers/-split/-hunt without -simulate, or with
--engine interp/-fpset host (the fleet is a device backend).
+-engine interp/-fpset host (the fleet is a device backend);
+-validate with -simulate/-hunt/-fused/-supervise/-deadlock/
+-maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
+is its own engine mode: rescue checkpoints are preemption-driven, the
+batch validator owns its mesh, and traces have no deadlock notion);
+-batch without -validate.
 
 Exit codes (the unified contract in tpuvsr/exitcodes.py): 0 ok;
 1 speclint errors (-lint); 2 bad flags; 12 safety/temporal violation
@@ -161,6 +185,17 @@ def build_parser():
     p.add_argument("-config", help=".cfg model file")
     p.add_argument("-workers", default="auto")
     p.add_argument("-simulate", action="store_true")
+    p.add_argument("-validate", default=None, metavar="TRACES.jsonl",
+                   help="validate recorded implementation traces "
+                        "(one JSON object per line) against the spec "
+                        "instead of checking/simulating: per step the "
+                        "next-state relation is constrained to "
+                        "transitions consistent with the recorded "
+                        "event; partial observations are tracked as "
+                        "candidate-state sets (tpuvsr/validate).  "
+                        "Exit 0 accepted / 12 diverged / 75 preempted")
+    p.add_argument("-batch", type=int, default=None, metavar="N",
+                   help="-validate: traces per round (default 1024)")
     p.add_argument("-depth", type=int, default=100)
     p.add_argument("-num", type=int, default=10000)
     p.add_argument("-seed", type=int, default=0)
@@ -296,6 +331,56 @@ def validate_args(parser, args):
         parser.error("-supervise needs the device/paged/sharded "
                      "engine (the interpreter has no "
                      "checkpoint/degrade ladder)")
+    if args.validate is not None:
+        # trace validation is its own engine mode (ISSUE 8): the
+        # check/simulate mode switches and their engine shapes don't
+        # compose with it — say so at parse time, not mid-run
+        if args.simulate:
+            parser.error("-validate checks recorded traces; it cannot "
+                         "be combined with -simulate (the two are "
+                         "different engine modes)")
+        if args.hunt or args.split or args.walkers is not None:
+            parser.error("-walkers/-split/-hunt configure the "
+                         "simulation fleet; they cannot be combined "
+                         "with -validate")
+        if args.fused:
+            parser.error("-validate has no fused fixpoint (its chunk "
+                         "loop needs the host to commit divergences); "
+                         "it cannot be combined with -fused")
+        if args.supervise:
+            parser.error("-validate runs its own rescue/resume and "
+                         "OOM batch-halving ladder; it cannot be "
+                         "combined with -supervise (use the dispatch "
+                         "service for requeue loops)")
+        if args.deadlock:
+            parser.error("-validate has no deadlock notion (a trace "
+                         "ending early is simply shorter); it cannot "
+                         "be combined with -deadlock")
+        if args.maxstates is not None:
+            parser.error("-maxstates bounds BFS; -validate is bounded "
+                         "by the trace file and -maxseconds")
+        if args.checkpoint is not None:
+            parser.error("-validate checkpoints are preemption-driven "
+                         "rescues (SIGTERM -> snapshot -> exit 75), "
+                         "not periodic; -checkpoint cannot be "
+                         "combined with it (-checkpointdir sets the "
+                         "rescue directory, -recover resumes)")
+        if args.engine == "sharded":
+            parser.error("-validate shards its trace batch over the "
+                         "mesh itself; it cannot be combined with "
+                         "-engine sharded (the BFS mesh engine)")
+        if args.fpset in ("hbm", "paged"):
+            parser.error(f"-fpset {args.fpset} configures the BFS "
+                         f"fingerprint set; -validate keeps its "
+                         f"candidate sets per trace (use -fpset host/"
+                         f"-engine interp for the interpreter "
+                         f"validator)")
+    if args.batch is not None:
+        if args.validate is None:
+            parser.error("-batch sizes the -validate round; it needs "
+                         "-validate")
+        if args.batch < 1:
+            parser.error(f"-batch must be >= 1 (got {args.batch})")
     if args.inject:
         from ..resilience.faults import FaultPlan
         try:
@@ -322,6 +407,120 @@ def _pick_engine(requested, fpset, spec):
     # the device engine; everything else on the interpreter
     from ..models.registry import has_device_model
     return "device" if has_device_model(spec) else "interp"
+
+
+def _format_divergence(rec):
+    """Render one divergence record the way violation traces render:
+    the recorded event that no spec transition matches, plus the
+    spec-side enabled set at that point."""
+    lines = [f"Error: trace {rec['trace']} diverges at event "
+             f"{rec['step']}."]
+    ev = rec.get("event") or {}
+    if ev.get("action"):
+        lines.append(f"  recorded action: {ev['action']}")
+    if ev.get("vars"):
+        lines.append("  recorded observation: "
+                     + ", ".join(f"{k} = {v}"
+                                 for k, v in sorted(ev["vars"].items())))
+    if rec.get("reason") == "no-init-state":
+        lines.append("  no spec init state matches the trace's init "
+                     "observation")
+    lines.append(f"  candidate states at the divergence: "
+                 f"{rec.get('candidates', 0)}")
+    enabled = rec.get("enabled") or []
+    if enabled:
+        lines.append("  spec-side enabled actions there:")
+        for e in enabled:
+            loc = f"  ({e['location']})" if e.get("location") else ""
+            par = (f"[{e['param']}]" if e.get("param") is not None
+                   else "")
+            lines.append(f"    {e['action']}{par}{loc}")
+    else:
+        lines.append("  no spec action is enabled there (the spec "
+                     "deadlocks where the implementation continued)")
+    if rec.get("invariant"):
+        lines.append(f"  note: every candidate state violated "
+                     f"invariant {rec['invariant']} from event "
+                     f"{rec['invariant_step']} on")
+    return "\n".join(lines)
+
+
+def _run_validate(args, spec, engine, obs, log, summary_metrics):
+    """The -validate execution branch (ISSUE 8): load TRACE.jsonl,
+    run the batched device validator (interpreter fallback), report
+    the first divergence, and map the ending onto the unified
+    exit-code table (0 accepted / 12 diverged / 75 preempted)."""
+    from ..core.values import TLAError
+    from ..exitcodes import EX_RESUMABLE
+    from ..validate import host_validate_batch, load_traces
+    try:
+        traces = load_traces(args.validate, spec)
+    except (OSError, TLAError) as e:
+        print(f"[tpuvsr] -validate: {e}", file=sys.stderr)
+        return 2
+    log(f"validating {len(traces)} trace(s) from {args.validate}")
+    if engine == "interp":
+        if args.recover:
+            log(f"-recover {args.recover} ignored: the interpreter "
+                f"validator keeps no rescue snapshots (it restarts "
+                f"from trace 0)")
+        res = host_validate_batch(spec, traces, obs=obs, log=log,
+                                  max_seconds=args.maxseconds)
+    else:
+        from ..resilience.supervisor import (Preempted,
+                                             PreemptionGuard)
+        from ..validate import ObservationUnsupported
+        from ..validate.batch import BatchValidator
+        ckpt_dir = args.checkpointdir or (
+            os.path.splitext(args.spec)[0] + ".ckpt")
+        try:
+            # encodability is pre-flighted BEFORE the journal-backed
+            # observer is handed over, so a fallback run still writes
+            # the user's -journal/-metrics through the same observer
+            bv = BatchValidator(spec, batch=args.batch or 1024,
+                                pipeline=args.pipeline, log=log)
+            bv.check_observations(traces)
+        except ObservationUnsupported as e:
+            # the codec cannot express some observation as encoded-
+            # leaf comparisons — the interpreter validator is fully
+            # general, so fall back instead of failing the run
+            log(f"{e}; falling back to the interpreter validator")
+            if args.recover:
+                log(f"-recover {args.recover} ignored: the "
+                    f"interpreter validator keeps no rescue "
+                    f"snapshots (it restarts from trace 0)")
+            res = host_validate_batch(spec, traces, obs=obs, log=log,
+                                      max_seconds=args.maxseconds)
+            bv = None
+        try:
+            if bv is not None:
+                with PreemptionGuard(log=log):
+                    res = bv.run(traces, checkpoint_path=ckpt_dir,
+                                 resume_from=args.recover, obs=obs,
+                                 log=log, max_seconds=args.maxseconds)
+        except Preempted as p:
+            log(f"{p}; rerun with -recover {p.path} to continue "
+                f"(exit {EX_RESUMABLE})")
+            return EX_RESUMABLE
+    summary = {"mode": "validate", "ok": res.ok,
+               "traces": res.traces_checked,
+               "accepted": res.accepted,
+               "divergences": len(res.divergences or []),
+               "first_divergence": res.first_divergence,
+               "traces_per_sec": round(res.traces_per_sec, 1),
+               "error": res.error,
+               "elapsed_s": round(res.elapsed, 3),
+               "metrics": summary_metrics(res.metrics)}
+    if res.divergences:
+        print(_format_divergence(res.divergences[0]),
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            if k != "first_divergence":
+                print(f"{k}: {v}")
+    return EX_OK if res.ok else EX_VIOLATION
 
 
 def main(argv=None):
@@ -387,8 +586,9 @@ def main(argv=None):
             init_from_env()
         backend = ensure_backend(log)
         log(f"backend: {backend}")
-    log(f"spec {spec.module.name}, engine {engine}, "
-        f"{'simulation' if args.simulate else 'BFS'}")
+    mode = ("trace validation" if args.validate
+            else "simulation" if args.simulate else "BFS")
+    log(f"spec {spec.module.name}, engine {engine}, {mode}")
 
     # speclint pre-flight: same gate the engines run, surfaced here as
     # a clean exit instead of a traceback (the engines' own call then
@@ -416,6 +616,12 @@ def main(argv=None):
             return None
         return {k: m[k] for k in ("run_id", "phases", "counters",
                                   "gauges") if k in m}
+
+    if args.validate:
+        # trace-validation mode (ISSUE 8): its own engine, its own
+        # exit-code handling — the branch returns directly
+        return _run_validate(args, spec, engine, obs, log,
+                             summary_metrics)
 
     if args.simulate:
         if engine in ("device", "paged"):

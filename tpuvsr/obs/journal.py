@@ -67,6 +67,11 @@ EVENT_REQUIRED = {
     "split": ("killed", "novelty_best", "elapsed_s"),
     "hunt_violation": ("name", "walk", "depth", "elapsed_s"),
     "hunt_elastic": ("from", "to", "elapsed_s"),
+    # batched trace validation (ISSUE 8): the chunk boundary is the
+    # validator's level_done analog (traces/divergences cumulative);
+    # `divergence` is one trace's first spec-inconsistent event
+    "validate_chunk": ("depth", "traces", "divergences", "elapsed_s"),
+    "divergence": ("trace", "step", "elapsed_s"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

@@ -294,6 +294,26 @@ class RunObserver:
                            elapsed_s=round(self.elapsed(), 3),
                            **{"from": int(from_), "to": int(to)})
 
+    # -- batched trace validation events (ISSUE 8) ---------------------
+    def validate_chunk(self, depth, *, traces, divergences, **extra):
+        """A committed validation chunk boundary — the validator's
+        ``level_done``/``sim_chunk`` analog (where service ticks and
+        rescues land).  `depth` is the committed event step within the
+        round; `traces`/`divergences` are cumulative across the run."""
+        self.count("validate_chunks")
+        self.journal.write("validate_chunk", depth=int(depth),
+                           traces=int(traces),
+                           divergences=int(divergences),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
+    def divergence(self, trace, step, **extra):
+        """One trace's first divergence: the recorded event at `step`
+        matches no spec transition from any candidate state."""
+        self.count("divergences")
+        self.journal.write("divergence", trace=str(trace),
+                           step=int(step),
+                           elapsed_s=round(self.elapsed(), 3), **extra)
+
     def rescue(self, path, depth, distinct, signal_name):
         """A preemption rescue snapshot written at a level boundary
         (the run exits with the resumable code right after)."""
@@ -305,11 +325,12 @@ class RunObserver:
 
     # -- the one progress formatter (drift-proof across engines) -------
     def progress(self, depth=None, distinct=None, generated=None,
-                 frontier=None, walks=None, steps=None, extra=None,
-                 force=False):
+                 frontier=None, walks=None, steps=None, traces=None,
+                 extra=None, force=False):
         """Throttled, uniformly formatted progress line.  BFS engines
         pass depth/distinct/generated(/frontier); simulation engines
-        pass walks/steps.  Returns True when a line was emitted."""
+        pass walks/steps; the trace validator passes traces.  Returns
+        True when a line was emitted."""
         if self._log is None:
             return False
         now = time.time()
@@ -319,7 +340,11 @@ class RunObserver:
         self._last_progress = now
         el = max(now - self._t0, 1e-9) if self._t0 is not None else None
         parts = []
-        if walks is not None:
+        if traces is not None:
+            parts.append(f"{traces} traces")
+            if el:
+                parts.append(f"{traces / el:.0f} traces/s")
+        elif walks is not None:
             parts.append(f"{walks} walks")
             if steps is not None:
                 parts.append(f"{steps} steps")
@@ -383,17 +408,25 @@ class RunObserver:
                            deadlocks=int(res.deadlocks))
             if getattr(res, "violations", None) is not None:
                 summary["unique_violations"] = len(res.violations)
+        elif hasattr(res, "traces_checked"):            # ValidateResult
+            self.gauge("traces_per_s", res.traces_checked / el)
+            summary.update(traces=int(res.traces_checked),
+                           accepted=int(res.accepted),
+                           divergences=len(res.divergences or []))
         elif hasattr(res, "property_name"):             # LivenessResult
             summary.update(distinct=int(res.distinct_states))
         summary["violated"] = violated
         summary["error"] = error
         if not res.ok and not self._finished:
-            kind = ("invariant" if violated else
+            divs = getattr(res, "divergences", None)
+            kind = ("divergence" if divs else
+                    "invariant" if violated else
                     "deadlock" if (error == "deadlock"
                                    or getattr(res, "deadlocks", 0))
                     else "error")
-            self.journal.write("violation", kind=kind,
-                               name=violated or error or kind,
+            name = (f"trace {divs[0].get('trace')}" if divs
+                    else violated or error or kind)
+            self.journal.write("violation", kind=kind, name=name,
                                elapsed_s=round(elapsed, 3))
         if not self._finished:
             self.journal.write("run_end", **summary)

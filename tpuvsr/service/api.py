@@ -99,6 +99,20 @@ def build_parser():
                     help="sim jobs: continuous hunt — run until "
                          "cancelled/preempted, collecting deduped "
                          "violations")
+    sp.add_argument("--validate", default=None,
+                    metavar="TRACES.jsonl",
+                    help="submit a kind=\"validate\" job: check every "
+                         "recorded implementation trace in the file "
+                         "against the spec (tpuvsr/validate) instead "
+                         "of a BFS check")
+    sp.add_argument("--batch", type=int, default=None,
+                    help="validate jobs: traces per round (default "
+                         "1024)")
+    sp.add_argument("--batch-per-device", type=int, default=None,
+                    help="validate jobs: tie the round size to the "
+                         "device allocation (elastic trace-batch "
+                         "placement: batch = N * devices, rescaled "
+                         "when the scheduler reshapes the job)")
     sp.add_argument("--stub", action="store_true",
                     help="run the inline counter spec on the stub "
                          "kernel (tier-1 smoke path, no reference "
@@ -170,7 +184,31 @@ def cmd_submit(args):
         flags["split"] = True
     if args.hunt:
         flags["hunt"] = True
-    kind = "sim" if args.sim else "check"
+    if args.validate and args.sim:
+        print("submit: --validate and --sim are different job kinds "
+              "(a trace-validation batch vs a walker-fleet hunt); "
+              "pick one", file=sys.stderr)
+        return EX_USAGE
+    if args.validate:
+        if args.maxstates is not None:
+            # mirrors the CLI's -maxstates/-validate exit-2 contract:
+            # the worker would silently ignore it otherwise
+            print("submit: --maxstates bounds BFS; a validate job is "
+                  "bounded by its trace file and --maxseconds",
+                  file=sys.stderr)
+            return EX_USAGE
+        flags["traces"] = args.validate
+        if args.batch is not None:
+            flags["batch"] = args.batch
+        if args.batch_per_device is not None:
+            flags["batch_per_device"] = args.batch_per_device
+    elif args.batch is not None or args.batch_per_device is not None:
+        print("submit: --batch/--batch-per-device size a validate "
+              "job's trace rounds; they need --validate",
+              file=sys.stderr)
+        return EX_USAGE
+    kind = ("validate" if args.validate
+            else "sim" if args.sim else "check")
     if not args.sim and (args.split or args.hunt
                          or args.walkers is not None
                          or args.depth is not None
@@ -194,13 +232,12 @@ def cmd_submit(args):
     return 0
 
 
-def _sim_progress(journal_path):
-    """Sim-specific per-job progress folded from the journal: the
-    latest chunk's walks/steps/depth, best novelty, and the unique
-    violation count — the fleet's analog of the BFS level rows
-    (ISSUE 7 satellite)."""
-    out = {"walks": 0, "steps": 0, "depth": 0, "novelty_best": None,
-           "unique_violations": 0, "walkers": None}
+def _fold_progress(journal_path, out, fold, nonempty):
+    """The shared journal fold behind the per-kind progress rows:
+    line-by-line JSON parse tolerating torn tails, ``fold(event, ev,
+    out)`` per parsed event, ``out`` returned only when ``nonempty``
+    says the journal actually carried that kind's progress (None
+    otherwise, like an unreadable file — the caller omits the row)."""
     try:
         with open(journal_path) as f:
             for line in f:
@@ -208,22 +245,63 @@ def _sim_progress(journal_path):
                     ev = json.loads(line)
                 except ValueError:
                     continue
-                e = ev.get("event")
-                if e == "sim_chunk":
-                    out["walks"] = ev.get("walks", out["walks"])
-                    out["steps"] = ev.get("steps", out["steps"])
-                    out["depth"] = ev.get("depth", out["depth"])
-                elif e == "split" and ev.get("novelty_best") \
-                        is not None:
-                    out["novelty_best"] = ev["novelty_best"]
-                elif e == "hunt_violation":
-                    out["unique_violations"] += 1
-                elif e == "hunt_elastic":
-                    out["walkers"] = ev.get("to", out["walkers"])
+                fold(ev.get("event"), ev, out)
     except OSError:
         return None
-    return out if (out["walks"] or out["steps"]
-                   or out["unique_violations"]) else None
+    return out if nonempty(out) else None
+
+
+def _sim_progress(journal_path):
+    """Sim-specific per-job progress folded from the journal: the
+    latest chunk's walks/steps/depth, best novelty, and the unique
+    violation count — the fleet's analog of the BFS level rows
+    (ISSUE 7 satellite)."""
+    def fold(e, ev, out):
+        if e == "sim_chunk":
+            out["walks"] = ev.get("walks", out["walks"])
+            out["steps"] = ev.get("steps", out["steps"])
+            out["depth"] = ev.get("depth", out["depth"])
+        elif e == "split" and ev.get("novelty_best") is not None:
+            out["novelty_best"] = ev["novelty_best"]
+        elif e == "hunt_violation":
+            out["unique_violations"] += 1
+        elif e == "hunt_elastic":
+            out["walkers"] = ev.get("to", out["walkers"])
+
+    return _fold_progress(
+        journal_path,
+        {"walks": 0, "steps": 0, "depth": 0, "novelty_best": None,
+         "unique_violations": 0, "walkers": None}, fold,
+        lambda o: (o["walks"] or o["steps"]
+                   or o["unique_violations"]))
+
+
+def _validate_progress(journal_path):
+    """Validate-specific per-job progress folded from the journal:
+    cumulative traces checked / divergences from the latest
+    ``validate_chunk``, plus the first divergence's location — the
+    trace-validation analog of the sim rows (ISSUE 8)."""
+    def fold(e, ev, out):
+        if e == "validate_chunk":
+            out["traces"] = ev.get("traces", out["traces"])
+            out["divergences"] = ev.get("divergences",
+                                        out["divergences"])
+            out["step"] = ev.get("depth", out["step"])
+        elif e == "run_end" and ev.get("traces") is not None:
+            # chunk rows are mid-round progress; the run summary has
+            # the final totals
+            out["traces"] = ev["traces"]
+            out["divergences"] = ev.get("divergences",
+                                        out["divergences"])
+        elif e == "divergence" and out["first_divergence"] is None:
+            out["first_divergence"] = {"trace": ev.get("trace"),
+                                       "step": ev.get("step")}
+
+    return _fold_progress(
+        journal_path,
+        {"traces": 0, "divergences": 0, "step": 0,
+         "first_divergence": None}, fold,
+        lambda o: o["traces"] or o["divergences"])
 
 
 def cmd_status(args):
@@ -241,6 +319,8 @@ def cmd_status(args):
         doc["metrics"] = mp if os.path.exists(mp) else None
         if job.kind == "sim" and os.path.exists(jp):
             doc["sim"] = _sim_progress(jp)
+        if job.kind == "validate" and os.path.exists(jp):
+            doc["validate"] = _validate_progress(jp)
         tail = []
         if args.tail and os.path.exists(jp):
             with open(jp) as f:
@@ -265,6 +345,13 @@ def cmd_status(args):
                       f"{s['unique_violations']} unique violation(s)"
                       + (f", best novelty {s['novelty_best']}"
                          if s["novelty_best"] is not None else ""))
+            if doc.get("validate"):
+                v = doc["validate"]
+                fd = v.get("first_divergence")
+                print(f"validate: {v['traces']} trace(s) checked, "
+                      f"{v['divergences']} divergence(s)"
+                      + (f", first at trace {fd['trace']} event "
+                         f"{fd['step']}" if fd else ""))
             if doc.get("result"):
                 r = {k: v for k, v in doc["result"].items()
                      if k not in ("trace", "violations")}

@@ -80,7 +80,8 @@ class Job:
     spec: str
     cfg: str = None
     engine: str = "auto"
-    kind: str = "check"   # "check" (BFS) | "sim" (fleet hunt) | "shell"
+    kind: str = "check"   # "check" (BFS) | "sim" (fleet hunt)
+    #                     # | "validate" (trace batch) | "shell"
     flags: dict = field(default_factory=dict)
     priority: int = 0
     devices: int = 1
@@ -99,10 +100,13 @@ class Job:
     def elastic(self):
         """True when the scheduler may reshape this job's device
         allocation: sharded BFS jobs (mesh reshaped through the PR 5
-        reshard-on-load resume) and fleet-sim jobs (walker fleet
-        resumed on the new mesh; walker count rescales at the next
-        round boundary, ISSUE 7)."""
-        return ((self.engine == "sharded" or self.kind == "sim")
+        reshard-on-load resume), fleet-sim jobs (walker fleet resumed
+        on the new mesh; walker count rescales at the next round
+        boundary, ISSUE 7), and trace-validation jobs (the batch
+        validator re-shards its committed candidate frontier onto
+        whatever mesh the resume builds, ISSUE 8)."""
+        return ((self.engine == "sharded"
+                 or self.kind in ("sim", "validate"))
                 and (self.devices_min is not None
                      or self.devices_max is not None))
 

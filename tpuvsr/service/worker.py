@@ -93,6 +93,14 @@ class JobObserver(RunObserver):
         if self._tick is not None:
             self._tick(int(depth))
 
+    def validate_chunk(self, depth, **kw):
+        # validation chunk boundaries complete the set (ISSUE 8):
+        # kind="validate" jobs cancel/rebalance where the batch
+        # validator polls the preemption flag
+        super().validate_chunk(depth, **kw)
+        if self._tick is not None:
+            self._tick(int(depth))
+
 
 class Worker:
     """Serial drain loop over one :class:`JobQueue` (see module doc).
@@ -260,6 +268,8 @@ class Worker:
                 return self._run_shell(job)
             if job.kind == "sim":
                 return self._run_sim(job)
+            if job.kind == "validate":
+                return self._run_validate(job)
             return self._run_check(job)
         finally:
             self.pool.release(job.job_id)
@@ -465,6 +475,82 @@ class Worker:
             if injected:
                 faults.clear()
         self._settle(job, out, sim_result_summary)
+
+    # -- validate jobs (batched trace validation, ISSUE 8) -------------
+    def _run_validate(self, job):
+        """``kind="validate"``: a recorded-trace batch checked against
+        the spec through ``run_validate_job`` — the validation twin of
+        the sim path.  ``flags.traces`` names the TRACE.jsonl file;
+        speclint admission already ran at ``queued -> admitted`` (the
+        shared gate), so no device time is spent on a rejected spec.
+        Validate-chunk boundaries tick the scheduler exactly like BFS
+        level boundaries, so cancel and elastic trace-batch placement
+        ride the ordinary preempt-requeue machinery; the rescue is the
+        CRC'd candidate-frontier snapshot and a resumed batch reports
+        bit-identical divergences on whatever mesh the new allocation
+        builds."""
+        from ..resilience import faults
+        from ..validate.batch import (run_validate_job,
+                                      validate_result_summary)
+        spec = self._specs.get(job.job_id) or self._load_spec(job)
+        alloc = self.scheduler.alloc_for(job)
+        self.pool.alloc(job.job_id, alloc)
+        backend, why = advise_backend(job, tpu_devices=self.tpu_devices,
+                                      bench_dir=self.bench_dir)
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=alloc, backend=backend, placement=why)
+        flags = job.flags
+        injected = None
+        try:
+            factory = None
+            if flags.get("stub"):
+                from ..testing import stub_model_factory
+                factory = stub_model_factory(
+                    inv_bound=flags.get("inv_bound"),
+                    inv_x_bound=flags.get("inv_x_bound"))
+            traces_path = flags.get("traces")
+            if not traces_path:
+                raise ValueError("validate jobs need flags.traces "
+                                 "(the TRACE.jsonl path)")
+            from ..validate import load_traces
+            traces = load_traces(traces_path, spec)
+
+            def observer_factory(**kw):
+                return JobObserver(
+                    tick=lambda depth: self._tick(job, depth), **kw)
+
+            injected = flags.get("inject")
+            if injected:
+                faults.install(injected)
+            batch = flags.get("batch")
+            batch = 1024 if batch is None else int(batch)
+            if flags.get("batch_per_device"):
+                # elastic trace-batch placement: the round size
+                # follows the device allocation (a resume finishes
+                # its round at the rescue's batch first — the
+                # determinism contract is per-trace, so reports are
+                # unchanged either way)
+                batch = max(1, int(flags["batch_per_device"]) * alloc)
+            out = run_validate_job(
+                spec, traces,
+                checkpoint_path=self.queue.checkpoint_path(job.job_id),
+                journal_path=self.queue.journal_path(job.job_id),
+                metrics_path=self.queue.metrics_path(job.job_id),
+                log=self._log, observer_factory=observer_factory,
+                model_factory=factory, batch=batch, n_devices=alloc,
+                cand_cap=int(flags.get("cand_cap") or 4),
+                chunk_steps=int(flags.get("chunk_steps") or 8),
+                pipeline=int(flags.get("pipeline") or 2),
+                max_seconds=flags.get("maxseconds"),
+                resume_from=(job.rescue or {}).get("path"))
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self._finish(job, "failed",
+                         reason=f"job-setup: {type(e).__name__}: {e}")
+            return
+        finally:
+            if injected:
+                faults.clear()
+        self._settle(job, out, validate_result_summary)
 
     # -- shell jobs (the absorbed tpu_queue workload driver) -----------
     def _run_shell(self, job):

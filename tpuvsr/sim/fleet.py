@@ -73,7 +73,8 @@ FLEET_PAYLOADS = ("walkers.npz", "hist.npz", "seen.npz")
 # npz payloads, atomic rename — the engine checkpoint idiom, minus the
 # BFS-specific payload set)
 # ---------------------------------------------------------------------
-def save_fleet_snapshot(path, *, manifest, arrays=None):
+def save_fleet_snapshot(path, *, manifest, arrays=None,
+                        kind="fleet-sim"):
     """Write a fleet snapshot to `path` (atomic + durable).
 
     ``manifest`` is the JSON-able driver state; ``arrays`` maps payload
@@ -81,7 +82,9 @@ def save_fleet_snapshot(path, *, manifest, arrays=None):
     a round-boundary snapshot carries no walker arrays).  The manifest
     mirrors the engine checkpoint's ``depth``/``fp_count``/``elapsed``
     keys so ``checkpoint.snapshot_info`` (the dispatch service's cheap
-    rescue-handoff reader) works on fleet snapshots unchanged."""
+    rescue-handoff reader) works on fleet snapshots unchanged.
+    ``kind`` distinguishes snapshot families sharing this format (the
+    batched trace validator writes ``kind="validate"``, ISSUE 8)."""
     tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
@@ -97,7 +100,7 @@ def save_fleet_snapshot(path, *, manifest, arrays=None):
         written.append(name)
     manifest = dict(manifest)
     manifest["format"] = FLEET_FORMAT
-    manifest["kind"] = "fleet-sim"
+    manifest["kind"] = kind
     manifest["payload_crc32"] = {
         name: _crc32_file(os.path.join(tmp, name)) for name in written}
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
@@ -119,17 +122,17 @@ def save_fleet_snapshot(path, *, manifest, arrays=None):
         shutil.rmtree(old)
 
 
-def load_fleet_snapshot(path, expect_digest=None):
+def load_fleet_snapshot(path, expect_digest=None, kind="fleet-sim"):
     """Read + CRC-verify a fleet snapshot; returns (manifest, arrays).
-    Raises ValueError on a non-fleet snapshot, CRC mismatch, or a
+    Raises ValueError on a wrong-kind snapshot, CRC mismatch, or a
     spec-digest mismatch (resuming a different model is a policy
     error, never masked)."""
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
-    if manifest.get("kind") != "fleet-sim" \
+    if manifest.get("kind") != kind \
             or manifest.get("format") != FLEET_FORMAT:
         raise ValueError(
-            f"{path}: not a fleet-sim/{FLEET_FORMAT} snapshot "
+            f"{path}: not a {kind}/{FLEET_FORMAT} snapshot "
             f"(kind={manifest.get('kind')!r})")
     if expect_digest is not None and manifest.get("spec_digest") and \
             manifest["spec_digest"] != expect_digest:
@@ -433,6 +436,11 @@ class FleetSimulator:
         if self.splitter is not None:
             self.splitter.bind(kern)
         self._mat = {}
+        # the encoded init batch is a pure function of the codec (and
+        # the codec only changes on a rebuild) — cache it per build
+        # instead of re-enumerating spec.init_states() every round
+        # (ROADMAP item 2 residual)
+        self._init_cache = None
 
     # -- growth --------------------------------------------------------
     def _grow_msgs(self, batches):
@@ -459,13 +467,17 @@ class FleetSimulator:
     def _init_batch(self, base, active):
         """Dense walker batch at the round start: walker slot s begins
         at init state ``(base + s) % n_init`` (the per-walk
-        deterministic analog of TLC's random init choice)."""
-        init_dense = [self.codec.encode(st)
-                      for st in self.spec.init_states()]
-        n_init = len(init_dense)
+        deterministic analog of TLC's random init choice).  The
+        encoded init states are cached per build — enumeration and
+        encoding happen once, not once per round."""
+        if self._init_cache is None:
+            init_dense = [self.codec.encode(st)
+                          for st in self.spec.init_states()]
+            self._init_cache = (
+                {k: np.stack([np.asarray(d[k]) for d in init_dense])
+                 for k in init_dense[0]}, len(init_dense))
+        batch, n_init = self._init_cache
         idx = (base + np.arange(self.W_pad)) % n_init
-        batch = {k: np.stack([np.asarray(d[k]) for d in init_dense])
-                 for k in init_dense[0]}
         states = {k: v[idx] for k, v in batch.items()}
         alive = np.arange(self.W_pad) < active
         return states, alive
@@ -787,106 +799,46 @@ class FleetSimulator:
         """Run walks until `num` of them completed (rounds of
         ``walkers`` at a time), reporting the minimum-walk-id violation
         of the first violating round (module docstring: the
-        determinism contract)."""
-        if depth < 1:
-            raise ValueError(f"depth must be >= 1 (got {depth})")
+        determinism contract).  The round loop is the shared
+        :func:`drive_rounds` driver; only the per-round event handling
+        (stop at the first violation) lives here."""
         if log is not None:
             self._log = self._log or log
         obs = RunObserver.ensure(obs, "fleet-sim", self.spec, log=log)
-        self._obs_active = obs
         res = SimResult()
-        res.walkers = self.walkers
-        t0 = time.time()
-        resume = None
-        base = 0
-        round_active = None
-        chunks = 0
-        if resume_from:
-            manifest, resume = self._load_resume(resume_from)
-            base = int(manifest["base"])
-            res.walks = int(manifest["walks"])
-            res.steps = int(manifest["steps"])
-            res.deadlocks = int(manifest.get("deadlocks", 0))
-            round_active = int(manifest["active"])
-            chunks = int(manifest.get("chunks", 0))
-            t0 -= float(manifest["elapsed"])
-            res.walkers = self.walkers
-        obs.start(t0, backend=jax.default_backend(),
-                  resumed=resume_from is not None)
-        obs.gauge("walkers", self.walkers)
-        obs.gauge("mesh_devices", self.D)
-        obs.gauge("pipeline_depth", self.pipeline)
-        bad0 = self.spec.check_invariants(
-            next(iter(self.spec.init_states())))
-        if bad0:
-            res.ok = False
-            res.violated_invariant = bad0
-            return obs.finish(res)
-        key = jax.random.PRNGKey(seed)
-        deadline = (t0 + max_seconds) if max_seconds else None
-        retries = 0
-        while res.walks < num:
-            active = (round_active if round_active is not None
-                      else min(self.walkers, num - res.walks))
-            round_active = None
-            try:
-                (violated, dead, hists, init_states, steps,
-                 completed, chunks) = self.run_round(
-                    base=base, active=active, depth=depth, key=key,
-                    obs=obs, deadline=deadline, on_chunk=on_chunk,
-                    checkpoint_path=checkpoint_path,
-                    rescue_extra={"num": num, "seed": seed,
-                                  "depth": depth},
-                    resume=resume, steps_before=res.steps,
-                    chunks_before=chunks,
-                    deadlocks_before=res.deadlocks)
-            except Exception as e:  # noqa: BLE001 — OOM ladder below
-                resume = None
-                if not self.try_degrade_oom(e, retries, obs):
-                    raise
-                retries += 1
-                res.walkers = self.walkers
-                continue
-            resume = None
-            res.steps += steps
-            res.deadlocks += int((dead >= 0).sum())
-            ev = self._pick_event(violated, dead, active,
+
+        def on_round(rr):
+            ev = self._pick_event(rr.violated, rr.dead, rr.active,
                                   check_deadlock)
-            if ev is not None:
-                slot, ev_depth, kind = ev
-                res.ok = False
-                res.trace = self.replay(
-                    {k: v[slot] for k, v in init_states.items()},
-                    hists, slot, ev_depth)
-                if completed:
-                    res.walks += active
-                if kind == "deadlock":
-                    res.violated_invariant = None
-                    return obs.finish(res)
-                confirmed = self.spec.check_invariants(
-                    res.trace[-1].state)
-                if confirmed is None:
-                    from ..core.values import TLAError
-                    err = TLAError(
-                        "device/interpreter divergence: the fleet "
-                        "invariant kernel reported a violation at "
-                        f"walk {base + slot} step {ev_depth}, but the "
-                        "interpreter accepts the replayed state")
-                    err.trace = res.trace
-                    raise err
-                res.violated_invariant = confirmed
-                return obs.finish(res)
-            if not completed:
-                # deadline-cut round: its walks did NOT complete — do
-                # not count them (walks/s stays honest; steps, which
-                # really ran, are already counted)
-                break
-            res.walks += active
-            base += active
-            obs.progress(walks=res.walks, steps=res.steps)
-            if deadline and time.time() > deadline:
-                break
-        return obs.finish(res)
+            if ev is None:
+                return False
+            slot, ev_depth, kind = ev
+            res.ok = False
+            res.trace = self.replay(
+                {k: v[slot] for k, v in rr.init_states.items()},
+                rr.hists, slot, ev_depth)
+            if kind == "deadlock":
+                res.violated_invariant = None
+                return True
+            confirmed = self.spec.check_invariants(
+                res.trace[-1].state)
+            if confirmed is None:
+                from ..core.values import TLAError
+                err = TLAError(
+                    "device/interpreter divergence: the fleet "
+                    "invariant kernel reported a violation at "
+                    f"walk {rr.base + slot} step {ev_depth}, but the "
+                    "interpreter accepts the replayed state")
+                err.trace = res.trace
+                raise err
+            res.violated_invariant = confirmed
+            return True
+
+        return drive_rounds(
+            self, self.spec, res, depth=depth, seed=seed, num=num,
+            obs=obs, max_seconds=max_seconds,
+            checkpoint_path=checkpoint_path, resume_from=resume_from,
+            on_chunk=on_chunk, on_round=on_round, log=log)
 
     def _pick_event(self, violated, dead, active, check_deadlock):
         """The deterministic violation choice: the minimum walk id
@@ -913,6 +865,178 @@ class FleetSimulator:
                 best = (int(slot), int(vd), "invariant")
             break
         return best
+
+
+class RoundData:
+    """What one committed round hands to the caller's ``on_round``
+    hook: the event arrays over the padded slot axis, the recorded
+    histories, the round's init batch, and the round bookkeeping."""
+
+    __slots__ = ("violated", "dead", "hists", "init_states", "base",
+                 "active", "completed")
+
+    def __init__(self, violated, dead, hists, init_states, base,
+                 active, completed):
+        self.violated = violated
+        self.dead = dead
+        self.hists = hists
+        self.init_states = init_states
+        self.base = base
+        self.active = active
+        self.completed = completed
+
+
+def drive_rounds(sim, spec, res, *, depth, seed, obs, num=None,
+                 max_seconds=None, checkpoint_path=None,
+                 resume_from=None, on_chunk=None, rescue_extra=None,
+                 on_resume=None, on_round=None, should_stop=None,
+                 finalize=None, elastic=None, reshape_rounds=False,
+                 progress_extra=None, log=None) -> SimResult:
+    """THE round driver shared by ``FleetSimulator.run`` and
+    ``sim.hunt.run_hunt`` (ISSUE 8 satellite — the rescue/resume and
+    OOM-ladder bookkeeping used to be duplicated in both, and the
+    missed-deadlocks seam bug had to be fixed twice).
+
+    The driver owns everything mode-independent: resume-manifest
+    unpacking, observer start/gauges, the init-state invariant
+    pre-check, round sizing, the per-round rescue-extra envelope
+    (``seed``/``depth``/``num``/``round_idx`` + the caller's
+    ``rescue_extra()`` dict), the fleet OOM degrade ladder, walks/
+    steps/deadlocks accounting, and — under ``reshape_rounds`` — the
+    walker-count elasticity applied at round boundaries (journaled
+    ``hunt_elastic``).  Callers plug in:
+
+    * ``on_round(RoundData) -> bool`` — mode-specific event handling
+      (stop-at-first-violation vs collect-and-dedup); truthy = stop;
+    * ``should_stop()`` — extra loop-top stop condition;
+    * ``on_resume(manifest, extra)`` — restore mode state from a
+      rescue snapshot's extra envelope;
+    * ``rescue_extra()`` — mode state to carry in the next rescue;
+    * ``finalize(res)`` — result fields computed at a NORMAL end (not
+      on the init-state-violation fast path);
+    * ``elastic(round_idx) -> walkers|None`` — the reshape schedule.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1 (got {depth})")
+    sim._obs_active = obs
+    res.walkers = sim.walkers
+    target_walkers = sim.walkers
+    t0 = time.time()
+    resume = None
+    base = 0
+    round_active = None
+    chunks = 0
+    round_idx = 0
+    if resume_from:
+        manifest, resume = sim._load_resume(resume_from)
+        base = int(manifest["base"])
+        res.walks = int(manifest["walks"])
+        res.steps = int(manifest["steps"])
+        res.deadlocks = int(manifest.get("deadlocks", 0))
+        round_active = int(manifest["active"])
+        chunks = int(manifest.get("chunks", 0))
+        t0 -= float(manifest["elapsed"])
+        extra = manifest.get("extra") or {}
+        # round numbering survives a rescue/resume so elastic
+        # schedules don't restart from 0 after a preemption
+        round_idx = int(extra.get("round_idx") or 0)
+        if on_resume is not None:
+            on_resume(manifest, extra)
+        res.walkers = sim.walkers
+    obs.start(t0, backend=jax.default_backend(),
+              resumed=resume_from is not None)
+    obs.gauge("walkers", sim.walkers)
+    obs.gauge("mesh_devices", sim.D)
+    obs.gauge("pipeline_depth", sim.pipeline)
+    bad0 = spec.check_invariants(next(iter(spec.init_states())))
+    if bad0:
+        res.ok = False
+        res.violated_invariant = bad0
+        return obs.finish(res)
+    key = jax.random.PRNGKey(seed)
+    deadline = (t0 + max_seconds) if max_seconds else None
+    retries = 0
+    try:
+        while True:
+            if num is not None and res.walks >= num:
+                break
+            if should_stop is not None and should_stop():
+                break
+            if deadline is not None and time.time() > deadline:
+                break
+            active = (round_active if round_active is not None else
+                      (min(sim.walkers, num - res.walks)
+                       if num is not None else sim.walkers))
+            round_active = None
+            extra_env = {"seed": seed, "depth": depth, "num": num,
+                         "round_idx": round_idx}
+            if rescue_extra is not None:
+                extra_env.update(rescue_extra())
+            try:
+                (violated, dead, hists, init_states, steps,
+                 completed, chunks) = sim.run_round(
+                    base=base, active=active, depth=depth, key=key,
+                    obs=obs, deadline=deadline, on_chunk=on_chunk,
+                    checkpoint_path=checkpoint_path,
+                    rescue_extra=extra_env,
+                    resume=resume, steps_before=res.steps,
+                    chunks_before=chunks,
+                    deadlocks_before=res.deadlocks)
+            except Exception as e:  # noqa: BLE001 — fleet OOM ladder
+                resume = None
+                if not sim.try_degrade_oom(e, retries, obs):
+                    raise
+                retries += 1
+                res.walkers = sim.walkers
+                # the degraded count IS the new target — regrowing at
+                # the next round boundary would just re-trip the OOM
+                target_walkers = sim.walkers
+                continue
+            resume = None
+            res.steps += steps
+            res.deadlocks += int((dead >= 0).sum())
+            stop = bool(on_round(RoundData(
+                violated, dead, hists, init_states, base, active,
+                completed))) if on_round is not None else False
+            if completed:
+                res.walks += active
+                base += active
+                round_idx += 1
+            if stop or not completed:
+                # an event stopped the run, or a deadline cut the
+                # round short (its walks did NOT complete — do not
+                # count them; steps, which really ran, are counted)
+                break
+            obs.progress(walks=res.walks, steps=res.steps,
+                         extra=(progress_extra()
+                                if progress_extra is not None
+                                else None))
+            if reshape_rounds:
+                # walker-count elasticity, applied at the round
+                # boundary (rounds restart from init states, so
+                # reshaping is free)
+                target = (elastic(round_idx) if elastic is not None
+                          else target_walkers)
+                if target and int(target) != sim.walkers:
+                    old = sim.walkers
+                    sim._set_walkers(int(target))
+                    target_walkers = sim.walkers
+                    obs.hunt_elastic(old, sim.walkers)
+                    obs.gauge("walkers", sim.walkers)
+                    obs.gauge("mesh_devices", sim.D)
+                    if log:
+                        log(f"hunt: fleet reshaped {old} -> "
+                            f"{sim.walkers} walkers")
+    except BaseException:
+        # the crash contract: finalize instrumentation (valid journal
+        # prefix, no run_end) on ANY escaping exception — Preempted
+        # included, whose rescue_checkpoint event is already journaled
+        sim._obs_active = None
+        obs.close()
+        raise
+    if finalize is not None:
+        finalize(res)
+    return obs.finish(res)
 
 
 def fleet_simulate(spec, num=1000, depth=100, seed=0, walkers=4096,

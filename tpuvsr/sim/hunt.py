@@ -25,11 +25,8 @@ the same way.
 from __future__ import annotations
 
 import hashlib
-import time
 
 import numpy as np
-
-import jax
 
 from ..engine.simulate import SimResult
 from ..exitcodes import (EX_OK, EX_RESUMABLE, EX_SOFTWARE,
@@ -97,166 +94,79 @@ def run_hunt(spec, *, walkers=4096, depth=100, seed=0, num=None,
     Stops when ``num`` walks completed, ``max_violations`` unique
     violations collected, or ``max_seconds`` elapsed — whichever comes
     first (a hunt with none of the three runs until preempted)."""
-    if depth < 1:
-        raise ValueError(f"depth must be >= 1 (got {depth})")
     sim = sim or FleetSimulator(
         spec, walkers=walkers, n_devices=n_devices, mesh=mesh,
         chunk_steps=chunk_steps, max_msgs=max_msgs,
         action_weights=action_weights, swarm_sigma=swarm_sigma,
         split=split, pipeline=pipeline, min_walkers=min_walkers,
         model_factory=model_factory, log=log)
-    target_walkers = sim.walkers
     obs = RunObserver.ensure(obs, "fleet-hunt", spec, log=log)
-    sim._obs_active = obs
     res = SimResult()
     res.violations = []
-    res.walkers = sim.walkers
     dedup = set()
-    t0 = time.time()
-    resume = None
-    base = 0
-    round_active = None
-    chunks = 0
-    round_start = 0
-    if resume_from:
-        manifest, resume = sim._load_resume(resume_from)
-        base = int(manifest["base"])
-        res.walks = int(manifest["walks"])
-        res.steps = int(manifest["steps"])
-        res.deadlocks = int(manifest.get("deadlocks", 0))
-        round_active = int(manifest["active"])
-        chunks = int(manifest.get("chunks", 0))
-        t0 -= float(manifest["elapsed"])
-        extra = manifest.get("extra") or {}
+
+    def on_resume(manifest, extra):
         res.violations = list(extra.get("violations") or [])
-        dedup = set(extra.get("dedup") or [])
-        round_start = int(extra.get("round_idx") or 0)
-    obs.start(t0, backend=jax.default_backend(),
-              resumed=resume_from is not None)
-    obs.gauge("walkers", sim.walkers)
-    obs.gauge("mesh_devices", sim.D)
-    obs.gauge("pipeline_depth", sim.pipeline)
-    bad0 = spec.check_invariants(next(iter(spec.init_states())))
-    if bad0:
-        res.ok = False
-        res.violated_invariant = bad0
-        return obs.finish(res)
-    key = jax.random.PRNGKey(seed)
-    deadline = (t0 + max_seconds) if max_seconds else None
-    retries = 0
-    # round numbering survives a rescue/resume so elastic(round_idx)
-    # schedules don't restart from 0 after a preemption
-    round_idx = round_start
-    try:
-        while True:
-            if num is not None and res.walks >= num:
-                break
+        dedup.update(extra.get("dedup") or [])
+
+    def on_round(rr):
+        for slot in np.nonzero(rr.violated[:rr.active] >= 0)[0]:
+            n = int(rr.violated[slot])
+            kd = _dedup_key(rr.hists, slot, n)
+            if kd in dedup:
+                obs.count("hunt_duplicates")
+                continue
+            trace = sim.replay(
+                {k: v[slot] for k, v in rr.init_states.items()},
+                rr.hists, int(slot), n)
+            confirmed = spec.check_invariants(trace[-1].state)
+            if confirmed is None:
+                from ..core.values import TLAError
+                err = TLAError(
+                    "device/interpreter divergence: the fleet "
+                    "invariant kernel reported a violation at "
+                    f"walk {rr.base + int(slot)} step {n}, but the "
+                    "interpreter accepts the replayed state")
+                err.trace = trace
+                raise err
+            dedup.add(kd)
+            rec = {"name": confirmed, "walk": int(rr.base + slot),
+                   "depth": n, "dedup": kd,
+                   "trace": trace_json(trace)}
+            res.violations.append(rec)
+            obs.hunt_violation(confirmed, int(rr.base + slot), n,
+                               dedup=kd)
+            if not res.trace:
+                res.trace = trace
+                res.violated_invariant = confirmed
             if max_violations is not None \
                     and len(res.violations) >= max_violations:
                 break
-            if deadline is not None and time.time() > deadline:
-                break
-            active = (round_active if round_active is not None else
-                      (min(sim.walkers, num - res.walks)
-                       if num is not None else sim.walkers))
-            round_active = None
-            try:
-                (violated, dead, hists, init_states, steps,
-                 completed, chunks) = sim.run_round(
-                    base=base, active=active, depth=depth, key=key,
-                    obs=obs, deadline=deadline, on_chunk=on_chunk,
-                    checkpoint_path=checkpoint_path,
-                    rescue_extra={
-                        "violations": res.violations,
-                        "dedup": sorted(dedup),
-                        "round_idx": round_idx,
-                        "seed": seed, "depth": depth, "num": num},
-                    resume=resume, steps_before=res.steps,
-                    chunks_before=chunks,
-                    deadlocks_before=res.deadlocks)
-            except Exception as e:  # noqa: BLE001 — fleet OOM ladder
-                resume = None
-                if not sim.try_degrade_oom(e, retries, obs):
-                    raise
-                retries += 1
-                # the degraded count IS the new target — regrowing at
-                # the next round boundary would just re-trip the OOM
-                target_walkers = sim.walkers
-                continue
-            resume = None
-            res.steps += steps
-            res.deadlocks += int((dead >= 0).sum())
-            for slot in np.nonzero(violated[:active] >= 0)[0]:
-                n = int(violated[slot])
-                kd = _dedup_key(hists, slot, n)
-                if kd in dedup:
-                    obs.count("hunt_duplicates")
-                    continue
-                trace = sim.replay(
-                    {k: v[slot] for k, v in init_states.items()},
-                    hists, int(slot), n)
-                confirmed = spec.check_invariants(trace[-1].state)
-                if confirmed is None:
-                    from ..core.values import TLAError
-                    err = TLAError(
-                        "device/interpreter divergence: the fleet "
-                        "invariant kernel reported a violation at "
-                        f"walk {base + int(slot)} step {n}, but the "
-                        "interpreter accepts the replayed state")
-                    err.trace = trace
-                    raise err
-                dedup.add(kd)
-                rec = {"name": confirmed, "walk": int(base + slot),
-                       "depth": n, "dedup": kd,
-                       "trace": trace_json(trace)}
-                res.violations.append(rec)
-                obs.hunt_violation(confirmed, int(base + slot), n,
-                                   dedup=kd)
-                if not res.trace:
-                    res.trace = trace
-                    res.violated_invariant = confirmed
-                if max_violations is not None \
-                        and len(res.violations) >= max_violations:
-                    break
-            if not completed:
-                # deadline-cut round: violations found up to the
-                # committed depth are real and kept, but the round's
-                # walks did not complete — walks/s stays honest
-                break
-            res.walks += active
-            base += active
-            round_idx += 1
-            obs.progress(walks=res.walks, steps=res.steps,
-                         extra=(f"{len(res.violations)} unique "
+        return False     # the hunt never stops at an event — it
+        #                  collects; should_stop bounds it
+
+    def finalize(res):
+        res.ok = not res.violations
+        res.walkers = sim.walkers
+        if res.violations and res.violated_invariant is None:
+            res.violated_invariant = res.violations[0]["name"]
+        obs.gauge("hunt_unique_violations", len(res.violations))
+
+    from .fleet import drive_rounds
+    return drive_rounds(
+        sim, spec, res, depth=depth, seed=seed, num=num, obs=obs,
+        max_seconds=max_seconds, checkpoint_path=checkpoint_path,
+        resume_from=resume_from, on_chunk=on_chunk,
+        rescue_extra=lambda: {"violations": res.violations,
+                              "dedup": sorted(dedup)},
+        on_resume=on_resume, on_round=on_round,
+        should_stop=lambda: (max_violations is not None
+                             and len(res.violations) >= max_violations),
+        finalize=finalize, elastic=elastic, reshape_rounds=True,
+        progress_extra=lambda: (f"{len(res.violations)} unique "
                                 f"violation(s)"
-                                if res.violations else None))
-            # walker-count elasticity, applied at the round boundary
-            # (rounds restart from init states, so reshaping is free)
-            target = elastic(round_idx) if elastic is not None \
-                else target_walkers
-            if target and int(target) != sim.walkers:
-                old = sim.walkers
-                sim._set_walkers(int(target))
-                target_walkers = sim.walkers
-                obs.hunt_elastic(old, sim.walkers)
-                obs.gauge("walkers", sim.walkers)
-                obs.gauge("mesh_devices", sim.D)
-                if log:
-                    log(f"hunt: fleet reshaped {old} -> "
-                        f"{sim.walkers} walkers")
-    except BaseException:
-        # the crash contract: finalize instrumentation (valid journal
-        # prefix, no run_end) on ANY escaping exception — Preempted
-        # included, whose rescue_checkpoint event is already journaled
-        sim._obs_active = None
-        obs.close()
-        raise
-    res.ok = not res.violations
-    res.walkers = sim.walkers
-    if res.violations and res.violated_invariant is None:
-        res.violated_invariant = res.violations[0]["name"]
-    obs.gauge("hunt_unique_violations", len(res.violations))
-    return obs.finish(res)
+                                if res.violations else None),
+        log=log)
 
 
 def run_hunt_job(spec, *, checkpoint_path=None, journal_path=None,

@@ -240,6 +240,74 @@ def stub_fleet(spec=None, inv_bound=None, inv_x_bound=None,
         min_walkers=kw.pop("min_walkers", 8), **kw)
 
 
+def stub_trace_records(n=8, depth=6, seed=0, spec=None, mutate=None,
+                       drop_vars=(), blank_every=None,
+                       drop_actions=False):
+    """Deterministic TRACE.jsonl records from host random walks of the
+    counter spec — the tier-1 fixture for the batched trace validator
+    (ISSUE 8).  Each record is a full observation of a genuine walk
+    (so it MUST validate) unless mutated:
+
+    * ``mutate=(i, s[, delta])`` corrupts trace i's event s by shifting
+      its first observed variable by ``delta`` (default +7) — off any
+      reachable transition, so the validator must report trace i
+      diverging at EXACTLY event s;
+    * ``drop_vars`` removes variables from every observation and
+      ``blank_every=k`` blanks every k-th event entirely (partial
+      observation: the candidate set grows past 1);
+    * ``drop_actions`` removes the recorded action names.
+    """
+    import random
+    spec = spec or counter_spec()
+    rng = random.Random(seed)
+    drop = set(drop_vars)
+    inits = list(spec.init_states())
+    records = []
+    for i in range(n):
+        st = rng.choice(inits)
+        init = {k: str(v) for k, v in sorted(st.items())
+                if k not in drop}
+        events = []
+        for s in range(depth):
+            succs = list(spec.successors(st))
+            if not succs:
+                break
+            action, st = rng.choice(succs)
+            if blank_every and (s + 1) % blank_every == 0:
+                events.append({})
+                continue
+            ev = {"vars": {k: str(v) for k, v in sorted(st.items())
+                           if k not in drop}}
+            if not drop_actions:
+                ev["action"] = action.name
+            if not ev["vars"]:
+                del ev["vars"]
+            events.append(ev)
+        records.append({"trace": f"t-{i:04d}", "init": init,
+                        "events": events})
+    if mutate is not None:
+        i, s = mutate[0], mutate[1]
+        delta = mutate[2] if len(mutate) > 2 else 7
+        ev = records[i]["events"][s]
+        var = sorted(ev.get("vars") or {"x": "0"})[0]
+        old = int(ev.get("vars", {}).get(var, 0))
+        ev.setdefault("vars", {})[var] = str(old + delta)
+    return records
+
+
+def stub_validator(spec=None, batch=64, n_devices=1, cand_cap=4,
+                   chunk_steps=4, **kw):
+    """A small :class:`tpuvsr.validate.BatchValidator` over the counter
+    spec and the stub kernel — the tier-1 harness for validator
+    determinism, divergence localization, rescue/resume and service
+    tests (ISSUE 8)."""
+    from .validate.batch import BatchValidator
+    return BatchValidator(spec or counter_spec(), batch=batch,
+                          n_devices=n_devices, cand_cap=cand_cap,
+                          chunk_steps=chunk_steps,
+                          model_factory=stub_model_factory(), **kw)
+
+
 def bad_counter_spec():
     """A counter-spec variant that FAILS the speclint frames pass
     (IncX leaves ``y`` unframed) — the admission-rejection fixture for

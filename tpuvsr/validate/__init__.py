@@ -1,0 +1,41 @@
+"""tpuvsr/validate — batched trace validation (ISSUE 8).
+
+Check recorded implementation traces (TRACE.jsonl) against the spec:
+per step the next-state relation is constrained to transitions
+consistent with the recorded event (arxiv 2404.16075), partial
+observations tracked as candidate-state sets.  ``host`` is the
+interpreter reference engine; ``batch`` the vmapped/shard_mapped
+production engine with pipeline dispatch, rescue checkpoints and
+exit-75 resume.  The CLI flag is ``-validate TRACES.jsonl``; the
+dispatch service runs ``kind="validate"`` jobs.
+
+This package's top-level imports stay jax-free (``traces``/``host``)
+so the service's fast verbs can reach the summary helpers; importing
+``BatchValidator``/``batch_validate``/``run_validate_job`` pulls in
+jax lazily via ``tpuvsr.validate.batch``.
+"""
+
+from .host import (HostVerdict, ValidateResult, divergence_record,
+                   host_validate_batch, validate_trace)
+from .traces import (Trace, TraceEvent, load_traces,
+                     record_from_entries, save_traces,
+                     trace_from_record, traces_from_records)
+
+__all__ = [
+    "HostVerdict", "ValidateResult", "divergence_record",
+    "host_validate_batch", "validate_trace",
+    "Trace", "TraceEvent", "load_traces", "record_from_entries",
+    "save_traces", "trace_from_record", "traces_from_records",
+    "BatchValidator", "ObservationUnsupported", "batch_validate",
+    "run_validate_job", "validate_result_summary",
+]
+
+
+def __getattr__(name):
+    if name in ("BatchValidator", "ObservationUnsupported",
+                "batch_validate", "run_validate_job",
+                "validate_result_summary", "traces_digest"):
+        from . import batch
+        return getattr(batch, name)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")
