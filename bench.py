@@ -297,6 +297,33 @@ def main():
     RESULT.setdefault("supervisor", None)
     RESULT["mesh_devices"] = g.get("mesh_devices")
     RESULT["resharded_from"] = g.get("resharded_from")
+    # packed-frontier identity (ISSUE 9): at-rest bytes one frontier
+    # row costs the headline run and the dense/packed ratio;
+    # compare_bench gates on bytes/state regressions (cross-layout
+    # comparisons advisory, like pipeline depth)
+    RESULT["frontier_bytes_per_state"] = g.get(
+        "frontier_bytes_per_state")
+    RESULT["pack_ratio"] = g.get("pack_ratio")
+    # defect-layout sizing (the CAPACITY.md headline — derived from
+    # the in-repo defect cfg, no reference mount needed): the ISSUE 9
+    # acceptance anchor is a >=4x bytes/state cut at MAX_MSGS=48
+    try:
+        from tpuvsr.analysis.passes.widths import derive_ranges_from
+        from tpuvsr.engine.pack import build_pack_spec
+        from tpuvsr.frontend.cfg import parse_cfg_file
+        from tpuvsr.models.vsr import VSRCodec
+        dcfg = parse_cfg_file(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "examples", "VSR_defect.cfg"))
+        dpk = build_pack_spec(
+            VSRCodec(dcfg.constants, max_msgs=48),
+            ranges=derive_ranges_from(dcfg.constants, "VSR"))
+        RESULT["defect_pack"] = {
+            "max_msgs": 48, "dense_bytes": dpk.dense_bytes,
+            "packed_bytes": dpk.packed_bytes,
+            "ratio": round(dpk.ratio, 2)}
+    except Exception as e:           # sizing is advisory, never fatal
+        RESULT["defect_pack"] = {"error": str(e)}
     # second timed run on the same engine: separates machine noise from
     # real throughput (VERDICT r3 item 8 asked the r2->r3 CPU drop be
     # explained with two runs; the identified cause — the CP06 header
@@ -349,11 +376,42 @@ def main():
                 == ab["pipeline2"]["generated"]
             ) if (ab["pipeline1"]["reached_fixpoint"]
                   and ab["pipeline2"]["reached_fixpoint"]) else None
+            # cross-level chaining (ISSUE 9 lever 3): the window that
+            # SURVIVES level boundaries — the host-round-trip-per-level
+            # cost the chunked window still pays disappears
+            if time.time() < DEADLINE - 90:
+                e = DeviceBFS(spec, tile_size=tile,
+                              fpset_capacity=1 << 21,
+                              next_capacity=1 << 15, expand_mult=2,
+                              expand_mults={"ReceiveMatchingSVC": 4,
+                                            "SendDVC": 4},
+                              pipeline=2)
+                e.run_chained(max_depth=6)      # compile + warm
+                r = e.run_chained(max_seconds=max(
+                    30.0, DEADLINE - time.time()))
+                ab["chained"] = {
+                    "distinct": r.distinct_states,
+                    "generated": r.states_generated,
+                    "distinct_per_s": round(
+                        r.distinct_states / r.elapsed, 1),
+                    "elapsed_s": round(r.elapsed, 2),
+                    "reached_fixpoint": r.error is None,
+                }
+                if ab["chained"]["reached_fixpoint"] and \
+                        ab["counts_identical"]:
+                    ab["counts_identical"] = (
+                        ab["chained"]["distinct"]
+                        == ab["pipeline1"]["distinct"]
+                        and ab["chained"]["generated"]
+                        == ab["pipeline1"]["generated"])
             RESULT["pipeline_ab"] = ab
             print(f"bench: pipeline A/B "
                   f"{ab['pipeline1']['distinct_per_s']} -> "
-                  f"{ab['pipeline2']['distinct_per_s']} distinct/s, "
-                  f"counts_identical={ab['counts_identical']}",
+                  f"{ab['pipeline2']['distinct_per_s']} distinct/s"
+                  + (f" -> chained "
+                     f"{ab['chained']['distinct_per_s']}"
+                     if "chained" in ab else "")
+                  + f", counts_identical={ab['counts_identical']}",
                   file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — A/B never kills bench
             RESULT["pipeline_ab"] = {

@@ -35,7 +35,13 @@ def state_bytes(max_msgs):
     codec = VSRCodec(spec.ev.constants, max_msgs=max_msgs)
     z = codec.zero_state()
     per = {k: int(np.prod(np.shape(v)) or 1) * 4 for k, v in z.items()}
-    return sum(per.values()), per, codec.shape
+    # packed bit-planed row (ISSUE 9): the at-rest/wire format the
+    # device engines default to, sized by the widths-pass ranges
+    from tpuvsr.analysis.passes.widths import derive_ranges_from
+    from tpuvsr.engine.pack import build_pack_spec
+    pk = build_pack_spec(
+        codec, ranges=derive_ranges_from(spec.ev.constants, "VSR"))
+    return sum(per.values()), per, codec.shape, pk
 
 
 HBM_PER_CHIP = 16 << 30          # v5e
@@ -45,10 +51,11 @@ LOAD = 0.5                       # max healthy FPSet load factor
 
 rows = []
 for M in (48, 64, 96, 128):
-    sb, per, shape = state_bytes(M)
-    rows.append((M, sb))
+    sb, per, shape, pk = state_bytes(M)
+    rows.append((M, sb, pk.packed_bytes, pk.ratio))
 
-sb48, per48, shape48 = state_bytes(48)
+sb48, per48, shape48, pk48 = state_bytes(48)
+pb48 = pk48.packed_bytes
 
 fp_cap_total = int(CHIPS * HBM_PER_CHIP * 0.5 / FP_SLOT_BYTES * LOAD)
 
@@ -59,11 +66,17 @@ for the defect fixture (`examples/VSR_defect.cfg`); reference baseline:
 multiple days + >=500 GB disk on a large CPU box
 (/root/reference/README.md:20).
 
-## Bytes per dense state (int32 struct-of-arrays)
+## Bytes per state: dense planes vs the packed bit-planed row
 
-| MAX_MSGS | bytes/state |
-|---|---|
-""" + "\n".join(f"| {m} | {b:,} |" for m, b in rows) + f"""
+(dense = int32 struct-of-arrays, one word per field; packed = the
+`engine/pack.py` interchange format the device engines default to —
+per-field bit budgets from the speclint widths pass, `-pack off`
+restores dense)
+
+| MAX_MSGS | dense bytes/state | packed bytes/state | ratio |
+|---|---|---|---|
+""" + "\n".join(f"| {m} | {b:,} | {p:,} | {r:.2f}x |"
+                for m, b, p, r in rows) + f"""
 
 Top contributors at MAX_MSGS=48 (bytes):
 """ + "\n".join(f"- `{k}`: {v:,}"
@@ -80,17 +93,22 @@ MAX_VIEW={shape48.MAX_VIEW}.
   ~**{fp_cap_total / 1e9:.1f} B distinct states** — fingerprint capacity is
   NOT the binding constraint at defect scale (TLC burned 500 GB of disk
   largely on queue/state storage, not fingerprints).
-- **Dense frontier**: the binding constraint.  At ~{sb48 / 1024:.1f} KiB/state
-  (MAX_MSGS=48), one chip's spare ~6 GB holds ~**{6e9 / sb48 / 1e6:.1f} M
-  frontier states** ({CHIPS * 6e9 / sb48 / 1e6:.0f} M mesh-wide); a
-  defect-scale BFS level can exceed that.  Mitigations, in order:
-  1. the frontier/next buffers already stream in tiles — only the FPSet
-     must be resident; frontier tiles can page from host RAM over PCIe
-     at a cost proportional to bytes/state x generated/s;
-  2. bag-slot compression (the m_log plane is {per48['m_log']:,} B/state,
-     {per48['m_log'] / sb48:.0%} of the state — most slots carry no log;
-     a content-addressed side table of distinct logs would cut the
-     frontier footprint by roughly that fraction);
+- **Frontier**: the binding constraint — now measured at the PACKED
+  row size ({pb48} B/state at MAX_MSGS=48, {pk48.ratio:.1f}x denser
+  than the {sb48 / 1024:.1f} KiB dense row): one chip's spare ~6 GB
+  holds ~**{6e9 / pb48 / 1e6:.1f} M frontier states**
+  ({CHIPS * 6e9 / pb48 / 1e6:.0f} M mesh-wide) vs
+  {6e9 / sb48 / 1e6:.1f} M dense; the same factor multiplies paged
+  spill bandwidth and the sharded exchange.  Remaining mitigations:
+  1. **BUILT (r4)**: `engine/paged_bfs.py` pages the frontier through
+     host RAM — with packing the 125 GB host holds ~{125e9 / pb48 / 1e6:.0f} M
+     states ({125e9 / sb48 / 1e6:.0f} M dense);
+  2. bag-slot compression, RE-SCOPED: packing already shrinks the log
+     planes ~16x (an entry packs to 8 bits vs 128 dense), so a
+     content-addressed side table of distinct logs now buys only the
+     residual duplicate-content factor, not the raw
+     {per48['m_log'] / sb48:.0%} the dense m_log plane suggested —
+     it drops below the DCN tier in priority;
   3. sharding the frontier over more hosts (DCN tier).
 - **Trace pointers**: 10 B/state on host; 1e9 states = 10 GB host RAM
   (the 125 GB host holds ~12 B states).

@@ -191,6 +191,36 @@ def gate_validate(base_doc, cand_doc, max_regression):
     return 0
 
 
+def gate_pack(base_doc, cand_doc, max_regression):
+    """The frontier bytes/state regression gate (ISSUE 9): 0
+    ok/advisory/absent, 1 when the candidate's at-rest frontier row
+    GREW beyond tolerance (bytes/state is a cost — the gate direction
+    is inverted vs the throughput gates).  A pack_ratio mismatch
+    between the documents (packing toggled, or a different codec
+    layout entirely) measures different formats, not a regression —
+    advisory, like pipeline depth."""
+    bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
+    if not (bm and cm):
+        return 0
+    b = bm.get("gauges", {}).get("frontier_bytes_per_state")
+    c = cm.get("gauges", {}).get("frontier_bytes_per_state")
+    if b is None or c is None:
+        return 0
+    print(f"frontier_bytes_per_state: baseline {b} -> candidate {c}  "
+          f"[{fmt_delta(b, c)}]")
+    br = bm.get("gauges", {}).get("pack_ratio")
+    cr = cm.get("gauges", {}).get("pack_ratio")
+    if br != cr:
+        print(f"  pack_ratio: {br} -> {cr} (different state formats "
+              f"— comparison is advisory)")
+        return 0
+    if b > 0 and c > b * (1.0 + max_regression / 100.0):
+        print(f"compare_bench: frontier_bytes_per_state GREW beyond "
+              f"{max_regression:.1f}% tolerance", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("baseline")
@@ -270,7 +300,10 @@ def main(argv=None):
     # Always evaluated (not short-circuited) so BOTH regressions are
     # reported in one run
     val_rc = gate_validate(base_doc, cand_doc, args.max_regression)
-    sim_rc = sim_rc or val_rc
+    # at-rest frontier bytes ride the gate too (ISSUE 9): bytes/state
+    # growth fails, cross-format comparisons are advisory
+    pack_rc = gate_pack(base_doc, cand_doc, args.max_regression)
+    sim_rc = sim_rc or val_rc or pack_rc
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch:

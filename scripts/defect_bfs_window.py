@@ -126,12 +126,15 @@ out = {
     "spill_count": eng.spill_count,
     "spill_rows": eng.spill_rows,
     "max_msgs_final": eng.codec.shape.MAX_MSGS,
-    "frontier_bytes_per_state": sum(
-        v.nbytes for v in eng.codec.zero_state().values()),
+    # the packed row when -pack is on (ISSUE 9) — the bytes the paged
+    # tier ACTUALLY moves per state; pack_ratio records the cut
+    "frontier_bytes_per_state": eng._state_row_bytes(),
+    "pack_ratio": round(
+        sum(v.nbytes for v in eng.codec.zero_state().values())
+        / eng._state_row_bytes(), 2),
     "device_bytes_per_s": round(
-        (res.states_generated + res.distinct_states) * sum(
-            v.nbytes for v in eng.codec.zero_state().values())
-        / max(elapsed, 1e-9) / 1e6, 1),
+        (res.states_generated + res.distinct_states)
+        * eng._state_row_bytes() / max(elapsed, 1e-9) / 1e6, 1),
     "depth24_projection": depth24_projection(
         eng.level_sizes, distinct_per_s),
     "violated": res.violated_invariant,

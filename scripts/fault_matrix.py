@@ -13,6 +13,10 @@ reference mount, no TPU, seconds on the CPU backend:
                      rescue checkpoint at the level boundary,
                      Preempted raised; -recover reproduces the
                      uninterrupted run's counts exactly
+  pack-kill-rescue   same kill with the packed frontier ON (ISSUE 9):
+                     the rescue snapshot stores DENSE planes, and both
+                     a packed and a -pack off engine resume it to the
+                     exact fixpoint
   corrupt-ckpt       crash-corrupted snapshot write (payload truncated,
                      .old kept) -> load_checkpoint falls back to .old
                      and the resumed run still reaches the fixpoint
@@ -187,6 +191,53 @@ def scenario_kill_rescue(tmp):
                and "rescue_checkpoint" in ev and "fault" in ev),
         "rescue_depth": preempted.depth,
         "distinct_after_recover": res2.distinct_states,
+    }
+
+
+def scenario_pack_kill_rescue(tmp):
+    """ISSUE 9 satellite: kill mid-run with the packed frontier ON ->
+    rescue checkpoint (stored DENSE, the interchange format), then BOTH
+    a packed and a dense engine resume it to the exact fixpoint — the
+    packed at-rest representation is invisible across the rescue
+    seam."""
+    ORACLE = _oracle()
+    from tpuvsr.obs import RunObserver
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "pack-ck")
+    jp = os.path.join(tmp, "pack.jsonl")
+    faults.install("kill@level=3")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                eng = stub_device_engine()      # pack defaults ON
+                assert eng._pk is not None
+                eng.run(checkpoint_path=ck,
+                        obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    res_packed = stub_device_engine().run(resume_from=ck)
+    res_dense = stub_device_engine(pack=False).run(resume_from=ck)
+    from tpuvsr.obs import read_journal
+    starts = [e for e in read_journal(jp) if e["event"] == "run_start"]
+    return {
+        "ok": (preempted.depth == 3
+               and res_packed.ok and res_dense.ok
+               and res_packed.distinct_states == ORACLE["distinct"]
+               and res_dense.distinct_states == ORACLE["distinct"]
+               and res_packed.levels == ORACLE["levels"]
+               and res_dense.levels == ORACLE["levels"]
+               and all(e.get("pack") for e in starts)),
+        "rescue_depth": preempted.depth,
+        "distinct_packed": res_packed.distinct_states,
+        "distinct_dense": res_dense.distinct_states,
     }
 
 
@@ -626,6 +677,7 @@ SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
     ("kill-rescue", scenario_kill_rescue),
+    ("pack-kill-rescue", scenario_pack_kill_rescue),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),

@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 from tests.conftest import REFERENCE, requires_reference
@@ -446,6 +447,93 @@ def test_drift_clean_on_matching_stub():
     rep = LintReport(module="Toy")
     check_drift(spec, _StubCodec(), _StubKern(), rep)
     assert not rep.findings, [str(f) for f in rep.findings]
+
+
+class _PackCodec(_StubCodec):
+    """Stub codec with packed-frontier bounds (ISSUE 9): `x` claims a
+    3-bit budget, `ghost` a 1-bit one.  TOY's init state (x = 0)
+    encodes in range."""
+
+    def plane_bounds(self, ranges):
+        return {"x": (0, 7), "ghost": (0, 1)}
+
+    def encode(self, st):
+        return {"x": np.int32(int(st["x"])), "ghost": np.int32(0)}
+
+
+def test_pack_drift_clean_on_matching_bounds():
+    from tpuvsr.analysis.passes.drift import check_pack_drift
+    spec = _spec(TOY, "INIT Init\nNEXT Next\n")
+    rep = LintReport(module="Toy")
+    check_pack_drift(spec, _PackCodec(), rep)
+    assert not _fired(rep, "drift", "error"), \
+        [str(f) for f in rep.findings]
+    # the pass reports the packed sizing as an INFO line
+    assert any("round-trip" in f.message
+               for f in _fired(rep, "drift"))
+
+
+def test_pack_drift_fires_on_codec_width_edit():
+    """ISSUE 9 satellite fixture: a codec width/encoding edit WITHOUT
+    a widths-table/bounds edit fails speclint.  Here the codec starts
+    encoding x with a +10 offset (a layout change) while plane_bounds
+    still claims the old 3-bit budget — the init state no longer
+    round-trips the packed format and the drift pass errors instead
+    of letting the engines wrap silently."""
+    from tpuvsr.analysis.passes.drift import check_pack_drift
+
+    class Edited(_PackCodec):
+        def encode(self, st):
+            return {"x": np.int32(int(st["x"]) + 10),
+                    "ghost": np.int32(0)}
+    spec = _spec(TOY, "INIT Init\nNEXT Next\n")
+    rep = LintReport(module="Toy")
+    check_pack_drift(spec, Edited(), rep)
+    errs = _fired(rep, "drift", "error")
+    assert any(f.subject == "x" and "round-trip" in f.message
+               for f in errs), [str(f) for f in rep.findings]
+
+
+def test_pack_drift_fires_on_stale_bound_key_and_bad_arity():
+    from tpuvsr.analysis.passes.drift import check_pack_drift
+
+    class StaleKey(_PackCodec):
+        def plane_bounds(self, ranges):
+            return {"x": (0, 7), "gone": (0, 1)}   # renamed plane
+    spec = _spec(TOY, "INIT Init\nNEXT Next\n")
+    rep = LintReport(module="Toy")
+    check_pack_drift(spec, StaleKey(), rep)
+    assert any(f.subject == "gone"
+               for f in _fired(rep, "drift", "error"))
+
+    class BadArity(_PackCodec):
+        def zero_state(self):
+            return {"x": 0, "ghost": np.zeros((2, 3), np.int32)}
+
+        def plane_bounds(self, ranges):
+            # per-column list with the wrong arity for ghost's last
+            # axis (2 entries vs 3 columns)
+            return {"x": (0, 7), "ghost": [(0, 1), (0, 1)]}
+    rep2 = LintReport(module="Toy")
+    check_pack_drift(spec, BadArity(), rep2)
+    assert any("drifted" in f.message
+               for f in _fired(rep2, "drift", "error"))
+
+
+def test_pack_drift_fires_on_zero_row_exclusion():
+    """Bounds whose lower end excludes 0 break the all-zero padding
+    row every growth path re-packs — the pass must catch it."""
+    from tpuvsr.analysis.passes.drift import check_pack_drift
+
+    class NoZero(_PackCodec):
+        def plane_bounds(self, ranges):
+            return {"x": (1, 8), "ghost": (0, 1)}  # 0 not encodable
+    spec = _spec(TOY, "INIT Init\nNEXT Next\n")
+    rep = LintReport(module="Toy")
+    check_pack_drift(spec, NoZero(), rep)
+    assert any(f.subject == "x" and "zero row" in f.message
+               for f in _fired(rep, "drift", "error")), \
+        [str(f) for f in rep.findings]
 
 
 def test_drift_kernel_key_tables_cover_all_registered_layouts():

@@ -108,12 +108,30 @@ def test_cli_sharded_flag_conflicts_exit_2(bad):
     assert "usage" in r.stderr or "error" in r.stderr
 
 
+@pytest.mark.parametrize("bad", [
+    ["-pack", "on", "-engine", "interp"],
+    ["-pack", "on", "-fpset", "host"],
+    ["-pack", "maybe"],
+], ids=["interp", "fpset-host", "bad-mode"])
+def test_cli_pack_flag_conflicts_exit_2(bad):
+    """ISSUE 9 satellite: explicit -pack on needs a device engine (the
+    packed frontier is the device engines' interchange format); the
+    conflicts are argparse errors before any spec is loaded."""
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
 @pytest.mark.parametrize("good", [
     ["-supervise", "-engine", "sharded"],
     ["-engine", "sharded", "-supervise", "-inject", "oom@shard=0"],
     ["-engine", "sharded", "-inject", "exchange-drop:3@shard=0"],
     ["-engine", "sharded", "-recover", "/nonexistent-ckpt"],
-], ids=["supervise", "supervise-oom-shard", "drop-count", "recover"])
+    ["-pack", "on", "-engine", "sharded"],
+    ["-pack", "off", "-engine", "interp"],
+    ["-pack", "off", "-fpset", "host"],
+], ids=["supervise", "supervise-oom-shard", "drop-count", "recover",
+        "pack-sharded", "pack-off-interp", "pack-off-fpset-host"])
 def test_cli_sharded_valid_combos_pass_parsing(good):
     """Valid sharded combinations get past flag validation: the run
     fails on the nonexistent spec path (not exit 2)."""

@@ -364,7 +364,11 @@ def test_stub_paged_bfs_spill_events(tmp_path):
     events = read_journal(jp)
     spills = [e for e in events if e["event"] == "spill"]
     assert spills, "paged run must journal its host page-outs"
-    assert all(e["bytes"] == e["rows"] * 16 for e in spills)  # 4 planes
+    # bytes reflect REAL transfer volume: the packed row (ISSUE 9; the
+    # stub layout packs 4 dense planes into one uint32 word)
+    rb = eng._state_row_bytes()
+    assert rb == 4 and eng._pk is not None
+    assert all(e["bytes"] == e["rows"] * rb for e in spills)
     doc = validate_metrics(res.metrics)
     assert doc["counters"]["spill_rows"] == sum(
         e["rows"] for e in spills)

@@ -642,7 +642,7 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["ok"] and len(out["scenarios"]) == 15
+    assert out["ok"] and len(out["scenarios"]) == 16
 
 
 # ---------------------------------------------------------------------

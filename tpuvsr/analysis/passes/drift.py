@@ -24,7 +24,17 @@ Cross-checks, per registered module:
   AUX_KEYS and, where present, GLOBAL_KEYS) must exactly cover the
   codec's ``zero_state`` planes: a plane the kernel does not hash is
   invisible to fingerprinting (ERROR), a key without a plane is a
-  stale layout reference (ERROR).
+  stale layout reference (ERROR);
+* packed-frontier bounds (ISSUE 9) — the codec's ``plane_bounds``
+  tables feed the engine/pack bit budgets, and the widths-pass range
+  table is their single source of truth.  A codec width/layout edit
+  that is not reflected in the bounds packs real values into too few
+  bits and wraps silently, so the pass cross-checks: bound keys must
+  name real ``zero_state`` planes (stale reference: ERROR),
+  per-column bound arity must match the plane shape (ERROR, surfaced
+  from build_pack_spec), the all-zero padding row and every encoded
+  init state must round-trip the packed format EXACTLY (a wrap here
+  is a bound that no longer covers the layout: ERROR).
 """
 
 from __future__ import annotations
@@ -77,6 +87,7 @@ def run(spec, report):
                    f"could not run")
         return
     check_drift(spec, codec, kern, report)
+    check_pack_drift(spec, codec, report)
 
 
 def check_drift(spec, codec, kern, report):
@@ -137,3 +148,66 @@ def check_drift(spec, codec, kern, report):
         report.add(PASS, SEV_ERROR, k,
                    "kernel key table names a plane the codec layout "
                    "does not allocate (stale layout reference)")
+
+
+def check_pack_drift(spec, codec, report):
+    """Packed-frontier bound drift (ISSUE 9 satellite).  Split out
+    from ``run`` so tests can drive it with a deliberately-stale stub
+    codec (the fixture: a codec width edit WITHOUT a widths-table /
+    bounds edit must fail speclint, not wrap at runtime)."""
+    import numpy as np
+
+    if not hasattr(codec, "plane_bounds"):
+        report.add(PASS, SEV_INFO, spec.module.name,
+                   "codec declares no plane_bounds; the packed "
+                   "frontier runs at ratio 1.0 (no bit budgets to "
+                   "cross-check)")
+        return
+    from ...engine.pack import build_pack_spec
+    from .widths import derive_ranges
+    ranges = derive_ranges(spec)
+    planes = set(codec.zero_state().keys())
+    for k in sorted(set(codec.plane_bounds(ranges)) - planes):
+        report.add(PASS, SEV_ERROR, k,
+                   "plane_bounds names a plane the codec layout does "
+                   "not allocate (stale packing reference)")
+    try:
+        pk = build_pack_spec(codec, ranges=ranges)
+    except TLAError as e:
+        report.add(PASS, SEV_ERROR, spec.module.name,
+                   f"packing-spec construction failed ({e}) — the "
+                   f"plane_bounds tables have drifted from the dense "
+                   f"layout")
+        return
+
+    def roundtrip_errors(row, what):
+        batch = {k: np.asarray(v)[None] for k, v in row.items()}
+        rt = pk.unpack_np(pk.pack_np(batch))
+        bad = sorted(k for k in batch
+                     if not np.array_equal(batch[k], rt[k]))
+        for k in bad:
+            report.add(PASS, SEV_ERROR, k,
+                       f"{what} does not round-trip the packed "
+                       f"format (plane {k!r}: a value lies outside "
+                       f"its declared bit budget and would wrap "
+                       f"silently) — the codec layout has drifted "
+                       f"from its plane_bounds / the widths table")
+        return bad
+
+    # the all-zero row is the padding every growth path re-packs;
+    # a bound excluding 0 breaks pad_msgs/_grow_msgs invisibly
+    zero = codec.zero_state()
+    if roundtrip_errors({k: np.asarray(v, np.int32)
+                         for k, v in zero.items()}, "the zero row"):
+        return
+    ok = 0
+    for i, st in enumerate(spec.init_states()):
+        if i >= 64:
+            break                  # static smoke, not an enumeration
+        if roundtrip_errors(codec.encode(st), f"init state {i}"):
+            return
+        ok += 1
+    report.add(PASS, SEV_INFO, spec.module.name,
+               f"packed layout {pk.packed_bytes} B/state "
+               f"({pk.ratio:.2f}x vs dense); zero row and {ok} init "
+               f"state(s) round-trip exactly")

@@ -110,7 +110,17 @@ FAMILY_PLANES = {
 def derive_ranges(spec):
     """Interval ranges of the protocol quantities, from cfg constants
     alone.  Returns {} entries only for derivable quantities."""
-    c = spec.ev.constants
+    return derive_ranges_from(spec.ev.constants, spec.module.name)
+
+
+def derive_ranges_from(constants, module_name):
+    """``derive_ranges`` without a SpecModel: the same table from a
+    bare constants dict + module name.  This is what the packed
+    frontier encoding (engine/pack.py, ISSUE 9) builds its per-plane
+    bit budgets from — the ranges this pass VERIFIES are the single
+    source of truth for field widths, so capacity tooling and codec
+    ``plane_bounds`` can derive them without parsing a .tla module."""
+    c = constants
     rng = {}
 
     def geti(name, default=None):
@@ -128,7 +138,7 @@ def derive_ranges(spec):
 
     if timer is not None:
         extra = restarts or 0
-        if spec.module.name != "VSR":
+        if module_name != "VSR":
             extra = 0          # only VSR's RestartEmpty re-mints views
         rng["view_number"] = (0, 1 + timer + extra)
     if nvalues is not None:

@@ -77,14 +77,26 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    level-boundary snapshots and SIGTERM rescues work;
                    a supervised resume continues through the chunked
                    engine)
-  -pipeline K      device/paged BFS dispatch window: keep up to K
-                   level-kernel dispatches in flight, blocking only on
-                   the oldest, so host-side work (journal, metrics,
-                   spill compaction, checkpoint staging) overlaps
-                   device compute (default 2; 1 = the synchronous
+  -pipeline K      device/paged/sharded BFS dispatch window: keep up
+                   to K level-kernel dispatches in flight, blocking
+                   only on the oldest, so host-side work (journal,
+                   metrics, spill compaction, checkpoint staging)
+                   overlaps device compute (default 2 on every device
+                   engine — the sharded step donates its buffers since
+                   ISSUE 9, so the old K-generations-in-HBM cost of a
+                   sharded window is gone; 1 = the synchronous
                    pre-pipeline behavior).  Counts, level sizes and
                    violation traces are bit-identical for every K
                    (README "Pipelining")
+  -pack MODE       on | off (default on): packed bit-planed frontier
+                   encoding (engine/pack.py) — the at-rest frontier,
+                   host spill pages and the sharded exchange move
+                   ceil(total_bits/32) uint32 words per state instead
+                   of one word per field, with the per-field bit
+                   budgets taken from the speclint widths pass.
+                   Results are bit-identical on/off (README "Packed
+                   frontier").  Device engines only: explicit -pack on
+                   with -engine interp/-fpset host is an error
   -lint            run the speclint static analyzer (tpuvsr/analysis)
                    over the bound spec and exit: 0 clean/warnings,
                    1 errors.  With -json the report is one JSON object.
@@ -139,6 +151,9 @@ whose rescue quantum makes fused snapshots possible); -fpset host with
 non-auto -fpset (its fingerprint set is always the mesh-sharded HBM
 table); -walkers/-split/-hunt without -simulate, or with
 -engine interp/-fpset host (the fleet is a device backend);
+explicit -pack on with -engine interp/-fpset host (the packed
+frontier is a device-engine format; the interpreter has no dense
+frontier to pack);
 -validate with -simulate/-hunt/-fused/-supervise/-deadlock/
 -maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
 is its own engine mode: rescue checkpoints are preemption-driven, the
@@ -237,11 +252,18 @@ def build_parser():
     p.add_argument("-pipeline", type=int, default=None, metavar="K",
                    help="device/paged/sharded BFS dispatch window: "
                         "keep K level-kernel dispatches in flight, "
-                        "blocking only on the oldest (default 2; the "
-                        "sharded engine defaults to 1 — its step has "
-                        "no buffer donation, so K>1 holds K buffer "
-                        "generations in HBM; 1 = synchronous).  "
+                        "blocking only on the oldest (default 2 on "
+                        "every device engine — the sharded step "
+                        "donates its buffers; 1 = synchronous).  "
                         "Results are bit-identical for every K")
+    p.add_argument("-pack", choices=["on", "off"], default=None,
+                   metavar="MODE",
+                   help="packed bit-planed frontier encoding "
+                        "(default on for the device engines): the "
+                        "at-rest frontier / spill pages / sharded "
+                        "exchange move packed uint32 word planes "
+                        "sized by the speclint widths pass.  Results "
+                        "are bit-identical on/off")
     p.add_argument("-lower", action="store_true",
                    help="compile the device kernel's guards/actions/"
                         "invariants from the spec AST (tpuvsr/lower) "
@@ -331,6 +353,12 @@ def validate_args(parser, args):
         parser.error("-supervise needs the device/paged/sharded "
                      "engine (the interpreter has no "
                      "checkpoint/degrade ladder)")
+    if args.pack == "on" and (args.engine == "interp"
+                              or args.fpset == "host"):
+        parser.error("-pack on needs a device engine (the packed "
+                     "frontier is the device engines' interchange "
+                     "format; the interpreter has no dense frontier "
+                     "to pack)")
     if args.validate is not None:
         # trace validation is its own engine mode (ISSUE 8): the
         # check/simulate mode switches and their engine shapes don't
@@ -558,9 +586,14 @@ def main(argv=None):
 
     engine = _pick_engine(args.engine, args.fpset, spec)
     if args.pipeline is None:
-        # the sharded dispatch window is opt-in (its step has no
-        # buffer donation, so K>1 holds K buffer generations in HBM)
-        args.pipeline = 1 if engine == "sharded" else 2
+        # default 2 on every device engine (ISSUE 9: the sharded step
+        # now donates its buffers, so the K-generations-in-HBM cost
+        # that made its window opt-in is gone)
+        args.pipeline = 2
+    # packed frontier (ISSUE 9): default on for device engines ("auto"
+    # packs whenever the codec declares plane_bounds — every
+    # registered layout); -pack off runs the dense format
+    pack_kw = False if args.pack == "off" else "auto"
 
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
@@ -686,7 +719,8 @@ def main(argv=None):
                     # -fused under -supervise: rescue-quantum-bounded
                     # fused dispatches; resume continues chunked
                     fused=args.fused and engine == "device",
-                    engine_kwargs={"pipeline": args.pipeline})
+                    engine_kwargs={"pipeline": args.pipeline,
+                                   "pack": pack_kw})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -711,7 +745,8 @@ def main(argv=None):
                 from ..parallel.sharded_bfs import ShardedBFS
                 mesh = Mesh(np.array(jax.devices()), ("d",))
                 log(f"sharded mesh: {mesh.shape['d']} devices")
-                eng = ShardedBFS(spec, mesh, pipeline=args.pipeline)
+                eng = ShardedBFS(spec, mesh, pipeline=args.pipeline,
+                                 pack=pack_kw)
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
@@ -732,11 +767,13 @@ def main(argv=None):
                     not spec.symmetry_perms
                 if want_graph:
                     eng = PagedBFS(spec, retain_levels=True,
-                                   pipeline=args.pipeline)
+                                   pipeline=args.pipeline,
+                                   pack=pack_kw)
                 else:
                     eng = (PagedBFS if engine == "paged"
                            else DeviceBFS)(spec,
-                                           pipeline=args.pipeline)
+                                           pipeline=args.pipeline,
+                                           pack=pack_kw)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:

@@ -91,12 +91,20 @@ def _fsync_path(path):
 def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
-                    elapsed, digest=None, extra=None, obs=None):
+                    elapsed, digest=None, extra=None, pack=None,
+                    obs=None):
     """Write a complete engine snapshot to `path` (atomic + durable).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
     concatenated host trace-pointer arrays; `init_dense` is the dense
-    encoding of the (deduped) initial states, in gid order."""
+    encoding of the (deduped) initial states, in gid order.
+
+    `pack` is the packed-frontier spec manifest the writing engine ran
+    under (engine/pack.PackSpec.manifest(); None = packing off).  The
+    frontier payload itself is ALWAYS dense planes — the interchange
+    format any engine/pack configuration can resume — but the manifest
+    records the spec version so resuming under a MISMATCHED widths
+    table is a loud policy error (ISSUE 9 satellite)."""
     from ..resilience.faults import fault_point
     tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
@@ -131,6 +139,9 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         "elapsed": float(elapsed),
         "spec_digest": digest,
         "payload_crc32": crcs,
+        # packed-frontier spec identity (ISSUE 9): version digest +
+        # plane table of the writer's packing spec, None when dense
+        "pack": pack,
         # engine-specific payload (e.g. the sharded driver's per-shard
         # frontier counts and exchange capacities)
         "extra": extra,
@@ -298,5 +309,6 @@ def load_checkpoint(path, expect_digest=None, log=None):
         "expand_mults": manifest["expand_mults"],
         "elapsed": manifest["elapsed"],
         "extra": manifest.get("extra"),
+        "pack": manifest.get("pack"),
         "restored_from": used,
     }

@@ -95,7 +95,8 @@ class DeviceBFS:
     def __init__(self, spec: SpecModel, max_msgs=None, tile_size=128,
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
-                 expand_mults=None, model_factory=None, pipeline=2):
+                 expand_mults=None, model_factory=None, pipeline=2,
+                 pack="auto"):
         if (tile_size > MAX_VALIDATED_TPU_TILE
                 and os.environ.get("TPUVSR_UNSAFE_TILE") != "1"
                 and jax.default_backend() != "cpu"):
@@ -127,6 +128,13 @@ class DeviceBFS:
         # is the hand-kernel registry, tests/the CLI can pass the
         # AST-compiled factory (lower/compile.make_compiled_model)
         self._model_factory = model_factory or registry.make_model
+        # packed frontier encoding (ISSUE 9): "auto" packs whenever the
+        # codec declares plane_bounds (every registered layout + the
+        # stub harness); False runs dense; True forces the interchange
+        # format even without bounds (ratio 1.0).  Results are
+        # bit-identical either way — the pack/unpack round trip is
+        # exact for in-range values, which the widths lint pass proves.
+        self._pack_req = pack
         registry.ensure_compile_cache()
         self.debug_checks = registry.ensure_debug_flags()
         self._build(max_msgs)
@@ -153,9 +161,18 @@ class DeviceBFS:
         self.L = self.kern.n_lanes
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}          # action id -> jitted single-action fn
+        # packed-frontier spec for THIS codec binding (rebuilt with the
+        # codec on bag growth: MAX_MSGS changes the lane count)
+        from .pack import build_pack_spec
+        if self._pack_req is False:
+            self._pk = None
+        else:
+            self._pk = build_pack_spec(self.codec, spec=spec,
+                                       force=self._pack_req is True)
         self._level = jax.jit(self._make_level(),
                               donate_argnums=(0, 4, 5, 6, 7))
         self._ml = None         # fused pass, built lazily (run_fused)
+        self._wl = None         # chained window pass (run_chained)
         # obs accounting: the first dispatch after a (re)jit is charged
         # to the "compile" phase (jit traces+compiles at first call)
         self._fresh_jit = True
@@ -165,9 +182,17 @@ class DeviceBFS:
         level pass (_make_level) and the fused multi-level pass
         (_make_multilevel).  Returns (caps, total_E, make_body) where
         make_body(frontier, n_front, want_deadlock) closes over the
-        (possibly traced) frontier and count."""
+        (possibly traced) frontier and count.
+
+        Packed frontier (ISSUE 9): with a pack spec bound, the at-rest
+        frontier and next buffers are ``[cap, words]`` uint32 planes —
+        the body unpacks a tile on entry and packs successors on exit,
+        so the expansion/fingerprint/invariant pipeline in between is
+        UNCHANGED and results stay bit-identical with packing on/off
+        (the pack/unpack round trip is exact for in-range values)."""
         kern = self.kern
         inv = self._inv
+        pk = self._pk
         T = self.tile
         incremental = self.hash_mode == "incremental"
 
@@ -179,15 +204,22 @@ class DeviceBFS:
         total_E = sum(caps)
 
         def make_body(frontier, n_front, want_deadlock):
-            F_cap = frontier["status"].shape[0]
+            F_cap = (frontier.shape[0] if pk is not None
+                     else frontier["status"].shape[0])
 
             def body(c):
                 t = c["t"]
                 base = t * T
                 sidx = base + jnp.arange(T, dtype=I32)
                 valid = sidx < n_front
-                tile = {k: v[jnp.clip(sidx, 0, F_cap - 1)]
-                        for k, v in frontier.items()}
+                if pk is not None:
+                    # packed at-rest frontier: gather [T, words] rows,
+                    # unpack to the dense tile the kernel consumes
+                    tile = jax.vmap(pk.unpack)(
+                        frontier[jnp.clip(sidx, 0, F_cap - 1)])
+                else:
+                    tile = {k: v[jnp.clip(sidx, 0, F_cap - 1)]
+                            for k, v in frontier.items()}
                 if incremental:
                     parts = jax.vmap(kern.parent_parts)(tile)
 
@@ -289,8 +321,15 @@ class DeviceBFS:
                     slots = tbl["slots"]
                     dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1,
                                      N_cap).astype(I32)
-                    for k in nb:
-                        nb[k] = nb[k].at[dest].set(succ_f[k], mode="drop")
+                    if pk is not None:
+                        # pack successors on exit: the next buffer holds
+                        # [words] uint32 rows, not dense planes
+                        nb = nb.at[dest].set(jax.vmap(pk.pack)(succ_f),
+                                             mode="drop")
+                    else:
+                        for k in nb:
+                            nb[k] = nb[k].at[dest].set(succ_f[k],
+                                                       mode="drop")
                     nbp = nbp.at[dest].set(base + pidx, mode="drop")
                     nba = nba.at[dest].set(aid, mode="drop")
                     nbprm = nbprm.at[dest].set(lane_sel, mode="drop")
@@ -387,13 +426,22 @@ class DeviceBFS:
                        tpp, tpa, tpm, lvl_buf,
                        n_front, start_t, nn0, gen_level0, depth0,
                        level_base0, fp_count0,
-                       want_deadlock, max_depth, max_states, max_lvls):
+                       want_deadlock, max_depth, max_states, max_lvls,
+                       tiles0, tile_budget):
             F_cap = nbp.shape[0]
             TP_CAP = tpp.shape[0]
             LVL_CAP = lvl_buf.shape[0]
             # max_lvls (traced, <= LVL_CAP) bounds levels per dispatch
             # so the host can check wall-clock budgets between
-            # dispatches without recompiling
+            # dispatches without recompiling.  tile_budget (traced)
+            # bounds the COMMITTED TILES per dispatch instead — the
+            # cross-level chaining mode (run_chained, ISSUE 9) gives
+            # each dispatch a chunk-sized budget and keeps a K-deep
+            # window of them in flight; the fused mode passes 2^31-1
+            # so its behavior is unchanged.  A budget boundary can land
+            # MID-LEVEL (start_t/nn/gen_level carry the partial level,
+            # exactly like a growth pause), so the window never drains
+            # at a level transition.
             idx = jnp.arange(F_cap, dtype=I32)
 
             def ocond(c):
@@ -401,6 +449,7 @@ class DeviceBFS:
                         & (c["depth"] < max_depth)
                         & (c["fp_count"] < max_states)
                         & (c["lvl_cur"] < max_lvls)
+                        & (c["tiles"] < tile_budget)
                         & (c["level_base"] + c["n_front"] + F_cap
                            <= TP_CAP))
 
@@ -408,9 +457,18 @@ class DeviceBFS:
                 n_front_l = c["n_front"]
                 n_tiles = (n_front_l + T - 1) // T
                 body = make_body(c["front"], n_front_l, want_deadlock)
+                # remaining per-dispatch tile budget, as an inner
+                # bound.  Saturated: the fused mode's 2^31-1 sentinel
+                # budget added to a carried start_t > 0 (a re-entry
+                # after a mid-level growth pause) must not wrap int32
+                # — a wrapped-negative t_stop would make the inner
+                # loop a permanent no-op and hang the outer fixpoint
+                t_stop = c["start_t"] + jnp.minimum(
+                    tile_budget - c["tiles"], jnp.int32(1 << 30))
 
                 def icond(ic):
-                    return (ic["t"] < n_tiles) & (ic["reason"] == RUNNING)
+                    return ((ic["t"] < n_tiles) & (ic["t"] < t_stop)
+                            & (ic["reason"] == RUNNING))
 
                 iinit = {
                     "t": c["start_t"],
@@ -427,7 +485,10 @@ class DeviceBFS:
                     "act": c["act"],
                 }
                 r = jax.lax.while_loop(icond, body, iinit)
-                committed = r["reason"] == RUNNING
+                # level committed only when every tile ran; a budget
+                # stop mid-level exits the outer loop with the partial
+                # (start_t, nn, gen_level) carried — no swap
+                committed = (r["reason"] == RUNNING) & (r["t"] >= n_tiles)
                 n_next = r["nn"]
                 # gids of the completed level start right after the
                 # current frontier's; stable across pause/resume since
@@ -474,6 +535,7 @@ class DeviceBFS:
                                             c["level_base"]),
                     "fp_count": c["fp_count"] + r["dist"],
                     "lvl_cur": c["lvl_cur"] + jnp.where(record, 1, 0),
+                    "tiles": c["tiles"] + (r["t"] - c["start_t"]),
                     "reason": r["reason"],
                     "viol": r["viol"], "dead": r["dead"],
                     "grow_aid": r["grow_aid"],
@@ -493,6 +555,7 @@ class DeviceBFS:
                 "level_base": jnp.asarray(level_base0, I32),
                 "fp_count": jnp.asarray(fp_count0, I32),
                 "lvl_cur": jnp.asarray(0, I32),
+                "tiles": jnp.asarray(tiles0, I32),
                 "reason": jnp.asarray(RUNNING, I32),
                 "viol": jnp.full((3,), -1, I32),
                 "dead": jnp.asarray(-1, I32),
@@ -510,31 +573,103 @@ class DeviceBFS:
         """Double MAX_MSGS in place: all-zero padding slots change no
         fingerprint (only present slots contribute to the bag hash), so
         the FPSet and every recorded trace pointer stay valid.  Pads the
-        given on-device state pytrees and rebuilds the jitted passes."""
+        given on-device state pytrees and rebuilds the jitted passes.
+
+        Packed buffers round-trip through the OLD pack spec to dense,
+        pad, and re-pack under the rebuilt spec (MAX_MSGS changes both
+        the lane count and the spec version); unused zero rows are
+        stable under the round trip, so the whole buffer converts."""
         old = self.codec.shape.MAX_MSGS
+        old_pk = self._pk
+        if old_pk is not None:
+            dense = [old_pk.unpack_np(np.asarray(d))
+                     for d in device_states]
+            self._build(old * 2)
+            dense = [self.codec.pad_msgs(d, old) for d in dense]
+            return [jnp.asarray(self._pk.pack_np(d)) for d in dense]
         self._build(old * 2)
         return [self.codec.pad_msgs(d, old) for d in device_states]
 
     @staticmethod
-    def _grow_next(bufs, factor=4):
+    def _pad_rows(buf, add):
+        """Append `add` zero rows to a frontier-format buffer (dense
+        plane dict or packed [cap, words] array)."""
+        def padv(v):
+            shape = (add,) + v.shape[1:]
+            return jnp.concatenate([v, jnp.zeros(shape, v.dtype)])
+        if isinstance(buf, dict):
+            return {k: padv(v) for k, v in buf.items()}
+        return padv(buf)
+
+    @classmethod
+    def _grow_next(cls, bufs, factor=4):
         """Enlarge the next-frontier buffer set, preserving contents."""
         nb, nbp, nba, nbprm = bufs
         cap = nbp.shape[0]
-        new = cap * factor
-
-        def padv(v):
-            shape = (new - cap,) + v.shape[1:]
-            return jnp.concatenate([v, jnp.zeros(shape, v.dtype)])
-        return ({k: padv(v) for k, v in nb.items()},
-                padv(nbp), padv(nba), padv(nbprm))
+        add = cap * (factor - 1)
+        return (cls._pad_rows(nb, add), cls._pad_rows(nbp, add),
+                cls._pad_rows(nba, add), cls._pad_rows(nbprm, add))
 
     # ------------------------------------------------------------------
     def _alloc_bufs(self, cap):
-        zero = self.codec.zero_state()
-        nb = {k: jnp.zeros((cap,) + np.shape(v), np.int32)
-              for k, v in zero.items()}
+        if self._pk is not None:
+            nb = jnp.zeros((cap, self._pk.words), jnp.uint32)
+        else:
+            zero = self.codec.zero_state()
+            nb = {k: jnp.zeros((cap,) + np.shape(v), np.int32)
+                  for k, v in zero.items()}
         return (nb, jnp.zeros((cap,), I32), jnp.zeros((cap,), I32),
                 jnp.zeros((cap,), I32))
+
+    def _set_rows(self, buf, batch, n):
+        """Write the first `n` rows of a dense host batch into a
+        frontier-format buffer (packing them when the buffer is
+        packed)."""
+        if self._pk is not None:
+            return buf.at[:n].set(jnp.asarray(self._pk.pack_np(batch)))
+        return {k: buf[k].at[:n].set(jnp.asarray(batch[k]))
+                for k in buf}
+
+    def _dense_rows(self, buf, n):
+        """First `n` rows of a frontier-format buffer as a dense host
+        plane dict (the checkpoint interchange format: snapshots always
+        store DENSE planes so any engine/pack configuration can resume
+        them)."""
+        if self._pk is not None:
+            return self._pk.unpack_np(np.asarray(buf[:n]))
+        return {k: np.asarray(v[:n]) for k, v in buf.items()}
+
+    def _pack_manifest(self):
+        return self._pk.manifest() if self._pk is not None else None
+
+    def _check_pack_manifest(self, ck, path):
+        """Resume-seam policy (ISSUE 9 satellite): a snapshot records
+        the packing-spec version it was written under; resuming with a
+        MISMATCHED widths table is a loud policy error, not a silent
+        re-encode — a drifted widths table means the run would pack
+        fields into different budgets than the ones speclint verified
+        for the snapshot's trajectory.  pack=off on either side is
+        compatible by construction (snapshots store dense planes)."""
+        ckpk = ck.get("pack")
+        if ckpk and self._pk is not None and \
+                ckpk.get("version") != self._pk.version:
+            raise TLAError(
+                f"checkpoint {path} was written under packing spec "
+                f"{ckpk.get('version')} but this engine derives "
+                f"{self._pk.version} from its widths table; refusing "
+                f"to resume (rebuild with the matching spec/.cfg or "
+                f"pass pack=False)")
+
+    def _pack_gauges(self, obs):
+        """frontier_bytes_per_state / pack_ratio (ISSUE 9 satellite):
+        the at-rest bytes one frontier row costs this run, and the
+        dense/packed ratio (1.0 when packing is off)."""
+        zero = self.codec.zero_state()
+        dense = sum(int(np.prod(np.shape(v)) or 1) * 4
+                    for v in zero.values())
+        packed = self._pk.packed_bytes if self._pk is not None else dense
+        obs.gauge("frontier_bytes_per_state", int(packed))
+        obs.gauge("pack_ratio", round(dense / packed, 3))
 
     def _register_init(self, res):
         """Encode, dedup, and FPSet-register the initial states; seed
@@ -586,6 +721,7 @@ class DeviceBFS:
         obs = RunObserver.ensure(obs, "device", self.spec, log=log,
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
+        obs.pack = self._pk is not None
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
@@ -618,6 +754,7 @@ class DeviceBFS:
                     self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
                 codec = self.codec
+            self._check_pack_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
             self._init_dense = ck["init_dense"]
@@ -635,8 +772,7 @@ class DeviceBFS:
             n_front = ck["n_front"]
             f_cap = max(self.next_cap, n_front)
             front, fpar, fact, fprm = self._alloc_bufs(f_cap)
-            front = {k: front[k].at[:n_front].set(
-                jnp.asarray(ck["frontier"][k])) for k in front}
+            front = self._set_rows(front, ck["frontier"], n_front)
             bufs = self._alloc_bufs(self.next_cap)
             level_base = sum(self.level_sizes[:-1])
             emit(f"resumed from {resume_from}: depth {depth}, "
@@ -656,8 +792,7 @@ class DeviceBFS:
             # --- device frontier + next buffers -----------------------
             f_cap = max(self.next_cap, n0)
             front, fpar, fact, fprm = self._alloc_bufs(f_cap)
-            front = {k: front[k].at[:n0].set(init_batch[k])
-                     for k in front}
+            front = self._set_rows(front, init_batch, n0)
             bufs = self._alloc_bufs(self.next_cap)
             n_front = n0
             level_base = 0          # gid of frontier[0]
@@ -881,7 +1016,8 @@ class DeviceBFS:
                     self._flush_pointers()
                     save_checkpoint(
                         checkpoint_path,
-                        slots=table["slots"], frontier=front,
+                        slots=table["slots"],
+                        frontier=self._dense_rows(front, n_next),
                         n_front=n_next,
                         h_parent=np.concatenate(self._h_parent),
                         h_action=np.concatenate(self._h_action),
@@ -893,7 +1029,8 @@ class DeviceBFS:
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
-                        digest=spec_digest(spec), obs=obs)
+                        digest=spec_digest(spec),
+                        pack=self._pack_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
@@ -932,6 +1069,8 @@ class DeviceBFS:
         stay inside the statically derived ranges (the widths lint
         pass).  Catches packed-field wrap the moment it happens instead
         of as a fingerprint anomaly millions of states later."""
+        if self._pk is not None:
+            front = self._pk.unpack_np(np.asarray(front[:n_front]))
         if not hasattr(self, "_debug_bounds"):
             from ..analysis.passes.widths import derive_ranges
             rng = derive_ranges(self.spec)
@@ -976,6 +1115,7 @@ class DeviceBFS:
         preflight(self.spec, log=log)   # fail fast, before any dispatch
         obs = RunObserver.ensure(obs, "device-fused", self.spec, log=log)
         obs.pipeline = 1                # one fused dispatch in flight
+        obs.pack = self._pk is not None
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
@@ -995,7 +1135,7 @@ class DeviceBFS:
         # ping-pong buffers share one capacity in fused mode
         f_cap = max(self.next_cap, n0)
         front, nbp, nba, nbprm = self._alloc_bufs(f_cap)
-        front = {k: front[k].at[:n0].set(init_batch[k]) for k in front}
+        front = self._set_rows(front, init_batch, n0)
         nb, _, _, _ = self._alloc_bufs(f_cap)
         tp_cap = max(4 * f_cap, 1 << 16)
         tpp = jnp.full((tp_cap,), -1, I32)
@@ -1048,7 +1188,9 @@ class DeviceBFS:
                     jnp.asarray(fp_count, I32),
                     jnp.asarray(bool(check_deadlock)),
                     jnp.asarray(md, I32), jnp.asarray(ms, I32),
-                    jnp.asarray(min(quantum, levels_per_dispatch), I32))
+                    jnp.asarray(min(quantum, levels_per_dispatch), I32),
+                    jnp.asarray(0, I32),
+                    jnp.asarray(2**31 - 1, I32))
                 out["reason"].block_until_ready()
             self._fresh_jit = False
             obs.count("dispatches")
@@ -1118,7 +1260,8 @@ class DeviceBFS:
                         set_pointers(level_base + n_front)
                         save_checkpoint(
                             checkpoint_path,
-                            slots=table["slots"], frontier=front,
+                            slots=table["slots"],
+                            frontier=self._dense_rows(front, n_front),
                             n_front=n_front,
                             h_parent=np.concatenate(self._h_parent),
                             h_action=np.concatenate(self._h_action),
@@ -1130,7 +1273,8 @@ class DeviceBFS:
                             max_msgs=self.codec.shape.MAX_MSGS,
                             expand_mults=self.expand_mults,
                             elapsed=time.time() - t0,
-                            digest=spec_digest(spec), obs=obs)
+                            digest=spec_digest(spec),
+                            pack=self._pack_manifest(), obs=obs)
                     last_checkpoint = time.time()
                     obs.checkpoint(checkpoint_path, depth, fp_count)
                     emit(f"checkpoint written to {checkpoint_path} "
@@ -1217,9 +1361,7 @@ class DeviceBFS:
                 front, nbp, nba, nbprm = self._grow_next(
                     (front, nbp, nba, nbprm))
                 f_cap = nbp.shape[0]
-                nb = {k: jnp.concatenate(
-                    [v, jnp.zeros((f_cap - old_cap,) + v.shape[1:],
-                                  v.dtype)]) for k, v in nb.items()}
+                nb = self._pad_rows(nb, f_cap - old_cap)
                 self._fresh_jit = True       # shape change: retrace
                 obs.grow("next_buffer", f_cap)
                 emit(f"frontier buffers grown to {f_cap}")
@@ -1251,6 +1393,279 @@ class DeviceBFS:
                             table=table, fp_cap=fp_cap)
 
     # ------------------------------------------------------------------
+    # chained run: a pipelined window that survives level boundaries
+    # ------------------------------------------------------------------
+    @closes_observer
+    def run_chained(self, max_states=None, max_depth=None,
+                    max_seconds=None, check_deadlock=False, log=None,
+                    progress_every=10.0, levels_cap=1024,
+                    obs=None) -> CheckResult:
+        """Like run() with ``-pipeline K``, but the dispatch window
+        SURVIVES level transitions (ISSUE 9 tentpole lever 3): run()
+        must drain its window at every level boundary — the host swaps
+        the frontier buffers and resets the chain scalars — so on a
+        level-heavy space the device idles through one host round-trip
+        per level no matter how deep the window is.  Here each dispatch
+        is the fused multi-level pass (_make_multilevel) bounded to a
+        ``chunk_tiles`` TILE budget: a budget boundary can land
+        mid-level (the partial (start_t, nn, gen_level) ride the carry,
+        exactly like a growth pause), the on-device ping-pong swap
+        carries the frontier across level ends, and the next dispatch
+        chains on the previous one's device-side carry — so the K-deep
+        window stays full through level transitions with zero host
+        syncs to refill it.
+
+        Pause discipline is unchanged: dispatches chained behind a
+        pause re-attempt the same tile, commit nothing, and re-fail
+        identically, so drained tickets carry no deltas and counts /
+        level sizes / violation traces are BIT-IDENTICAL to run() for
+        every K (tests/test_pack.py asserts it).  Trace pointers and
+        level sizes accumulate on device fused-style and are pulled per
+        collected ticket (level sizes) / at the end (pointers).
+        Checkpointed or resumable runs use run() / run_fused — the
+        chained window has no level-boundary rescue seam."""
+        from ..analysis import preflight
+        preflight(self.spec, log=log)
+        obs = RunObserver.ensure(obs, "device-chained", self.spec,
+                                 log=log, progress_every=progress_every)
+        obs.pipeline = self.pipe_window
+        obs.pack = self._pk is not None
+        self._obs_active = obs          # closes_observer finalizes it
+        spec = self.spec
+        self._act_counts = np.zeros(len(self.kern.action_names),
+                                    np.int64)
+        res = CheckResult()
+        t0 = time.time()
+        obs.start(t0, backend=jax.default_backend())
+
+        fp_cap = self.fpset_capacity
+        self.level_sizes = []      # no stale trajectory on init-viol
+        table, init_batch, n0, viol = self._register_init(res)
+        if viol is not None:
+            return self._finish(res, obs, n0, table=table, fp_cap=fp_cap)
+        f_cap = max(self.next_cap, n0)
+        front, nbp, nba, nbprm = self._alloc_bufs(f_cap)
+        front = self._set_rows(front, init_batch, n0)
+        nb, _, _, _ = self._alloc_bufs(f_cap)
+        tp_cap = max(4 * f_cap, 1 << 16)
+        tpp = jnp.full((tp_cap,), -1, I32)
+        tpa = jnp.full((tp_cap,), -1, I32)
+        tpm = jnp.zeros((tp_cap,), I32)
+        lvl_buf = jnp.zeros((levels_cap,), I32)
+        md = 2**31 - 1 if max_depth is None else int(max_depth)
+        ms = int(max_states) if max_states else 2**31 - 1
+
+        # device-side chain scalars: rebound from every launch's output
+        # so filling the window costs zero host syncs (run()'s chain is
+        # just (start_t, nn); here the whole fused carry chains)
+        d_n_front = jnp.asarray(n0, I32)
+        d_start = jnp.asarray(0, I32)
+        d_nn = jnp.asarray(0, I32)
+        d_gen_level = jnp.asarray(0, I32)
+        d_depth = jnp.asarray(0, I32)
+        d_level_base = jnp.asarray(0, I32)
+        d_fp = jnp.asarray(n0, I32)
+        self.level_sizes = [n0]
+        depth, fp_count, n_front = 0, n0, n0
+        level_base, gen_level = 0, 0
+
+        from .pipeline import DispatchPipeline
+        pipe = DispatchPipeline(self.pipe_window, obs,
+                                ready=lambda o: o["reason"])
+
+        def pull(o):
+            return jax.device_get(
+                [o["reason"], o["n_front"], o["depth"], o["fp_count"],
+                 o["level_base"], o["lvl_cur"], o["gen"],
+                 o["gen_level"], o["act"]])
+
+        def set_pointers(n):
+            self._h_parent = [np.asarray(tpp[:n]).astype(np.int64)]
+            self._h_action = [np.asarray(tpa[:n])]
+            self._h_param = [np.asarray(tpm[:n])]
+
+        def collect_one():
+            """Collect the oldest ticket, fold its deltas into the
+            host-side totals, and emit its committed levels."""
+            nonlocal depth, fp_count, n_front, level_base, gen_level
+            out, sc = pipe.collect(pull)
+            (reason, n_front, depth, fp_count, level_base, lvl_cur,
+             gen_add, gen_level) = (int(x) for x in sc[:8])
+            res.states_generated += gen_add
+            self._act_counts += np.asarray(sc[8], np.int64)
+            if lvl_cur:
+                # each dispatch records its own committed levels from
+                # slot 0 of ITS lvl_buf output (which is why lvl_buf is
+                # excluded from donation: this read can race a newer
+                # in-flight dispatch)
+                with obs.timer("host_sync"):
+                    sizes = np.asarray(out["lvl_buf"][:lvl_cur])
+                cum = sum(self.level_sizes)
+                for x in sizes:
+                    prev = self.level_sizes[-1]
+                    self.level_sizes.append(int(x))
+                    cum += int(x)
+                    obs.level_done(len(self.level_sizes) - 1,
+                                   frontier=prev, distinct=cum,
+                                   generated=res.states_generated)
+            return out, reason
+
+        emit = obs.log
+        stop = None
+        while True:
+            while pipe.has_room():
+                fresh = self._fresh_jit or self._wl is None
+                if self._wl is None:
+                    # the SAME pass run_fused jits, minus the lvl_buf
+                    # donation (argnum 9): collected tickets read their
+                    # level counters back while newer dispatches are
+                    # already consuming the other buffers
+                    self._wl = jax.jit(self._make_multilevel(),
+                                       donate_argnums=tuple(range(9)))
+                out = pipe.launch(
+                    self._wl, table["slots"], front, nb, nbp, nba,
+                    nbprm, tpp, tpa, tpm, lvl_buf,
+                    d_n_front, d_start, d_nn, d_gen_level, d_depth,
+                    d_level_base, d_fp,
+                    jnp.asarray(bool(check_deadlock)),
+                    jnp.asarray(md, I32), jnp.asarray(ms, I32),
+                    jnp.asarray(levels_cap, I32),
+                    jnp.asarray(0, I32),
+                    jnp.asarray(self.chunk_tiles, I32),
+                    fresh=fresh, label=f"window (depth {depth}+)")
+                self._fresh_jit = False
+                table = {"slots": out["slots"]}
+                front, nb = out["front"], out["nb"]
+                nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
+                tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
+                lvl_buf = out["lvl_buf"]
+                d_n_front, d_start = out["n_front"], out["start_t"]
+                d_nn, d_gen_level = out["nn"], out["gen_level"]
+                d_depth, d_level_base = out["depth"], out["level_base"]
+                d_fp = out["fp_count"]
+            out, reason = collect_one()
+            obs.progress(depth=depth, distinct=fp_count,
+                         generated=res.states_generated)
+
+            if reason == RUNNING:
+                if n_front == 0:
+                    pipe.drain()            # trailing no-op tickets
+                    break
+                if max_depth is not None and depth >= max_depth:
+                    stop = f"depth limit {max_depth} reached"
+                elif max_states and fp_count >= max_states:
+                    stop = f"state limit {max_states} reached"
+                elif max_seconds and time.time() - t0 > max_seconds:
+                    stop = f"time budget {max_seconds}s reached"
+                if stop:
+                    # trailing tickets hold REAL committed work (unlike
+                    # a pause, whose replays commit nothing): consume
+                    # them so the reported counts reflect what ran
+                    while pipe.in_flight:
+                        out, reason = collect_one()
+                    break
+                if level_base + n_front + nbp.shape[0] > tp_cap:
+                    # trace-pointer store pressure paused the kernel
+                    # (trailing tickets hit the same guard: no-ops)
+                    pipe.drain()
+                    add = tp_cap
+                    tpp = jnp.concatenate(
+                        [tpp, jnp.full((add,), -1, I32)])
+                    tpa = jnp.concatenate(
+                        [tpa, jnp.full((add,), -1, I32)])
+                    tpm = jnp.concatenate([tpm, jnp.zeros((add,), I32)])
+                    tp_cap += add
+                    self._fresh_jit = True   # shape change: retrace
+                    obs.grow("trace_pointer_store", tp_cap)
+                    emit(f"trace-pointer store grown to {tp_cap}")
+                # else: tile budget (the normal windowed cadence) or a
+                # full per-dispatch level counter (next dispatch resets
+                # it) — just keep the window full
+                continue
+            # pause or terminal: trailing tickets are commit-nothing
+            # replays; handle the reason on the chain-tip buffers
+            pipe.drain()
+            if reason == R_VIOLATION:
+                res.states_generated += gen_level
+                vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
+                gid = level_base + vp
+                parent_dense = self._fetch_row(front, vp)
+                vstate = self._materialize_one(parent_dense, va, vprm)
+                bad = spec.check_invariants(self.codec.decode(vstate))
+                if bad is None:
+                    raise TLAError(
+                        "device/interpreter divergence: device "
+                        "invariant kernel reported a violation the "
+                        "interpreter accepts (parent gid "
+                        f"{gid}, action {self.kern.action_names[va]})")
+                set_pointers(level_base + n_front)
+                res.ok = False
+                res.violated_invariant = bad
+                res.trace = self._trace(gid, extra=(va, vprm))
+                res.diameter = depth + 1
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
+            if reason == R_DEADLOCK:
+                res.states_generated += gen_level
+                di = int(out["dead"])
+                set_pointers(level_base + n_front)
+                res.ok = False
+                res.error = "deadlock"
+                res.deadlock_state = self.codec.decode(
+                    self._fetch_row(front, di))
+                res.trace = self._trace(level_base + di)
+                res.diameter = depth + 1
+                return self._finish(res, obs, fp_count,
+                                    table=table, fp_cap=fp_cap)
+            if reason == R_BAG_GROW:
+                front, nb = self._grow_msgs([front, nb])
+                obs.grow("message_table", self.codec.shape.MAX_MSGS)
+                emit(f"message table grown to "
+                     f"{self.codec.shape.MAX_MSGS} slots (recompiling)")
+            elif reason == R_FPSET_GROW:
+                table = grow(table)
+                fp_cap *= 4
+                self._fresh_jit = True
+                obs.grow("fpset", fp_cap)
+                emit(f"FPSet grown to {fp_cap} slots")
+            elif reason == R_NEXT_GROW:
+                old_cap = nbp.shape[0]
+                front, nbp, nba, nbprm = self._grow_next(
+                    (front, nbp, nba, nbprm))
+                nb = self._pad_rows(nb, nbp.shape[0] - old_cap)
+                f_cap = nbp.shape[0]
+                self._fresh_jit = True
+                obs.grow("next_buffer", f_cap)
+                emit(f"frontier buffers grown to {f_cap}")
+            elif reason == R_EXPAND_GROW:
+                aid = int(out["grow_aid"])
+                self.expand_mults[aid] *= 2
+                self._level = jax.jit(self._make_level(),
+                                      donate_argnums=(0, 4, 5, 6, 7))
+                self._fresh_jit = True
+                self._ml = None
+                self._wl = None
+                obs.grow("expand_buffer", self.expand_mults[aid])
+                emit(f"expand buffer for "
+                     f"{self.kern.action_names[aid]} grown to tile x "
+                     f"{self.expand_mults[aid]} (recompiling)")
+            elif reason == R_SLOT_ERR:
+                raise TLAError(
+                    "dense-layout slot collision (a second DVC or "
+                    "recovery response from one source in one view): "
+                    "this restart-era interleaving needs the "
+                    "multi-slot layout (vsr.py docstring)")
+
+        res.states_generated += gen_level
+        set_pointers(fp_count if (stop is None and n_front == 0)
+                     else level_base + n_front)
+        if stop:
+            res.error = stop
+        res.diameter = depth
+        return self._finish(res, obs, fp_count,
+                            table=table, fp_cap=fp_cap)
+
+    # ------------------------------------------------------------------
     def _flush_pointers(self):
         """Materialize any still-on-device trace-pointer levels (the
         per-level fetches are issued async)."""
@@ -1264,6 +1679,10 @@ class DeviceBFS:
                     lst[i] = np.asarray(v, np.int32)
 
     def _fetch_row(self, batch, i):
+        """One dense state row from a frontier-format buffer (packed
+        rows are unpacked host-side)."""
+        if not isinstance(batch, dict):
+            return self._pk.unpack_row_np(np.asarray(batch[i]))
         return {k: np.asarray(v[i]) for k, v in batch.items()}
 
     def _materialize_one(self, st, aid, param):
@@ -1285,6 +1704,7 @@ class DeviceBFS:
         stamps elapsed/states_per_sec/levels/metrics (ISSUE 2
         satellite — no more post-hoc res.elapsed patching)."""
         res.distinct_states = fp_count
+        self._pack_gauges(obs)
         if fp_cap:
             obs.gauge("fpset_capacity", int(fp_cap))
             obs.gauge("fpset_occupancy", fp_count / fp_cap)

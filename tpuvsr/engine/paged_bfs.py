@@ -70,9 +70,21 @@ class PagedBFS(DeviceBFS):
 
     # -- host-side helpers ---------------------------------------------
     def _host_zero(self, n):
+        if self._pk is not None:
+            # packed host frontier: spill pages and the at-rest host
+            # store move [words] uint32 rows, not dense planes
+            # (ISSUE 9 — 4-8x fewer bytes over the chunk-in/drain-out
+            # transfers that bound this engine)
+            return np.zeros((n, self._pk.words), np.uint32)
         zero = self.codec.zero_state()
         return {k: np.zeros((n,) + np.shape(v), np.int32)
                 for k, v in zero.items()}
+
+    def _host_row(self, host_front, i):
+        """One dense state row of the (possibly packed) host frontier."""
+        if self._pk is not None:
+            return self._pk.unpack_row_np(host_front[i])
+        return {k: host_front[k][i] for k in host_front}
 
     def _chunk_cap(self):
         return self.chunk_tiles * self.tile
@@ -90,7 +102,12 @@ class PagedBFS(DeviceBFS):
             self._init_dense[i] = {k: v[0] for k, v in padded.items()}
 
     def _state_row_bytes(self):
-        """Dense bytes of one frontier row (the paged-spill unit)."""
+        """Bytes of one frontier row as the paged tier actually moves
+        it: packed words when the pack spec is bound, dense otherwise
+        (the spill `bytes` journal field and gauges report REAL
+        transfer volume)."""
+        if self._pk is not None:
+            return self._pk.packed_bytes
         zero = self.codec.zero_state()
         return sum(int(np.prod(np.shape(v)) or 1) * 4
                    for v in zero.values())
@@ -105,6 +122,7 @@ class PagedBFS(DeviceBFS):
         obs = RunObserver.ensure(obs, "paged", self.spec, log=log,
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
+        obs.pack = self._pk is not None
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -136,6 +154,7 @@ class PagedBFS(DeviceBFS):
                 if ck["expand_mults"]:
                     self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
+            self._check_pack_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
             self._init_dense = ck["init_dense"]
@@ -151,8 +170,12 @@ class PagedBFS(DeviceBFS):
             t0 -= ck["elapsed"]
             obs.set_epoch(t0)
             n_front = ck["n_front"]
-            host_front = {k: np.asarray(v)
-                          for k, v in ck["frontier"].items()}
+            # snapshots store dense planes (the engine-agnostic
+            # interchange format); pack them on load when packing is on
+            host_front = (self._pk.pack_np(
+                {k: np.asarray(v) for k, v in ck["frontier"].items()})
+                if self._pk is not None else
+                {k: np.asarray(v) for k, v in ck["frontier"].items()})
             level_base = sum(self.level_sizes[:-1])
             emit(f"resumed from {resume_from}: depth {depth}, "
                  f"{fp_count} distinct, frontier {n_front}")
@@ -164,8 +187,10 @@ class PagedBFS(DeviceBFS):
             if viol is not None:
                 return self._finish(res, obs, fp_count,
                                     table=table, fp_cap=fp_cap)
-            host_front = {k: init_batch[k][:n0].astype(np.int32)
-                          for k in init_batch}
+            init_rows = {k: init_batch[k][:n0].astype(np.int32)
+                         for k in init_batch}
+            host_front = (self._pk.pack_np(init_rows)
+                          if self._pk is not None else init_rows)
             n_front = n0
             level_base = 0
             depth = 0
@@ -203,7 +228,11 @@ class PagedBFS(DeviceBFS):
                 res.error = f"depth limit {max_depth} reached"
                 break
             if self.retain_levels:
-                self.level_blocks.append(host_front)
+                # level blocks stay DENSE: the device liveness graph
+                # builder enumerates them as plane dicts
+                self.level_blocks.append(
+                    self._pk.unpack_np(host_front)
+                    if self._pk is not None else host_front)
             depth += 1
             fault_point("level", depth=depth, obs=obs)
             # per-level host accumulators for drained next states and
@@ -226,9 +255,12 @@ class PagedBFS(DeviceBFS):
                 nb, nbp, nba, nbprm = bufs
                 with obs.timer("host_sync"):
                     rows, par, act, prm = jax.device_get(
-                        ({k: v[:n_next] for k, v in nb.items()},
+                        (nb[:n_next] if self._pk is not None
+                         else {k: v[:n_next] for k, v in nb.items()},
                          nbp[:n_next], nba[:n_next], nbprm[:n_next]))
-                drained.append({k: np.asarray(v) for k, v in rows.items()})
+                drained.append(np.asarray(rows)
+                               if self._pk is not None else
+                               {k: np.asarray(v) for k, v in rows.items()})
                 # par is chunk-relative; lift to level-relative now
                 d_par.append(np.asarray(par, np.int64) + chunk_start)
                 d_act.append(np.asarray(act))
@@ -242,6 +274,13 @@ class PagedBFS(DeviceBFS):
             def put_chunk():
                 nonlocal dev_chunk
                 cc = self._chunk_cap()
+                if self._pk is not None:
+                    if dev_chunk is None:
+                        dev_chunk = jnp.zeros((cc, self._pk.words),
+                                              jnp.uint32)
+                    dev_chunk = dev_chunk.at[:n_c].set(
+                        host_front[chunk_start:chunk_start + n_c])
+                    return
                 if dev_chunk is None:
                     dev_chunk = {
                         k: jnp.zeros((cc,) + np.shape(v), np.int32)
@@ -301,9 +340,8 @@ class PagedBFS(DeviceBFS):
                         vp, va, vprm = (int(v)
                                         for v in np.asarray(out["viol"]))
                         gid = level_base + chunk_start + vp
-                        parent_dense = {
-                            k: host_front[k][chunk_start + vp]
-                            for k in host_front}
+                        parent_dense = self._host_row(
+                            host_front, chunk_start + vp)
                         vstate = self._materialize_one(
                             parent_dense, va, vprm)
                         bad = spec.check_invariants(
@@ -332,12 +370,25 @@ class PagedBFS(DeviceBFS):
                     elif reason == R_BAG_GROW:
                         old = self.codec.shape.MAX_MSGS
                         spill()
+                        old_pk = self._pk
                         self._build(old * 2)
                         obs.grow("message_table",
                                  self.codec.shape.MAX_MSGS)
-                        host_front = self.codec.pad_msgs(host_front, old)
-                        drained = [self.codec.pad_msgs(d, old)
-                                   for d in drained]
+                        if old_pk is not None:
+                            # packed pages: round-trip through the OLD
+                            # spec to dense, pad, re-pack under the
+                            # rebuilt one (see DeviceBFS._grow_msgs)
+                            def regrow(rows):
+                                d = self.codec.pad_msgs(
+                                    old_pk.unpack_np(rows), old)
+                                return self._pk.pack_np(d)
+                            host_front = regrow(host_front)
+                            drained = [regrow(d) for d in drained]
+                        else:
+                            host_front = self.codec.pad_msgs(
+                                host_front, old)
+                            drained = [self.codec.pad_msgs(d, old)
+                                       for d in drained]
                         self.level_blocks = [
                             self.codec.pad_msgs(b, old)
                             for b in self.level_blocks]
@@ -388,8 +439,7 @@ class PagedBFS(DeviceBFS):
                         res.ok = False
                         res.error = "deadlock"
                         res.deadlock_state = self.codec.decode(
-                            {k: host_front[k][chunk_start + di]
-                             for k in host_front})
+                            self._host_row(host_front, chunk_start + di))
                         res.trace = self._trace(gid)
                         res.diameter = depth
                         return self._finish(res, obs, fp_count,
@@ -410,9 +460,10 @@ class PagedBFS(DeviceBFS):
             obs.level_done(depth, frontier=n_front, distinct=fp_count,
                            generated=res.states_generated)
             if n_next_total:
-                host_next = {
-                    k: np.concatenate([d[k] for d in drained])
-                    for k in host_front}
+                host_next = (np.concatenate(drained)
+                             if self._pk is not None else
+                             {k: np.concatenate([d[k] for d in drained])
+                              for k in host_front})
                 self._h_parent.append(
                     np.concatenate(d_par) + level_base)
                 self._h_action.append(np.concatenate(d_act))
@@ -438,7 +489,10 @@ class PagedBFS(DeviceBFS):
                 with obs.timer("checkpoint"):
                     save_checkpoint(
                         checkpoint_path,
-                        slots=table["slots"], frontier=host_front,
+                        slots=table["slots"],
+                        frontier=(self._pk.unpack_np(host_front)
+                                  if self._pk is not None
+                                  else host_front),
                         n_front=n_front,
                         h_parent=np.concatenate(self._h_parent),
                         h_action=np.concatenate(self._h_action),
@@ -450,7 +504,8 @@ class PagedBFS(DeviceBFS):
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
-                        digest=spec_digest(spec), obs=obs)
+                        digest=spec_digest(spec),
+                        pack=self._pack_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "

@@ -32,6 +32,10 @@ class A01Codec(ST03Codec):
                 f"A01 packed entries need MAX_VIEW < {1 << ENTRY_VIEW_BITS}"
                 f" (StartViewOnTimerLimit too large)")
 
+    def _entry_code_hi(self, view_hi):
+        # packed entries: value_id << ENTRY_VIEW_BITS | view_number
+        return (self.shape.V << ENTRY_VIEW_BITS) | view_hi
+
     def _enc_entry(self, e: FnVal) -> int:
         return (self.value_id[e.apply("operation")] << ENTRY_VIEW_BITS) \
             | e.apply("view_number")

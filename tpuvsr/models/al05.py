@@ -28,6 +28,15 @@ from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_OP, H_SRC, H_TYPE,
 
 
 class AL05Codec(RR05Codec):
+    def _entry_code_hi(self, view_hi):
+        return self.shape.V        # plain 1-field entries again
+
+    def plane_bounds(self, ranges):
+        b = super().plane_bounds(ranges)
+        b["rec_ceil"] = (0, self._range_hi(ranges, "op_number",
+                                           self.shape.MAX_OPS))
+        return b
+
     # AL05 log entries revert to the 1-field [operation] records
     # (AL05:106-108) — undo RR05's packed 2-field encoding
     def _enc_entry(self, e: FnVal) -> int:

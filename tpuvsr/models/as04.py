@@ -33,6 +33,19 @@ ERR_DVC_OVERFLOW = 2
 class AS04Codec(ST03Codec):
     """ST03 codec + app plane + DVC slots + frozen-recovery checks."""
 
+    def plane_bounds(self, ranges):
+        b = super().plane_bounds(ranges)
+        s = self.shape
+        view = self._range_hi(ranges, "view_number", s.MAX_VIEW)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        ent = self._entry_code_hi(view)
+        b.update({
+            "app": (0, ent),
+            "dvc": (0, 1), "dvc_lnv": (0, view), "dvc_op": (0, ops),
+            "dvc_commit": (0, ops), "dvc_log": (0, ent),
+        })
+        return b
+
     def zero_state(self):
         d = super().zero_state()
         s = self.shape

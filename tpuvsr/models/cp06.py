@@ -57,6 +57,29 @@ class CP06Codec(RR05Codec):
             self.mtype_id[mv] = code
             self.mtype_mv[code] = mv
 
+    def _entry_code_hi(self, view_hi):
+        return self.noop_id        # plain ids, NoOp = V + 1
+
+    def _hdr_bounds(self, ranges, view_hi, ops_hi):
+        b = super()._hdr_bounds(ranges, view_hi, ops_hi)
+        b[H_FLAG] = (0, 1)
+        b[H_CP] = (0, ops_hi)      # cp_number <= commit <= ops
+        return b
+
+    def plane_bounds(self, ranges):
+        b = super().plane_bounds(ranges)
+        s = self.shape
+        view = self._range_hi(ranges, "view_number", s.MAX_VIEW)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        ent = self._entry_code_hi(view)
+        b.update({
+            "m_cp": (0, ent),
+            "dvc_cp": (0, ent), "dvc_cpn": (0, ops),
+            "rec_flag": (0, 1), "rec_first": (-1, ops + 1),
+            "rec_cp": (0, ent), "rec_cpn": (0, ops),
+        })
+        return b
+
     # -- entries: [operation: Values u {NoOp}] --------------------------
     def _enc_entry(self, e: FnVal) -> int:
         op = e.apply("operation")

@@ -25,6 +25,20 @@ from .a01 import A01Codec
 
 
 class I01Codec(A01Codec):
+    def plane_bounds(self, ranges):
+        b = super().plane_bounds(ranges)
+        s = self.shape
+        view = self._range_hi(ranges, "view_number", s.MAX_VIEW)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        ent = self._entry_code_hi(view)
+        b.update({
+            "sent_svc": (0, 1),
+            "dvc": (0, 1), "dvc_view": (0, view),
+            "dvc_lnv": (0, view), "dvc_op": (0, ops),
+            "dvc_commit": (0, ops), "dvc_log": (0, ent),
+        })
+        return b
+
     def zero_state(self):
         d = super().zero_state()
         s = self.shape

@@ -35,7 +35,10 @@ MSGTYPE_NAMES[M_RECOVERY] = "RecoveryMsg"
 MSGTYPE_NAMES[M_RECOVERYRESP] = "RecoveryResponseMsg"
 
 
-ENTRY_VIEW_BITS = 8
+# Packed-entry view width: the ONE definition lives in a01.py (ISSUE 9
+# satellite — a duplicated literal here drifted independently of the
+# widths-pass table; re-exported for back-compat importers).
+from .a01 import ENTRY_VIEW_BITS  # noqa: E402
 
 
 class RR05Codec(AS04Codec):
@@ -49,6 +52,34 @@ class RR05Codec(AS04Codec):
             mv = constants[MSGTYPE_NAMES[code]]
             self.mtype_id[mv] = code
             self.mtype_mv[code] = mv
+
+    def _entry_code_hi(self, view_hi):
+        # packed 2-field entries (see _enc_entry below)
+        return (self.shape.V << ENTRY_VIEW_BITS) | view_hi
+
+    def _x_hi(self, ranges):
+        # recovery nonce: derivable from CrashLimit (widths pass
+        # recovery_nonce range); underivable -> H_X keeps 32 bits
+        r = ranges.get("recovery_nonce")
+        return int(r[1]) if r else None
+
+    def plane_bounds(self, ranges):
+        b = super().plane_bounds(ranges)
+        s = self.shape
+        view = self._range_hi(ranges, "view_number", s.MAX_VIEW)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        ent = self._entry_code_hi(view)
+        x = self._x_hi(ranges)
+        b.update({
+            "rec_number": ((0, max(1, x)) if x is not None else None),
+            "rec": (0, 1), "rec_view": (0, view),
+            "rec_has_log": (0, 1), "rec_log": (0, ent),
+            "rec_op": (-1, ops), "rec_commit": (-1, ops),
+            # crash counter: bounded with the nonce (by CrashLimit);
+            # underivable -> keep the raw lane, never guess
+            "aux_restart": ((0, max(1, x)) if x is not None else None),
+        })
+        return b
 
     # RR05 log entries are [operation, view_number] records
     # (RR05:306-309) — packed like A01's, without the client_id

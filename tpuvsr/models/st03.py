@@ -30,7 +30,7 @@ import numpy as np
 
 from ..core.values import FnVal, TLAError, mk_record, value_key
 from .vsr import (H_COMMIT, H_DEST, H_FIRST, H_LNV, H_OP, H_SRC, H_TYPE,
-                  H_VIEW, NHDR)
+                  H_VIEW, H_X, NHDR)
 
 # Status encoding (ST03:52-54)
 NORMAL, VIEWCHANGE, STATETRANSFER = 0, 1, 2
@@ -122,6 +122,74 @@ class ST03Codec:
         }
 
     MSG_KEYS = ("m_present", "m_count", "m_hdr", "m_entry", "m_log")
+
+    # -- packed-frontier bit budgets (ISSUE 9) ---------------------------
+    # The per-plane value ranges the packed interchange format
+    # (engine/pack.py) allocates bits by.  Derived from the SAME shape
+    # attributes the codec constructors already guard (MAX_VIEW,
+    # MAX_OPS, R) plus the widths-pass range table — no per-field width
+    # literal lives here that isn't cross-checked by speclint
+    # (analysis/passes/drift.py ties the structural packing constants
+    # to widths.FAMILY_PACKED).  A plane omitted from the dict keeps
+    # raw 32-bit lanes (e.g. m_count: TLC bag counts have no static
+    # bound).
+
+    @staticmethod
+    def _range_hi(ranges, name, default):
+        r = ranges.get(name)
+        return max(default, int(r[1])) if r else default
+
+    def _entry_code_hi(self, view_hi):
+        """Largest packed log-entry code this layout can store (plain
+        value ids for ST03/AL05; A01/I01/RR05 pack ``vid << 8 | view``;
+        CP06 adds the NoOp id)."""
+        return self.shape.V
+
+    def _x_hi(self, ranges):
+        """Largest recovery nonce in the H_X header column (None =
+        underivable -> the column keeps 32 bits).  The ST03/A01/I01/
+        AS04 layouts never write H_X."""
+        return 0
+
+    def _hdr_bounds(self, ranges, view_hi, ops_hi):
+        s = self.shape
+        x_hi = self._x_hi(ranges)
+        b = [None] * self.NHDR
+        b[H_TYPE] = (0, max(self.mtype_id.values(), default=7))
+        b[H_VIEW] = (0, view_hi)
+        b[H_OP] = (-1, ops_hi + 1)
+        b[H_COMMIT] = (-1, ops_hi)
+        b[H_DEST] = (-1, s.R)          # ANYDEST sentinel
+        b[H_SRC] = (0, s.R)
+        b[H_X] = (0, max(1, x_hi)) if x_hi is not None else None
+        b[H_FIRST] = (-1, ops_hi + 1)
+        b[H_LNV] = (0, view_hi)
+        # unset columns (None) keep raw 32-bit lanes
+        return [(0, (1 << 31)) if c is None else c for c in b]
+
+    def plane_bounds(self, ranges):
+        """Plane key -> (lo, hi) or per-last-axis-column bound list,
+        consumed by engine/pack.build_pack_spec.  ``ranges`` is the
+        widths-pass field-range table (may be empty: the shape bounds
+        alone are already sound)."""
+        s = self.shape
+        view = self._range_hi(ranges, "view_number", s.MAX_VIEW)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        ent = self._entry_code_hi(view)
+        return {
+            "status": (0, max(self.status_id.values())),
+            "view": (0, view), "op": (0, ops), "commit": (0, ops),
+            "lnv": (0, view),
+            "log": (0, ent), "peer_op": (0, ops),
+            "sent_dvc": (0, 1), "sent_sv": (0, 1), "no_prog": (0, 1),
+            "np_ctr": (0, max(1, s.np_limit)),
+            "m_present": (0, 1),
+            "m_hdr": self._hdr_bounds(ranges, view, ops),
+            "m_entry": (0, max(1, ent)), "m_log": (0, ent),
+            "aux_svc": (0, max(1, s.timer_limit)),
+            "aux_acked": (0, 2),
+            "err": (0, 7),
+        }
 
     def pad_msgs(self, dense, old_max_msgs):
         """Grow the message table in place (zero padding is content-

@@ -201,6 +201,66 @@ class VSRCodec:
             "err": z(),
         }
 
+    # -- packed-frontier bit budgets (ISSUE 9; engine/pack.py) -------------
+    # Per-plane (or per-column, for the heterogeneous hdr/entry planes)
+    # value ranges derived from the shape attributes this constructor
+    # already guards plus the widths-pass range table; speclint's drift
+    # pass cross-checks the structural packing constants against
+    # widths.FAMILY_PACKED.  m_count keeps raw 32-bit lanes (bag counts
+    # have no static bound).
+
+    @staticmethod
+    def _range_hi(ranges, name, default):
+        r = ranges.get(name)
+        return max(default, int(r[1])) if r else default
+
+    def plane_bounds(self, ranges):
+        s = self.shape
+        view = max(self._range_hi(ranges, "view_number",
+                                  s.MAX_VIEW - 1),
+                   s.MAX_VIEW - 1 + s.restart_limit)
+        ops = self._range_hi(ranges, "op_number", s.MAX_OPS)
+        req = self._range_hi(ranges, "request_number", s.V)
+        cli = self._range_hi(ranges, "client_id", s.C)
+        # nonce x: minted once per RestartEmpty (UniqueNumber under
+        # aux_restart < restart_limit, vsr_kernel.py:676-695)
+        x = max(self._range_hi(ranges, "recovery_nonce",
+                               s.restart_limit), s.restart_limit)
+        ent = [(0, view), (0, s.V), (0, cli), (0, req)]  # E_* columns
+        hdr = [None] * self.NHDR
+        hdr[H_TYPE] = (0, max(self.mtype_id.values(), default=9))
+        hdr[H_VIEW] = (0, view)
+        hdr[H_OP] = (-1, ops + 1)
+        hdr[H_COMMIT] = (-1, ops)
+        hdr[H_DEST] = (-1, s.R)
+        hdr[H_SRC] = (0, s.R)
+        hdr[H_X] = (0, max(1, x))
+        hdr[H_FIRST] = (-1, ops + 1)
+        hdr[H_LNV] = (0, view)
+        return {
+            "status": (0, max(self.status_id.values())),
+            "view": (0, view), "op": (0, ops), "commit": (0, ops),
+            "lnv": (0, view),
+            "log": ent, "log_len": (0, ops), "peer_op": (0, ops),
+            "ct": [(0, req), (0, ops), (0, 1)],       # T_REQ/T_OP/T_EXEC
+            "svc": (0, 1),
+            "dvc": (0, 1), "dvc_lnv": (0, view), "dvc_op": (0, ops),
+            "dvc_commit": (0, ops), "dvc_log": ent,
+            "dvc_log_len": (0, ops),
+            "sent_dvc": (0, 1), "sent_sv": (0, 1),
+            "rec_number": (0, max(1, x)), "rec": (0, 1),
+            "rec_view": (0, view), "rec_has_log": (0, 1),
+            "rec_log": ent, "rec_log_len": (0, ops),
+            "rec_op": (-1, ops), "rec_commit": (-1, ops),
+            "m_present": (0, 1),
+            "m_hdr": hdr, "m_entry": ent, "m_log": ent,
+            "m_log_len": (0, ops), "m_has_log": (0, 1),
+            "aux_svc": (0, max(1, s.timer_limit)),
+            "aux_restart": (0, max(1, s.restart_limit)),
+            "aux_acked": (0, 2),
+            "err": (0, 7),
+        }
+
     # -- message-table growth ----------------------------------------------
     MSG_KEYS = ("m_present", "m_count", "m_hdr", "m_entry", "m_log",
                 "m_log_len", "m_has_log")

@@ -80,6 +80,10 @@ class RunObserver:
         # carries it (1 = synchronous) so journals stay key-set
         # uniform across engines
         self.pipeline = 1
+        # packed-frontier encoding in effect (ISSUE 9): engines set it
+        # before start(); journaled on run_start like pipeline so a
+        # journal identifies the run's state representation
+        self.pack = False
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -148,7 +152,8 @@ class RunObserver:
         self.journal.write("run_start", schema=JOURNAL_SCHEMA,
                            engine=self.engine, module=self.module,
                            backend=self.backend, resumed=bool(resumed),
-                           pipeline=int(self.pipeline or 1), **extra)
+                           pipeline=int(self.pipeline or 1),
+                           pack=bool(self.pack), **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")

@@ -225,7 +225,7 @@ R_BUCKET_GROW = 8
 
 def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                        tile: int, bucket_cap: int,
-                       check_deadlock: bool = False):
+                       check_deadlock: bool = False, pack_spec=None):
     """Build the jitted one-tile sharded BFS step.
 
     step(tables, frontier, n_front, start_t, nb, nbp, nba, nbprm, nn,
@@ -236,7 +236,23 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     (one per device; identical where globally agreed).  With
     ``check_deadlock`` a frontier state with no enabled successor
     pauses the level with R_DEADLOCK and its device-local row index in
-    the `dead` output (-1 on devices without a witness)."""
+    the `dead` output (-1 on devices without a witness).
+
+    With a ``pack_spec`` (engine/pack.PackSpec, ISSUE 9) the frontier
+    and next-frontier are ``[D*cap, words]`` uint32 planes and — the
+    lever that matters here — the all_to_all ships PACKED rows: the
+    tile is unpacked on entry, successors are packed once right after
+    expansion, and the exchange buckets/receive buffers/next frontier
+    all carry the packed row, cutting wire and at-rest bytes by the
+    pack ratio (~11x on the defect layout).  Receivers never unpack:
+    dedup/insert work on the fingerprints that ride alongside.
+
+    The jit DONATES the FPSet shards and the next-frontier buffer set
+    (the ISSUE 9 donation lever): each dispatch consumes the previous
+    one's buffers instead of holding K generations of them in HBM,
+    which is what lets ``pipeline=2`` be the sharded default.  The
+    read-only frontier and base_gid are NOT donated (the level's
+    dispatch chain re-reads them)."""
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
@@ -264,12 +280,21 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             base = t * T
             sidx = base + jnp.arange(T, dtype=jnp.int32)
             valid = sidx < n_loc
-            tile_st = {k: v[jnp.clip(sidx, 0, v.shape[0] - 1)]
-                       for k, v in frontier.items()}
+            if pack_spec is not None:
+                tile_st = jax.vmap(pack_spec.unpack)(
+                    frontier[jnp.clip(sidx, 0, frontier.shape[0] - 1)])
+            else:
+                tile_st = {k: v[jnp.clip(sidx, 0, v.shape[0] - 1)]
+                           for k, v in frontier.items()}
             succs, en = jax.vmap(kern.step_all)(tile_st)
             en = en & valid[:, None]
             flat = {k: v.reshape((T * L,) + v.shape[2:])
                     for k, v in succs.items()}
+            if pack_spec is not None:
+                # pack successors ONCE, right after expansion: the
+                # buckets, the wire, and the next frontier all move
+                # the packed row from here on
+                flat_rows = jax.vmap(pack_spec.pack)(flat)
             en_f = en.reshape(-1)
             n_en = en_f.sum()
             fps = jax.vmap(kern.fingerprint)(flat)
@@ -305,8 +330,15 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             b_p = jnp.zeros((n_dev, cap), jnp.int32)
             b_a = jnp.zeros((n_dev, cap), jnp.int32)
             b_m = jnp.zeros((n_dev, cap), jnp.int32)
-            b_st = {k: jnp.zeros((n_dev, cap) + v.shape[1:], v.dtype)
-                    for k, v in flat.items()}
+            if pack_spec is not None:
+                b_st = {"rows": jnp.zeros(
+                    (n_dev, cap, pack_spec.words), U32)}
+                flat_src = {"rows": flat_rows}
+            else:
+                b_st = {k: jnp.zeros((n_dev, cap) + v.shape[1:],
+                                     v.dtype)
+                        for k, v in flat.items()}
+                flat_src = flat
             ovf_b = jnp.asarray(False)
             for d in range(n_dev):
                 m = cand & (owner == d)
@@ -320,7 +352,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 b_m = b_m.at[d, idx].set(meta_m, mode="drop")
                 for k in b_st:
                     b_st[k] = b_st[k].at[d, idx].set(
-                        flat[k][perm], mode="drop")
+                        flat_src[k][perm], mode="drop")
 
             # deadlock: a valid frontier state with no enabled lane
             dead_l = valid & ~en.any(axis=1) if check_deadlock else \
@@ -344,6 +376,8 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             i_m = a2a(b_m).reshape(n_dev * cap)
             i_st = {k: a2a(v).reshape((n_dev * cap,) + v.shape[2:])
                     for k, v in b_st.items()}
+            if pack_spec is not None:
+                i_st = i_st["rows"]     # [D*cap, words] packed rows
 
             # receiver-side capacity vote (cross-sender dedup can only
             # shrink the count, so this bound is safe)
@@ -368,8 +402,12 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1, N
                              ).astype(jnp.int32)
             src = perm2
-            for k in nb:
-                nb[k] = nb[k].at[dest].set(i_st[k][src], mode="drop")
+            if pack_spec is not None:
+                nb = nb.at[dest].set(i_st[src], mode="drop")
+            else:
+                for k in nb:
+                    nb[k] = nb[k].at[dest].set(i_st[k][src],
+                                               mode="drop")
             nbp = nbp.at[dest].set(i_p[src], mode="drop")
             nba = nba.at[dest].set(i_a[src], mode="drop")
             nbprm = nbprm.at[dest].set(i_m[src], mode="drop")
@@ -435,10 +473,17 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 one(out["dead"]), out["act"][None])
 
     sp = P(axis)
+    # donate the FPSet shards + the next-frontier buffer set (args 0,
+    # 4-7): the K-deep dispatch window chains each step on the previous
+    # one's outputs, so donation means the window holds ONE generation
+    # of the capacity-bound buffers instead of K (ISSUE 9 — the lever
+    # that makes pipeline=2 the sharded default).  The frontier (1) and
+    # base_gid (9) are re-read by every dispatch of the level's chain
+    # and must NOT be donated.
     step = jax.jit(_shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 13))
+        out_specs=(sp,) * 13), donate_argnums=(0, 4, 5, 6, 7))
     return step
 
 
@@ -454,9 +499,9 @@ class ShardedBFS:
     def __init__(self, spec, mesh: Mesh, axis: str = "d", max_msgs=None,
                  tile=32, bucket_cap=None, next_capacity=1 << 12,
                  fpset_capacity=1 << 14, check_deadlock=False,
-                 model_factory=None, pipeline=1, exchange_retries=5,
+                 model_factory=None, pipeline=2, exchange_retries=5,
                  exchange_backoff=0.05, exchange_backoff_cap=2.0,
-                 sleep=time.sleep):
+                 sleep=time.sleep, pack="auto"):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
@@ -474,15 +519,19 @@ class ShardedBFS:
         # set by an elastic resume that re-hash-partitioned an N-shard
         # snapshot onto this mesh (None: no reshard happened)
         self.resharded_from = None
-        # dispatch-window depth (ISSUE 4; 1 = synchronous).  Unlike
-        # the device/paged engines (default 2), the sharded window is
-        # OPT-IN: the step is one whole-level attempt (overlap covers
-        # only pause handling and boundary bookkeeping) and its jit
-        # has no buffer donation, so K>1 keeps K generations of the
-        # FPSet shards + frontier alive in HBM — a real cost on the
-        # capacity-bound runs this engine exists for.  Semantics are
-        # identical at every K (tests/test_pipeline.py).
+        # dispatch-window depth (ISSUE 4; 1 = synchronous).  Default 2
+        # like the device/paged engines (ISSUE 9): the step's jit now
+        # DONATES the FPSet shards and next-frontier buffers, so a
+        # K-deep window holds ONE generation of the capacity-bound
+        # buffers instead of K — the HBM cost that made K>1 opt-in is
+        # gone.  Semantics are identical at every K
+        # (tests/test_pipeline.py).
         self.pipe_window = max(1, int(pipeline))
+        # packed frontier encoding (ISSUE 9): "auto" packs whenever the
+        # codec declares plane_bounds; False runs dense; True forces
+        # the interchange format (ratio 1.0 without bounds).  Results
+        # are bit-identical either way.
+        self._pack_req = pack
         # model_factory(spec, max_msgs=..) -> (codec, kernel); default
         # is the hand-kernel registry (DeviceBFS parity — tests drive
         # the driver with stub kernels through this hook)
@@ -507,10 +556,19 @@ class ShardedBFS:
         self.codec, self.kern = factory(self.spec, max_msgs=max_msgs)
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}
+        # packed-frontier spec for THIS codec binding (rebuilt with the
+        # codec on bag growth — MAX_MSGS changes the lane count)
+        from ..engine.pack import build_pack_spec
+        if self._pack_req is False:
+            self._pk = None
+        else:
+            self._pk = build_pack_spec(self.codec, spec=self.spec,
+                                       force=self._pack_req is True)
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
                                         self.axis, self.tile,
                                         self.bucket_cap,
-                                        check_deadlock=self._ckd)
+                                        check_deadlock=self._ckd,
+                                        pack_spec=self._pk)
         self._fresh_jit = True   # first dispatch after a (re)jit is
         #                          charged to the "compile" phase
         self._sh = NamedSharding(self.mesh, P(self.axis))
@@ -524,6 +582,9 @@ class ShardedBFS:
     _materialize_one = _DB._materialize_one
     _trace = _DB._trace
     _fetch_row = _DB._fetch_row
+    _pack_manifest = _DB._pack_manifest
+    _check_pack_manifest = _DB._check_pack_manifest
+    _pack_gauges = _DB._pack_gauges
 
     def _flush_pointers(self):
         """No-op: the sharded driver's pointer pulls are synchronous
@@ -539,10 +600,18 @@ class ShardedBFS:
         return put_sharded(arr, self._rep_sh)
 
     def _alloc_frontier(self, cap):
-        zero = self.codec.zero_state()
         D = self.D
-        nb = {k: self._put(np.zeros((D * cap,) + np.shape(v), np.int32))
-              for k, v in zero.items()}
+        if self._pk is not None:
+            # packed at-rest frontier (ISSUE 9): [D*cap, words] uint32
+            # planes — the exchange and the next frontier move packed
+            # rows, so this buffer IS the interchange format
+            nb = self._put(np.zeros((D * cap, self._pk.words),
+                                    np.uint32))
+        else:
+            zero = self.codec.zero_state()
+            nb = {k: self._put(np.zeros((D * cap,) + np.shape(v),
+                                        np.int32))
+                  for k, v in zero.items()}
         z = lambda: self._put(np.zeros((D * cap,), np.int32))
         return nb, z(), z(), z()
 
@@ -578,6 +647,7 @@ class ShardedBFS:
         obs = RunObserver.ensure(obs, "sharded", self.spec, log=log,
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
+        obs.pack = self._pk is not None
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
@@ -605,9 +675,15 @@ class ShardedBFS:
         # are accumulated with the row size current at the time (the
         # codec — and so the state row — grows on R_BAG_GROW)
         def _row_bytes():
-            zero = self.codec.zero_state()
-            state_b = sum(int(np.prod(np.shape(v)) or 1) * 4
-                          for v in zero.values())
+            # state bytes as the wire actually moves them: packed words
+            # when the pack spec is bound (the exchange buckets carry
+            # packed rows), dense planes otherwise
+            if self._pk is not None:
+                state_b = self._pk.packed_bytes
+            else:
+                zero = self.codec.zero_state()
+                state_b = sum(int(np.prod(np.shape(v)) or 1) * 4
+                              for v in zero.values())
             return state_b + 16 + 1 + 12      # + fps/mask/meta
         exch_rows_useful = 0
         exch_rows_wire = 0
@@ -647,6 +723,11 @@ class ShardedBFS:
                     ex["bucket_cap"] != self.bucket_cap:
                 self.bucket_cap = int(ex["bucket_cap"])
                 self._build(ck["max_msgs"])
+            # AFTER the max_msgs rebuild: the pack-spec version digests
+            # the lane count, so a snapshot from a grown-bag run only
+            # matches the spec rebuilt at ITS MAX_MSGS (DeviceBFS
+            # orders these the same way)
+            self._check_pack_manifest(ck, resume_from)
             rows = ck["frontier"]
             h_parent = np.asarray(ck["h_parent"])
             h_action = np.asarray(ck["h_action"])
@@ -732,7 +813,11 @@ class ShardedBFS:
                     for k in host_front:
                         host_front[k][d * F + j] = rows[k][pos]
                     pos += 1
-            front = {k: self._put(v) for k, v in host_front.items()}
+            # snapshots store dense planes (the engine-agnostic
+            # interchange format); pack the scatter when packing is on
+            front = (self._put(self._pk.pack_np(host_front))
+                     if self._pk is not None else
+                     {k: self._put(v) for k, v in host_front.items()})
             n_front = self._put(counts0.astype(np.int32))
             base_dev = (sum(self.level_sizes[:-1])
                         + np.concatenate([[0], np.cumsum(counts0)[:-1]]))
@@ -777,7 +862,9 @@ class ShardedBFS:
                     for k in host_front:
                         host_front[k][d * F + j] = row[k]
                     pos += 1
-            front = {k: self._put(v) for k, v in host_front.items()}
+            front = (self._put(self._pk.pack_np(host_front))
+                     if self._pk is not None else
+                     {k: self._put(v) for k, v in host_front.items()})
             n_front = self._put(counts0.astype(np.int32))
             tables, _fr, ovf = sharded_ins(
                 tables, self._rep(fps[keep]),
@@ -982,6 +1069,9 @@ class ShardedBFS:
                     res.ok = False
                     res.error = "deadlock"
                     res.deadlock_state = self.codec.decode(
+                        self._pk.unpack_row_np(
+                            self._pull(front[d * F + di]))
+                        if self._pk is not None else
                         {k: self._pull(v[d * F + di])
                          for k, v in front.items()})
                     res.trace = self._trace(gid)
@@ -990,7 +1080,17 @@ class ShardedBFS:
                     return self._finish(res, obs, fp_count)
                 if reason == R_BAG_GROW:
                     old = self.codec.shape.MAX_MSGS
+                    old_pk = self._pk
                     self._build(old * 2)
+
+                    def regrow_packed(garr):
+                        # packed buffers round-trip through the OLD
+                        # spec to dense, pad, re-pack under the rebuilt
+                        # one (MAX_MSGS changes the lane count AND the
+                        # spec version; see DeviceBFS._grow_msgs)
+                        host = old_pk.unpack_np(self._pull(garr))
+                        host = self.codec.pad_msgs(host, old)
+                        return self._put(self._pk.pack_np(host))
 
                     # pad the message-table axis of every state array
                     def pad_msgs_global(g_dict, cap):
@@ -1009,8 +1109,12 @@ class ShardedBFS:
                             out[k] = self._put(v.reshape(
                                 (D * cap,) + v.shape[2:]))
                         return out
-                    front = pad_msgs_global(front, F)
-                    nb = pad_msgs_global(nb, self.N)
+                    if old_pk is not None:
+                        front = regrow_packed(front)
+                        nb = regrow_packed(nb)
+                    else:
+                        front = pad_msgs_global(front, F)
+                        nb = pad_msgs_global(nb, self.N)
                     obs.grow("message_table", self.codec.shape.MAX_MSGS)
                     emit(f"message table grown to "
                          f"{self.codec.shape.MAX_MSGS} (recompiling)")
@@ -1019,15 +1123,17 @@ class ShardedBFS:
                     self._step = make_sharded_level(
                         self.kern, self._inv, self.mesh, self.axis,
                         self.tile, self.bucket_cap,
-                        check_deadlock=self._ckd)
+                        check_deadlock=self._ckd, pack_spec=self._pk)
                     self._fresh_jit = True
                     obs.grow("exchange_bucket", self.bucket_cap)
                     emit(f"exchange bucket grown to {self.bucket_cap} "
                          f"(recompiling)")
                 elif reason == R_NEXT_GROW:
                     new_n = self.N * 2
-                    nb = {k: self._grow_global(v, self.N, new_n)
-                          for k, v in nb.items()}
+                    nb = (self._grow_global(nb, self.N, new_n)
+                          if self._pk is not None else
+                          {k: self._grow_global(v, self.N, new_n)
+                           for k, v in nb.items()})
                     nbp = self._grow_global(nbp, self.N, new_n)
                     nba = self._grow_global(nba, self.N, new_n)
                     nbprm = self._grow_global(nbprm, self.N, new_n)
@@ -1090,8 +1196,13 @@ class ShardedBFS:
                 # the pulls are collectives in multi-process mode —
                 # every process participates; only rank 0 writes
                 ck_slots = self._pull(tables["slots"])
-                ck_front = {k: self._pull_rows(v, nn_h)
-                            for k, v in front.items()}
+                # snapshots always store DENSE planes — the interchange
+                # format any engine/pack configuration can resume
+                ck_front = (self._pk.unpack_np(
+                    self._pull_rows(front, nn_h))
+                    if self._pk is not None else
+                    {k: self._pull_rows(v, nn_h)
+                     for k, v in front.items()})
                 if jax.process_index() == 0:
                     save_checkpoint(
                         checkpoint_path,
@@ -1109,7 +1220,8 @@ class ShardedBFS:
                         max_msgs=self.codec.shape.MAX_MSGS,
                         expand_mults=[],
                         elapsed=_time.time() - t0,
-                        digest=spec_digest(spec), obs=obs,
+                        digest=spec_digest(spec),
+                        pack=self._pack_manifest(), obs=obs,
                         extra={"sharded": True,
                                "shard_counts": [int(x) for x in nn_h],
                                "bucket_cap": self.bucket_cap,
@@ -1159,6 +1271,7 @@ class ShardedBFS:
 
     def _finish(self, res, obs, fp_count):
         res.distinct_states = fp_count
+        self._pack_gauges(obs)
         cap_total = self.fp_cap * self.D
         obs.gauge("fpset_capacity", cap_total)
         obs.gauge("fpset_occupancy",

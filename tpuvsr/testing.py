@@ -101,6 +101,13 @@ def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
             # "status" is the plane the level kernel sizes buffers by
             return {"status": 0, "x": 0, "y": 0, "err": 0}
 
+        def plane_bounds(self, ranges):
+            # packed-frontier bit budgets (ISSUE 9): the stub layout
+            # declares real (narrow) bounds so every tier-1 engine run
+            # exercises the pack/unpack seam with a non-trivial ratio
+            return {"status": (0, 1), "x": (0, limit + 1),
+                    "y": (0, limit + 1), "err": (0, 1)}
+
         def encode(self, st):
             return {"status": np.int32(0), "x": np.int32(st["x"]),
                     "y": np.int32(st["y"]), "err": np.int32(0)}
