@@ -338,6 +338,15 @@ def main():
     # between rounds as advisory
     RESULT["pipeline_depth"] = (res.metrics or {}).get(
         "gauges", {}).get("pipeline_depth")
+    # level-kernel commit mode + occupancy (ISSUE 10): compare_bench
+    # treats commit mismatches between docs as advisory (like pipeline
+    # depth) and gates occupancy regressions
+    RESULT["commit"] = (res.metrics or {}).get(
+        "gauges", {}).get("commit_mode")
+    RESULT["occupancy"] = (res.metrics or {}).get(
+        "gauges", {}).get("occupancy")
+    RESULT["inserts_per_tile"] = (res.metrics or {}).get(
+        "gauges", {}).get("inserts_per_tile")
     # A/B the chunked engine's dispatch window on the same probe
     # (ISSUE 4 acceptance): -pipeline 1 vs -pipeline 2 must explore
     # the identical space; the throughput delta is the window's win
@@ -403,6 +412,38 @@ def main():
                         ab["chained"]["distinct"]
                         == ab["pipeline1"]["distinct"]
                         and ab["chained"]["generated"]
+                        == ab["pipeline1"]["generated"])
+            # commit-mode A/B (ISSUE 10 acceptance spot-check): the
+            # occupancy-packed fused commit vs the historical
+            # per-action serial phases — counts must be IDENTICAL,
+            # the throughput delta is the tentpole's win
+            if time.time() < DEADLINE - 90:
+                e = DeviceBFS(spec, tile_size=tile,
+                              fpset_capacity=1 << 21,
+                              next_capacity=1 << 15, expand_mult=2,
+                              expand_mults={"ReceiveMatchingSVC": 4,
+                                            "SendDVC": 4},
+                              pipeline=2, commit="per-action")
+                e.run(max_depth=6)      # compile + warm
+                r = e.run(max_seconds=max(30.0,
+                                          DEADLINE - time.time()))
+                m = (r.metrics or {}).get("gauges", {})
+                ab["per_action_commit"] = {
+                    "distinct": r.distinct_states,
+                    "generated": r.states_generated,
+                    "distinct_per_s": round(
+                        r.distinct_states / r.elapsed, 1),
+                    "elapsed_s": round(r.elapsed, 2),
+                    "reached_fixpoint": r.error is None,
+                    "occupancy": m.get("occupancy"),
+                    "inserts_per_tile": m.get("inserts_per_tile"),
+                }
+                if ab["per_action_commit"]["reached_fixpoint"] and \
+                        ab["counts_identical"]:
+                    ab["counts_identical"] = (
+                        ab["per_action_commit"]["distinct"]
+                        == ab["pipeline1"]["distinct"]
+                        and ab["per_action_commit"]["generated"]
                         == ab["pipeline1"]["generated"])
             RESULT["pipeline_ab"] = ab
             print(f"bench: pipeline A/B "

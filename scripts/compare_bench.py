@@ -258,6 +258,8 @@ def main(argv=None):
     bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
     pipe_mismatch = False
     mesh_mismatch = False
+    commit_mismatch = False
+    occ_regressed = False
     if bm and cm:
         bp = bm.get("gauges", {}).get("pipeline_depth")
         cp = cm.get("gauges", {}).get("pipeline_depth")
@@ -271,6 +273,31 @@ def main(argv=None):
             mesh_mismatch = True
             print(f"  mesh_devices: {bmesh} -> {cmesh} (different "
                   f"mesh sizes — comparison is advisory)")
+        # commit-mode mismatch (ISSUE 10): fused vs per-action docs
+        # measure different level-kernel bodies — advisory, like
+        # pipeline depth (the two are bit-identical in RESULTS, so
+        # only throughput comparisons are affected)
+        bc = (base_doc.get("commit")
+              or bm.get("gauges", {}).get("commit_mode"))
+        cc = (cand_doc.get("commit")
+              or cm.get("gauges", {}).get("commit_mode"))
+        if bc is not None and cc is not None and bc != cc:
+            commit_mismatch = True
+            print(f"  commit: {bc} -> {cc} (different level-kernel "
+                  f"commit modes — comparison is advisory)")
+        # occupancy regression gate (ISSUE 10): the fraction of expand
+        # lanes doing real work dropping means the exact-count packing
+        # regressed (caps ballooned past the observed need)
+        bo = bm.get("gauges", {}).get("occupancy")
+        co = cm.get("gauges", {}).get("occupancy")
+        if bo and co:
+            print(f"  occupancy: {bo} -> {co} "
+                  f"({fmt_delta(bo, co)})")
+            # flagged here, reported with the common exit below so the
+            # rest of the comparison context still prints
+            occ_regressed = (not commit_mismatch and
+                             co < bo * (1.0 - args.max_regression
+                                        / 100.0))
 
     # context: phase-timer and counter drift between the documents
     if bm and cm:
@@ -294,6 +321,9 @@ def main(argv=None):
 
     # simulation throughput rides the same gate (ISSUE 7): walks/s
     # regressions fail, cross-walker-count comparisons are advisory
+    if occ_regressed:
+        print(f"compare_bench: occupancy REGRESSION beyond "
+              f"{args.max_regression:.1f}% tolerance", file=sys.stderr)
     sim_rc = gate_sim(base_doc, cand_doc, args.max_regression)
     # trace-validation throughput likewise (ISSUE 8): traces/s
     # regressions fail, cross-backend/batch comparisons are advisory.
@@ -303,12 +333,13 @@ def main(argv=None):
     # at-rest frontier bytes ride the gate too (ISSUE 9): bytes/state
     # growth fails, cross-format comparisons are advisory
     pack_rc = gate_pack(base_doc, cand_doc, args.max_regression)
-    sim_rc = sim_rc or val_rc or pack_rc
+    sim_rc = sim_rc or val_rc or pack_rc or (1 if occ_regressed else 0)
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
-        if pipe_mismatch or mesh_mismatch:
+        if pipe_mismatch or mesh_mismatch or commit_mismatch:
             what = ("pipeline depths" if pipe_mismatch
-                    else "mesh sizes")
+                    else "mesh sizes" if mesh_mismatch
+                    else "commit modes")
             print(f"compare_bench: drop beyond "
                   f"{args.max_regression:.1f}% tolerance, but the "
                   f"documents ran different {what} — "

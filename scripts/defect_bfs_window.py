@@ -144,6 +144,12 @@ out = {
     "metrics_file": "scripts/defect_window_metrics.json",
     "phases": (res.metrics or {}).get("phases"),
     "counters": (res.metrics or {}).get("counters"),
+    # ISSUE 10 acceptance surface: the occupancy-packed fused commit's
+    # real-work fraction and its one-insert-per-tile structure
+    "commit": (res.metrics or {}).get("gauges", {}).get("commit_mode"),
+    "occupancy": (res.metrics or {}).get("gauges", {}).get("occupancy"),
+    "inserts_per_tile": (res.metrics or {}).get(
+        "gauges", {}).get("inserts_per_tile"),
 }
 with open(OUT, "w") as f:
     json.dump(out, f, indent=1)

@@ -241,6 +241,55 @@ def scenario_pack_kill_rescue(tmp):
     }
 
 
+def scenario_kill_fused_commit_resume(tmp):
+    """ISSUE 10 satellite: kill mid-chunk with packing AND the fused
+    (occupancy-packed single-insert) commit on -> rescue checkpoint,
+    then a fused resume AND a per-action resume both reach the exact
+    uninterrupted fixpoint — the three-stage commit restructure is
+    invisible across the rescue seam, and the journal's run_start rows
+    carry the commit key."""
+    ORACLE = _oracle()
+    from tpuvsr.obs import RunObserver
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "fused-ck")
+    jp = os.path.join(tmp, "fused.jsonl")
+    faults.install("kill@level=3")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                eng = stub_device_engine()      # commit defaults fused
+                assert eng.commit == "fused" and eng._pk is not None
+                eng.run(checkpoint_path=ck,
+                        obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    res_fused = stub_device_engine().run(resume_from=ck)
+    res_pa = stub_device_engine(
+        commit="per-action").run(resume_from=ck)
+    from tpuvsr.obs import read_journal
+    starts = [e for e in read_journal(jp) if e["event"] == "run_start"]
+    return {
+        "ok": (preempted.depth == 3
+               and res_fused.ok and res_pa.ok
+               and res_fused.distinct_states == ORACLE["distinct"]
+               and res_pa.distinct_states == ORACLE["distinct"]
+               and res_fused.levels == ORACLE["levels"]
+               and res_pa.levels == ORACLE["levels"]
+               and all(e.get("commit") == "fused" for e in starts)),
+        "rescue_depth": preempted.depth,
+        "distinct_fused": res_fused.distinct_states,
+        "distinct_per_action": res_pa.distinct_states,
+    }
+
+
 def scenario_corrupt_ckpt(tmp):
     ORACLE = _oracle()
     from tpuvsr.resilience import faults
@@ -678,6 +727,7 @@ SCENARIOS = [
     ("oom-paged-fallback", scenario_oom_paged_fallback),
     ("kill-rescue", scenario_kill_rescue),
     ("pack-kill-rescue", scenario_pack_kill_rescue),
+    ("kill-fused-commit-resume", scenario_kill_fused_commit_resume),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),
@@ -717,4 +767,4 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

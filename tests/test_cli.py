@@ -109,6 +109,21 @@ def test_cli_sharded_flag_conflicts_exit_2(bad):
 
 
 @pytest.mark.parametrize("bad", [
+    ["-commit", "fused", "-engine", "interp"],
+    ["-chained", "-fused"],
+    ["-chained", "-recover", "ck"],
+], ids=["commit-interp", "chained-fused",
+        "chained-recover-unsupervised"])
+def test_cli_commit_flag_conflicts_exit_2(bad):
+    """ISSUE 10: -commit configures the BFS level kernel and -chained
+    the device dispatch window; their documented conflicts are
+    argparse errors (exit 2) before any spec is loaded."""
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
+@pytest.mark.parametrize("bad", [
     ["-pack", "on", "-engine", "interp"],
     ["-pack", "on", "-fpset", "host"],
     ["-pack", "maybe"],

@@ -642,7 +642,8 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["ok"] and len(out["scenarios"]) == 16
+    # 17 scenarios since ISSUE 10 (kill-fused-commit-resume)
+    assert out["ok"] and len(out["scenarios"]) == 17
 
 
 # ---------------------------------------------------------------------

@@ -77,6 +77,22 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    level-boundary snapshots and SIGTERM rescues work;
                    a supervised resume continues through the chunked
                    engine)
+  -chained         device BFS: cross-level chained window
+                   (run_chained) — the dispatch window survives level
+                   boundaries; checkpointable via its level-boundary
+                   rescue seam (-checkpoint; snapshots resume through
+                   the chunked engine, so -recover needs -supervise,
+                   which journals the mode degrade)
+  -commit MODE     fused | per-action (default fused): level-kernel
+                   commit mode.  fused runs the occupancy-packed
+                   three-stage tile pass (chunk-wide guard matrix ->
+                   work-queue compaction -> single-commit tiles: ONE
+                   FPSet insert batch + ONE scatter per frontier tile
+                   instead of n_actions of each, expansion caps sized
+                   by exact enabled counts).  per-action is the
+                   historical serial-phase body.  Results are
+                   bit-identical either way (README "The level
+                   kernel")
   -pipeline K      device/paged/sharded BFS dispatch window: keep up
                    to K level-kernel dispatches in flight, blocking
                    only on the oldest, so host-side work (journal,
@@ -153,7 +169,11 @@ table); -walkers/-split/-hunt without -simulate, or with
 -engine interp/-fpset host (the fleet is a device backend);
 explicit -pack on with -engine interp/-fpset host (the packed
 frontier is a device-engine format; the interpreter has no dense
-frontier to pack);
+frontier to pack); -chained with -fused/-engine sharded/-engine
+interp/-fpset host/-simulate/-validate, or with -recover unless
+-supervise (the chained window has no resume path of its own);
+explicit -commit with -engine interp/-fpset host/-simulate/-validate
+(it configures the BFS level kernel);
 -validate with -simulate/-hunt/-fused/-supervise/-deadlock/
 -maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
 is its own engine mode: rescue checkpoints are preemption-driven, the
@@ -249,6 +269,22 @@ def build_parser():
                         " dispatches (no per-level host syncs; remote-"
                         "TPU mode; excludes -checkpoint/-recover "
                         "unless -supervise)")
+    p.add_argument("-chained", action="store_true",
+                   help="device engine: cross-level chained window "
+                        "(run_chained) — the dispatch window survives "
+                        "level boundaries; now checkpointable via its "
+                        "level-boundary rescue seam (-checkpoint; a "
+                        "snapshot resumes through the chunked engine, "
+                        "so -recover needs -supervise)")
+    p.add_argument("-commit", choices=["fused", "per-action"],
+                   default=None, metavar="MODE",
+                   help="level-kernel commit mode (default fused): "
+                        "'fused' runs the occupancy-packed three-stage "
+                        "tile pass — chunk-wide guard matrix, "
+                        "work-queue compaction, ONE FPSet insert batch "
+                        "+ ONE scatter per tile; 'per-action' runs the "
+                        "historical n_actions serial phases.  Results "
+                        "are bit-identical either way")
     p.add_argument("-pipeline", type=int, default=None, metavar="K",
                    help="device/paged/sharded BFS dispatch window: "
                         "keep K level-kernel dispatches in flight, "
@@ -310,6 +346,35 @@ def validate_args(parser, args):
                      "through the chunked engine)")
     if args.pipeline is not None and args.pipeline < 1:
         parser.error(f"-pipeline must be >= 1 (got {args.pipeline})")
+    if args.chained:
+        if args.fused:
+            parser.error("-chained and -fused are different device "
+                         "dispatch modes; pick one")
+        if args.engine == "sharded":
+            parser.error("-chained is the device engine's cross-level "
+                         "window; the sharded engine's per-level "
+                         "exchange needs the host in the loop")
+        if args.engine == "interp" or args.fpset == "host":
+            parser.error("-chained needs the device engine")
+        if args.simulate or args.validate is not None:
+            parser.error("-chained configures the BFS dispatch "
+                         "window; it cannot be combined with "
+                         "-simulate/-validate")
+        if args.recover and not args.supervise:
+            parser.error("-chained has no resume path (its snapshots "
+                         "resume through the chunked engine): combine "
+                         "-recover with -supervise, which journals "
+                         "the mode degrade, or drop -chained")
+    if args.commit is not None:
+        if args.engine == "interp" or args.fpset == "host":
+            parser.error("-commit configures the device level kernel; "
+                         "it cannot be combined with -engine interp/"
+                         "-fpset host")
+        if args.simulate or args.validate is not None:
+            parser.error("-commit configures the BFS level kernel; it "
+                         "cannot be combined with -simulate/-validate "
+                         "(the fleet and the validator have their own "
+                         "dispatch packing)")
     if args.fpset == "host" and args.engine == "device":
         parser.error("-fpset host requires -engine interp (the host "
                      "fingerprint set only exists in the interpreter)")
@@ -594,6 +659,8 @@ def main(argv=None):
     # packs whenever the codec declares plane_bounds — every
     # registered layout); -pack off runs the dense format
     pack_kw = False if args.pack == "off" else "auto"
+    # level-kernel commit mode (ISSUE 10): fused is the default
+    commit_kw = args.commit or "fused"
 
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
@@ -717,10 +784,15 @@ def main(argv=None):
                     journal_path=args.journal,
                     metrics_path=args.metrics, log=log,
                     # -fused under -supervise: rescue-quantum-bounded
-                    # fused dispatches; resume continues chunked
+                    # fused dispatches; resume continues chunked.
+                    # -chained likewise: the chained window's
+                    # level-boundary rescue seam checkpoints, resume
+                    # continues chunked (journaled mode degrade)
                     fused=args.fused and engine == "device",
+                    chained=args.chained and engine == "device",
                     engine_kwargs={"pipeline": args.pipeline,
-                                   "pack": pack_kw})
+                                   "pack": pack_kw,
+                                   "commit": commit_kw})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -746,7 +818,7 @@ def main(argv=None):
                 mesh = Mesh(np.array(jax.devices()), ("d",))
                 log(f"sharded mesh: {mesh.shape['d']} devices")
                 eng = ShardedBFS(spec, mesh, pipeline=args.pipeline,
-                                 pack=pack_kw)
+                                 pack=pack_kw, commit=commit_kw)
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
@@ -768,12 +840,13 @@ def main(argv=None):
                 if want_graph:
                     eng = PagedBFS(spec, retain_levels=True,
                                    pipeline=args.pipeline,
-                                   pack=pack_kw)
+                                   pack=pack_kw, commit=commit_kw)
                 else:
                     eng = (PagedBFS if engine == "paged"
                            else DeviceBFS)(spec,
                                            pipeline=args.pipeline,
-                                           pack=pack_kw)
+                                           pack=pack_kw,
+                                           commit=commit_kw)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:
@@ -784,11 +857,32 @@ def main(argv=None):
                     log("-fused excludes -checkpoint/-recover; "
                         "using chunked run")
                     use_fused = False
+                use_chained = (args.chained
+                               and isinstance(eng, DeviceBFS)
+                               and not isinstance(eng, PagedBFS))
+                if args.chained and not use_chained:
+                    log("-chained needs the plain device engine (no "
+                        "temporal properties / -fpset paged); using "
+                        "chunked run")
                 if use_fused:
                     res = eng.run_fused(
                         max_states=args.maxstates,
                         max_seconds=args.maxseconds,
                         check_deadlock=args.deadlock, log=log, obs=obs)
+                elif use_chained:
+                    # the chained window is checkpointable through its
+                    # level-boundary rescue seam (ISSUE 10 satellite)
+                    # — no more silent fallback to run() for
+                    # checkpointed runs
+                    res = eng.run_chained(
+                        max_states=args.maxstates,
+                        max_seconds=args.maxseconds,
+                        check_deadlock=args.deadlock, log=log, obs=obs,
+                        checkpoint_path=(ckpt_dir if args.checkpoint
+                                         else None),
+                        checkpoint_every=(args.checkpoint * 60.0
+                                          if args.checkpoint
+                                          else None))
                 else:
                     res = eng.run(
                         max_states=args.maxstates,

@@ -4,19 +4,45 @@ This is the reference's hot loop — TLC's BFS worker (SURVEY.md §3.1) —
 restructured so an entire BFS level runs ON DEVICE inside one jitted
 ``lax.while_loop``, with a single host synchronization per chunk of
 tiles (round 1 synced ~5x per 32-state tile, which over a tunneled TPU
-was the whole runtime).  Per tile of T frontier states, per action:
+was the whole runtime).  The tile body is the occupancy-packed
+THREE-STAGE pass (ISSUE 10, ``commit="fused"``, the default):
 
-  tile --guard pass --> enabled mask over all lanes (cheap)
-       --compaction  --> enabled lanes only, per-action capacity caps
-       --vmap expand --> successors for enabled lanes (vsr_kernel)
-       --fingerprint --> incremental 128-bit fp    (VIEW + symmetry)
-       --invariants  --> per-successor pass/fail
-       --FPSet insert--> fresh mask (claim-based, duplicate-tolerant;
-                         a conservative headroom check at tile entry
-                         keeps inserts and scatters atomic)
-       --scatter     --> fresh successors + (parent, action, param)
-                         written straight into the device-resident
-                         next-frontier buffer
+  chunk --guard matrix--> every action's guard over every lane of the
+                          whole chunk of tiles, one vmapped pass:
+                          EXACT per-action enabled counts (generated /
+                          per-action counters, deadlock detection,
+                          exact cap-overflow `need` so growth hits the
+                          true count and level boundaries calibrate
+                          the caps back down onto observed maxima)
+  tile  --work queue  --> enabled (state, lane) items packed into
+                          dense per-action segments of one tile-local
+                          staging queue; ONLY real items are expanded
+                          (vsr_kernel), fingerprinted (VIEW +
+                          symmetry, incremental 128-bit), and
+                          invariant-checked — expand FLOPs scale with
+                          `generated`, not sum of static caps
+  tile  --single commit-> ONE FPSet insert_core batch + ONE scatter
+                          set per tile (vs n_actions of each): a
+                          stable first-occurrence dedup mask picks the
+                          earliest queue item among duplicate
+                          fingerprints (= the per-action commit
+                          order), the claim column arbitrates distinct
+                          fingerprints racing for a slot, and the
+                          headroom check at tile entry keeps inserts
+                          and scatters atomic
+
+``commit="per-action"`` preserves the historical body — n_actions
+serial guard/compact/expand/insert/scatter phases per tile — and the
+two modes are BIT-IDENTICAL in counts, level sizes and traces
+(tests/test_commit.py; the failure-cause priority and the
+committed-action-prefix rule on a failing tile are replicated
+verbatim).  One documented edge: an FPSet PROBE-OVERFLOW pause
+(R_FPSET_GROW mid-tile, rare — the proactive between-level growth
+keeps chains short) commits the resolvable subset of the single batch
+where per-action committed an action prefix, so after re-entry that
+tile's next-frontier gids may be ORDERED differently between the
+modes; the committed sets, counts, level sizes and trace CONTENT
+still agree (both orders dedup to the same exploration).
 
 Full states never leave the device.  The host keeps only the compact
 (parent gid, action id, lane param) pointer table, and counterexamples
@@ -62,7 +88,8 @@ from ..obs import RunObserver, closes_observer
 from ..resilience.faults import fault_point
 from ..resilience.supervisor import Preempted, preempt_signal
 from .bfs import CheckResult
-from .fpset import empty_table, grow, insert_batch, insert_core
+from .fpset import (dedup_batch, empty_table, grow, insert_batch,
+                    insert_core)
 from .spec import SpecModel
 from .trace import TraceEntry
 
@@ -82,6 +109,13 @@ R_EXPAND_GROW = 8    # per-action enabled-lane compaction buffer too small
 _value_perm_table = registry.value_perm_table
 
 
+def _align8(n):
+    """Round an expansion-cap target up to a lane multiple of 8 (keeps
+    the compaction shapes TPU-register friendly without inflating the
+    occupancy denominator)."""
+    return ((int(n) + 7) // 8) * 8
+
+
 # Largest tile width validated against the pinned fixpoint counts on
 # the real TPU (axon): tile=1024 mis-explored the flagship config
 # (58,957 distinct vs pinned 43,941 — scripts/tile_sweep.json), an
@@ -96,7 +130,10 @@ class DeviceBFS:
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
                  expand_mults=None, model_factory=None, pipeline=2,
-                 pack="auto"):
+                 pack="auto", commit="fused"):
+        if commit not in ("fused", "per-action"):
+            raise TLAError(f"commit must be 'fused' or 'per-action' "
+                           f"(got {commit!r})")
         if (tile_size > MAX_VALIDATED_TPU_TILE
                 and os.environ.get("TPUVSR_UNSAFE_TILE") != "1"
                 and jax.default_backend() != "cpu"):
@@ -123,6 +160,22 @@ class DeviceBFS:
         # action names once the kernel exists (_build)
         self.expand_mults = expand_mults
         self._expand_mult_default = expand_mult
+        # level-kernel commit mode (ISSUE 10 tentpole).  "fused" (the
+        # default) restructures the tile pass into three stages —
+        # chunk-wide guard matrix, work-queue compaction, single-commit
+        # tiles — so each tile issues ONE FPSet insert batch and ONE
+        # scatter instead of n_actions of each, and the per-action
+        # expansion caps are sized by EXACT enabled counts instead of
+        # tile-multiple guesses.  "per-action" is the pre-ISSUE-10
+        # serial-phase body; results are bit-identical between the two
+        # (tests/test_commit.py).
+        self.commit = commit
+        # fused-mode per-action expansion caps (absolute lane counts,
+        # exact-count grown/calibrated; run-scoped — snapshots keep the
+        # per-action expand_mults format and a resumed fused run simply
+        # re-calibrates)
+        self.expand_caps = None
+        self._need_seen = None
         self.inv_names = list(spec.cfg.invariants)
         # model_factory(spec, max_msgs=..) -> (codec, kernel); default
         # is the hand-kernel registry, tests/the CLI can pass the
@@ -158,6 +211,21 @@ class DeviceBFS:
             self.expand_mults = base
         else:
             self.expand_mults = list(self.expand_mults)
+        if self.commit == "fused":
+            tl = [self.tile * self.kern._lane_count(n) for n in names]
+            if self.expand_caps is None:
+                # modest static start; the exact-count growth events
+                # (and the level-boundary calibration) converge the
+                # caps onto the observed per-tile maxima
+                self.expand_caps = [min(t, max(8, _align8(self.tile)))
+                                    for t in tl]
+            else:
+                # re-clamp after a MAX_MSGS rebuild (lane counts grow)
+                self.expand_caps = [min(t, max(8, int(c)))
+                                    for t, c in zip(tl, self.expand_caps)]
+            if self._need_seen is None or \
+                    len(self._need_seen) != len(names):
+                self._need_seen = np.zeros(len(names), np.int64)
         self.L = self.kern.n_lanes
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}          # action id -> jitted single-action fn
@@ -177,12 +245,49 @@ class DeviceBFS:
         # to the "compile" phase (jit traces+compiles at first call)
         self._fresh_jit = True
 
+    def _expand_caps(self):
+        """Per-action enabled-lane compaction capacities, in lanes.
+        Fused commit: the absolute exact-count caps (grown to observed
+        need, calibrated down at level boundaries).  Per-action commit:
+        the historical tile-multiple formula.  PagedBFS sizes its
+        next-buffer headroom floor from the same list."""
+        kern, T = self.kern, self.tile
+        if self.commit == "fused":
+            return [min(T * kern._lane_count(n), max(8, int(c)))
+                    for n, c in zip(kern.action_names, self.expand_caps)]
+        return [min(T * kern._lane_count(nm),
+                    max(64, T * self.expand_mults[a]))
+                for a, nm in enumerate(kern.action_names)]
+
+    def _guard_matrix(self, kern):
+        """Stage 1 of the fused pass: a closure evaluating EVERY
+        action's guard over a dense state batch in one vmapped sweep —
+        returns the per-action [B, L_a] enabled matrices.  Applied
+        chunk-wide by _make_level (exact per-action counts for the
+        whole chunk of tiles) and tile-wide inside the multilevel
+        body."""
+        guards = kern._guard_fns()
+
+        def mat(batch):
+            segs = []
+            for name, guard in zip(kern.action_names, guards):
+                lanes = jnp.arange(kern._lane_count(name), dtype=I32)
+                segs.append(jax.vmap(lambda st: jax.vmap(
+                    lambda ln, g=guard: g(st, ln))(lanes))(batch))
+            return segs
+
+        return mat
+
     def _tile_body_factory(self):
         """Build the one-tile expansion body shared by the chunked
         level pass (_make_level) and the fused multi-level pass
         (_make_multilevel).  Returns (caps, total_E, make_body) where
-        make_body(frontier, n_front, want_deadlock) closes over the
-        (possibly traced) frontier and count.
+        make_body(frontier, n_front, want_deadlock, chunk_ctx=None)
+        closes over the (possibly traced) frontier and count;
+        ``chunk_ctx`` optionally feeds the body a chunk-wide
+        precomputed (dense states, guard matrix, start tile) so the
+        fused body consumes the hoisted stage-1 pass instead of
+        re-deriving it per tile.
 
         Packed frontier (ISSUE 9): with a pack spec bound, the at-rest
         frontier and next buffers are ``[cap, words]`` uint32 planes —
@@ -190,6 +295,8 @@ class DeviceBFS:
         so the expansion/fingerprint/invariant pipeline in between is
         UNCHANGED and results stay bit-identical with packing on/off
         (the pack/unpack round trip is exact for in-range values)."""
+        if self.commit == "fused":
+            return self._fused_body_factory()
         kern = self.kern
         inv = self._inv
         pk = self._pk
@@ -198,12 +305,10 @@ class DeviceBFS:
 
         # per-action compaction capacities (adaptive; R_EXPAND_GROW
         # carries the overflowing action so only it grows)
-        caps = [min(T * kern._lane_count(nm),
-                    max(64, T * self.expand_mults[a]))
-                for a, nm in enumerate(kern.action_names)]
+        caps = self._expand_caps()
         total_E = sum(caps)
 
-        def make_body(frontier, n_front, want_deadlock):
+        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None):
             F_cap = (frontier.shape[0] if pk is not None
                      else frontier["status"].shape[0])
 
@@ -366,6 +471,9 @@ class DeviceBFS:
                                    t + 1, t),
                     "reason": reason, "viol": viol, "dead": dead_i,
                     "grow_aid": grow_aid,
+                    # per-action mode sizes growth by doubling; the
+                    # need vector only carries data in fused commit
+                    "need": c["need"],
                     "slots": slots,
                     "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                     "nn": nn, "dist": dist,
@@ -378,26 +486,286 @@ class DeviceBFS:
 
         return caps, total_E, make_body
 
+    def _fused_body_factory(self):
+        """The ISSUE 10 tentpole body: one frontier tile flows through
+        three stages —
+
+        (1) **guard matrix**: every action's guard over every lane of
+            the tile in one sweep (no expansion interleaved), yielding
+            EXACT per-action enabled counts: they drive the generated/
+            per-action counters, deadlock detection, and exact
+            cap-overflow events (the ``need`` vector carries the
+            observed per-action maxima so growth is sized to the real
+            count, not a doubling guess);
+        (2) **work-queue compaction**: each action's enabled
+            (state, lane) items are packed into a dense per-action
+            segment of one tile-local staging queue (action-major, so
+            queue order == the per-action commit order) and ONLY those
+            lanes are expanded/fingerprinted/invariant-checked;
+        (3) **single-commit**: the staged segments are committed with
+            ONE FPSet ``insert_core`` batch and ONE scatter set per
+            tile (vs n_actions of each).  A stable first-occurrence
+            dedup mask makes the intra-batch winner for duplicate
+            fingerprints the earliest queue item — exactly the action
+            order the per-action body commits in — and the
+            failure-cause priority (violation > slot > bag >
+            expand-grow > fpset-grow) plus the committed-action-prefix
+            rule on a failing tile are preserved verbatim, so results
+            are bit-identical to commit="per-action"."""
+        kern = self.kern
+        inv = self._inv
+        pk = self._pk
+        T = self.tile
+        incremental = self.hash_mode == "incremental"
+        n_act = len(kern.action_names)
+        caps = self._expand_caps()
+        total_E = sum(caps)
+        caps_v = jnp.asarray(caps, I32)
+        aid_q = jnp.asarray(np.repeat(np.arange(n_act, dtype=np.int32),
+                                      caps))
+        guard_mat = self._guard_matrix(kern)
+
+        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None):
+            F_cap = (frontier.shape[0] if pk is not None
+                     else frontier["status"].shape[0])
+
+            def body(c):
+                t = c["t"]
+                base = t * T
+                sidx = base + jnp.arange(T, dtype=I32)
+                valid = sidx < n_front
+                if chunk_ctx is not None:
+                    cstates, csegs, c_start = chunk_ctx
+                    off = (t - c_start) * T
+                    tile = {k: jax.lax.dynamic_slice_in_dim(v, off, T)
+                            for k, v in cstates.items()}
+                    en_segs = [jax.lax.dynamic_slice_in_dim(s, off, T)
+                               for s in csegs]
+                else:
+                    if pk is not None:
+                        tile = jax.vmap(pk.unpack)(
+                            frontier[jnp.clip(sidx, 0, F_cap - 1)])
+                    else:
+                        tile = {k: v[jnp.clip(sidx, 0, F_cap - 1)]
+                                for k, v in frontier.items()}
+                    en_segs = guard_mat(tile)
+                # -- stage 1: guard matrix -> exact per-action counts --
+                en_segs = [e & valid[:, None] for e in en_segs]
+                cnts = jnp.stack([e.sum(dtype=I32) for e in en_segs])
+                en_any = jnp.zeros((T,), bool)
+                for e in en_segs:
+                    en_any = en_any | e.any(axis=1)
+                gen_local = cnts.sum()
+                ovf_vec = cnts > caps_v
+                ovf_e = ovf_vec.any()
+                grow_aid = jnp.where(ovf_e,
+                                     jnp.argmax(ovf_vec).astype(I32),
+                                     c["grow_aid"])
+                need = jnp.maximum(c["need"], cnts.astype(jnp.uint32))
+
+                slots = c["slots"]
+                nb, nbp, nba, nbprm = c["nb"], c["nbp"], c["nba"], c["nbprm"]
+                N_cap = nbp.shape[0]
+                nn, dist = c["nn"], c["dist"]
+                reason, viol = c["reason"], c["viol"]
+                # same headroom gate as the per-action body: with
+                # N_cap - nn >= total_E no scatter can overrun, so an
+                # insert is never committed without its successors
+                commit0 = (N_cap - nn) >= total_E
+                reason = jnp.where((reason == RUNNING) & ~commit0,
+                                   R_NEXT_GROW, reason)
+
+                # -- stage 2: work-queue compaction + expansion --------
+                if incremental:
+                    parts = jax.vmap(kern.parent_parts)(tile)
+                succ_segs, fp_segs, en_s_segs = [], [], []
+                pidx_segs, lane_segs = [], []
+                viol_any = jnp.asarray(False)
+                bag_err = jnp.asarray(False)
+                slot_err = jnp.asarray(False)
+                first_bad = jnp.asarray(n_act, I32)
+                for aid, (name, fn) in enumerate(
+                        zip(kern.action_names, kern._action_fns())):
+                    L_a = kern._lane_count(name)
+                    TL = T * L_a
+                    E_a = caps[aid]
+                    en_f = en_segs[aid].reshape(TL)
+                    (sel,) = jnp.nonzero(en_f, size=E_a, fill_value=TL)
+                    sel_ok = sel < TL
+                    pidx = jnp.clip(sel // L_a, 0, T - 1).astype(I32)
+                    lane_sel = (sel % L_a).astype(I32)
+                    st_sel = {k: v[pidx] for k, v in tile.items()}
+
+                    if incremental:
+                        parts_sel = jax.tree_util.tree_map(
+                            lambda v: v[pidx], parts)
+
+                        def one(st, parts_one, lane, fn=fn, name=name):
+                            succ, en1 = fn(kern.seed_touch(st), lane)
+                            ri = kern.lane_replica(name, st, lane)
+                            fp = kern.fingerprint_incremental(
+                                succ, ri, parts_one, st)
+                            clean = {k: v for k, v in succ.items()
+                                     if not k.startswith("_")}
+                            return clean, fp, en1, inv(clean), clean["err"]
+                        succ_f, fp, en2, iok, errv = jax.vmap(one)(
+                            st_sel, parts_sel, lane_sel)
+                    else:
+                        def one(st, lane, fn=fn):
+                            succ, en1 = fn(st, lane)
+                            return (succ, kern.fingerprint(succ), en1,
+                                    inv(succ), succ["err"])
+                        succ_f, fp, en2, iok, errv = jax.vmap(one)(
+                            st_sel, lane_sel)
+
+                    en_s = en2 & sel_ok
+                    errv = jnp.where(en_s, errv, 0)
+                    viol_l = en_s & ~iok & (errv == 0)
+                    a_bag = ((errv & ERR_BAG_OVERFLOW) != 0).any()
+                    a_slot = ((errv & ~ERR_BAG_OVERFLOW) != 0).any()
+                    have_v = viol_l.any()
+                    vidx = jnp.argmax(viol_l)
+                    vinfo = jnp.stack([(base + pidx[vidx]).astype(I32),
+                                       jnp.asarray(aid, I32),
+                                       lane_sel[vidx]])
+                    viol = jnp.where(have_v & (viol[0] < 0), vinfo, viol)
+                    viol_any = viol_any | have_v
+                    bag_err = bag_err | a_bag
+                    slot_err = slot_err | a_slot
+                    # committed-prefix rule: every queue item of an
+                    # action at or past the FIRST failing one commits
+                    # nothing (identical to the per-action body's
+                    # carried commit flag going false there)
+                    bad_a = have_v | a_slot | a_bag | ovf_vec[aid]
+                    first_bad = jnp.minimum(
+                        first_bad, jnp.where(bad_a, aid, n_act))
+                    succ_segs.append(succ_f)
+                    fp_segs.append(fp)
+                    en_s_segs.append(en_s)
+                    pidx_segs.append(pidx)
+                    lane_segs.append(lane_sel)
+
+                succ_q = {k: jnp.concatenate([s[k] for s in succ_segs])
+                          for k in succ_segs[0]}
+                fp_q = jnp.concatenate(fp_segs)
+                en_q = jnp.concatenate(en_s_segs)
+                pidx_q = jnp.concatenate(pidx_segs)
+                lane_q = jnp.concatenate(lane_segs)
+
+                # -- stage 3: ONE insert batch + ONE scatter per tile --
+                mcommit = en_q & (aid_q < first_bad) & commit0
+                # stable first-occurrence dedup: the winner among equal
+                # fingerprints is the earliest queue item (= earliest
+                # action, matching the per-action commit order); the
+                # FPSet claim column then only has to arbitrate
+                # distinct fingerprints racing for one probe slot
+                perm, keep = dedup_batch(fp_q, mcommit)
+                canon = jnp.zeros((total_E,), bool).at[perm].set(keep)
+                tbl, fresh, ovf_i = insert_core(
+                    {"slots": slots}, fp_q, canon)
+                slots = tbl["slots"]
+                dest = jnp.where(fresh, nn + jnp.cumsum(fresh) - 1,
+                                 N_cap).astype(I32)
+                if pk is not None:
+                    nb = nb.at[dest].set(jax.vmap(pk.pack)(succ_q),
+                                         mode="drop")
+                else:
+                    for k in nb:
+                        nb[k] = nb[k].at[dest].set(succ_q[k],
+                                                   mode="drop")
+                nbp = nbp.at[dest].set(base + pidx_q, mode="drop")
+                nba = nba.at[dest].set(aid_q, mode="drop")
+                nbprm = nbprm.at[dest].set(lane_q, mode="drop")
+                nfi = fresh.sum()
+                nn = nn + nfi
+                dist = dist + nfi
+                commit = commit0 & (first_bad >= n_act) & ~ovf_i
+
+                # failure cause priority: violation > slot error > bag
+                # growth > expand-capacity > fpset growth (same order
+                # as the per-action body)
+                new_reason = jnp.where(
+                    viol_any, R_VIOLATION,
+                    jnp.where(slot_err, R_SLOT_ERR,
+                              jnp.where(bag_err, R_BAG_GROW,
+                                        jnp.where(ovf_e, R_EXPAND_GROW,
+                                                  jnp.where(ovf_i,
+                                                            R_FPSET_GROW,
+                                                            RUNNING)))))
+                reason = jnp.where(reason == RUNNING, new_reason, reason)
+
+                dead = valid & ~en_any
+                dl = want_deadlock & commit & dead.any()
+                reason = jnp.where(dl & (reason == RUNNING),
+                                   R_DEADLOCK, reason)
+                dead_i = jnp.where(dl, base + jnp.argmax(dead), c["dead"])
+                return {
+                    "t": jnp.where(commit & (reason == RUNNING),
+                                   t + 1, t),
+                    "reason": reason, "viol": viol, "dead": dead_i,
+                    "grow_aid": grow_aid, "need": need,
+                    "slots": slots,
+                    "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
+                    "nn": nn, "dist": dist,
+                    "gen": c["gen"] + jnp.where(commit, gen_local, 0),
+                    "act": c["act"] + jnp.where(
+                        commit, cnts.astype(jnp.uint32), jnp.uint32(0)),
+                }
+
+            return body
+
+        return caps, total_E, make_body
+
     def _make_level(self):
         T = self.tile
         K = self.chunk_tiles
         _caps, _tot, make_body = self._tile_body_factory()
+        fused = self.commit == "fused"
+        pk = self._pk
+        kern = self.kern
+        guard_mat = self._guard_matrix(kern) if fused else None
 
         def level(slots, frontier, n_front, start_t,
                   nb, nbp, nba, nbprm, n_next0, want_deadlock):
             n_tiles = (n_front + T - 1) // T
+            chunk_ctx = None
+            need0 = jnp.zeros((len(_caps),), jnp.uint32)
+            if fused:
+                # chunk-wide guard matrix (ISSUE 10 stage 1): evaluate
+                # every guard for the WHOLE chunk of tiles in one
+                # vmapped pass before the tile loop runs — the body
+                # slices its tile's rows out, and the exact per-tile
+                # per-action counts make a cap-overflow pause report
+                # the exact need across the whole chunk (the host
+                # grows once, not once per tile)
+                F_cap = (frontier.shape[0] if pk is not None
+                         else frontier["status"].shape[0])
+                cidx = start_t * T + jnp.arange(K * T, dtype=I32)
+                cvalid = cidx < n_front
+                gidx = jnp.clip(cidx, 0, F_cap - 1)
+                if pk is not None:
+                    cstates = jax.vmap(pk.unpack)(frontier[gidx])
+                else:
+                    cstates = {k: v[gidx] for k, v in frontier.items()}
+                csegs = [e & cvalid[:, None] for e in guard_mat(cstates)]
+                need0 = jnp.stack(
+                    [e.reshape(K, -1).sum(axis=1, dtype=I32).max()
+                     for e in csegs]).astype(jnp.uint32)
+                chunk_ctx = (cstates, csegs, start_t)
 
             def cond(c):
                 return ((c["t"] < n_tiles) & (c["t"] < start_t + K)
                         & (c["reason"] == RUNNING))
 
-            body = make_body(frontier, n_front, want_deadlock)
+            body = make_body(frontier, n_front, want_deadlock,
+                             chunk_ctx=chunk_ctx)
             init = {
                 "t": jnp.asarray(start_t, I32),
                 "reason": jnp.asarray(RUNNING, I32),
                 "viol": jnp.full((3,), -1, I32),
                 "dead": jnp.asarray(-1, I32),
                 "grow_aid": jnp.asarray(-1, I32),
+                "need": need0,
                 "slots": slots,
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": jnp.asarray(n_next0, I32),
@@ -476,6 +844,7 @@ class DeviceBFS:
                     "viol": jnp.full((3,), -1, I32),
                     "dead": jnp.asarray(-1, I32),
                     "grow_aid": jnp.asarray(-1, I32),
+                    "need": c["need"],
                     "slots": c["slots"],
                     "nb": c["nb"], "nbp": c["nbp"], "nba": c["nba"],
                     "nbprm": c["nbprm"],
@@ -538,7 +907,7 @@ class DeviceBFS:
                     "tiles": c["tiles"] + (r["t"] - c["start_t"]),
                     "reason": r["reason"],
                     "viol": r["viol"], "dead": r["dead"],
-                    "grow_aid": r["grow_aid"],
+                    "grow_aid": r["grow_aid"], "need": r["need"],
                     "act": r["act"],
                 }
 
@@ -560,6 +929,7 @@ class DeviceBFS:
                 "viol": jnp.full((3,), -1, I32),
                 "dead": jnp.asarray(-1, I32),
                 "grow_aid": jnp.asarray(-1, I32),
+                "need": jnp.zeros((len(_caps),), jnp.uint32),
                 "act": jnp.zeros((len(_caps),), jnp.uint32),
             }
             return jax.lax.while_loop(ocond, obody, init)
@@ -609,6 +979,94 @@ class DeviceBFS:
         add = cap * (factor - 1)
         return (cls._pad_rows(nb, add), cls._pad_rows(nbp, add),
                 cls._pad_rows(nba, add), cls._pad_rows(nbprm, add))
+
+    # ------------------------------------------------------------------
+    # exact-count expansion caps (ISSUE 10)
+    # ------------------------------------------------------------------
+    def _fold_need(self, need):
+        """Fold one dispatch's chunk-wide per-action enabled maxima
+        into the run-scoped observation (the exact-growth and
+        calibration source)."""
+        if self.commit == "fused" and self._need_seen is not None:
+            self._need_seen = np.maximum(
+                self._need_seen, np.asarray(need, np.int64))
+
+    def _grow_expand(self, aid, obs, emit):
+        """R_EXPAND_GROW handler shared by the chunked/fused/chained
+        (and paged) loops.  Fused commit: grow EVERY action whose
+        observed exact need exceeds its cap — the chunk-wide guard
+        matrix already measured the true maxima, so one recompile
+        covers the whole chunk instead of one doubling guess per tile.
+        Per-action commit: the historical doubling of the overflowing
+        action's tile multiplier."""
+        kern = self.kern
+        if self.commit == "fused":
+            caps = self._expand_caps()
+            grown = []
+            for a, name in enumerate(kern.action_names):
+                need = int(self._need_seen[a])
+                if need > caps[a]:
+                    self.expand_caps[a] = min(
+                        self.tile * kern._lane_count(name),
+                        _align8(need))
+                    grown.append((name, self.expand_caps[a]))
+            if not grown:
+                # defensive: a pause whose need never reached the host
+                # (should not happen — the paused ticket carries it)
+                self.expand_caps[aid] = min(
+                    self.tile * kern._lane_count(kern.action_names[aid]),
+                    _align8(caps[aid] * 2))
+                grown = [(kern.action_names[aid], self.expand_caps[aid])]
+            for _name, cap in grown:
+                obs.grow("expand_buffer", cap)
+            emit("expand caps grown to exact chunk need: "
+                 + ", ".join(f"{n}={c}" for n, c in grown)
+                 + " (recompiling)")
+        else:
+            self.expand_mults[aid] *= 2
+            obs.grow("expand_buffer", self.expand_mults[aid])
+            emit(f"expand buffer for {kern.action_names[aid]} grown "
+                 f"to tile x {self.expand_mults[aid]} (recompiling)")
+        self._level = jax.jit(self._make_level(),
+                              donate_argnums=(0, 4, 5, 6, 7))
+        self._ml = None
+        self._wl = None
+        self._fresh_jit = True
+
+    def _calibrate_caps(self, obs, emit, level_states):
+        """Level-boundary cap calibration (fused commit): shrink the
+        per-action expansion caps onto the observed exact per-tile
+        maxima once a representative level has been measured.  Only
+        ever fires when it saves >= 20% of the dispatched expand lanes
+        (each calibration is a recompile); caps can only shrink onto
+        real observations, so a later bigger tile simply triggers an
+        exact growth event.  Cap changes never affect results — only
+        which lanes are padding (the occupancy gauge's denominator)."""
+        if self.commit != "fused" or level_states < 4 * self.tile:
+            return False
+        kern, T = self.kern, self.tile
+        tgt = [min(T * kern._lane_count(n),
+                   max(8, _align8(max(int(s), 1))))
+               for n, s in zip(kern.action_names, self._need_seen)]
+        cur = self._expand_caps()
+        if sum(tgt) * 5 > sum(cur) * 4:
+            return False
+        self.expand_caps = tgt
+        self._level = jax.jit(self._make_level(),
+                              donate_argnums=(0, 4, 5, 6, 7))
+        self._ml = None
+        self._wl = None
+        self._fresh_jit = True
+        obs.grow("expand_calibrate", sum(tgt))
+        emit(f"expand caps calibrated to exact chunk maxima "
+             f"({sum(cur)} -> {sum(tgt)} lanes/tile; recompiling)")
+        return True
+
+    def _account_tiles(self, n_tiles):
+        """Occupancy accounting: `n_tiles` frontier tiles were
+        dispatched under the current cap set."""
+        self._tiles_done += int(n_tiles)
+        self._lanes_disp += int(n_tiles) * sum(self._expand_caps())
 
     # ------------------------------------------------------------------
     def _alloc_bufs(self, cap):
@@ -722,12 +1180,16 @@ class DeviceBFS:
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
+        obs.commit = self.commit
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
-        # with the control scalars; run-scoped, not checkpointed)
+        # with the control scalars; run-scoped, not checkpointed) +
+        # occupancy accounting (ISSUE 10)
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
+        self._tiles_done = 0
+        self._lanes_disp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend(),
@@ -826,7 +1288,8 @@ class DeviceBFS:
             # ONE host round-trip for all control scalars — separate
             # int() pulls cost one tunnel RTT each on a remote TPU
             return jax.device_get([o["reason"], o["t"], o["nn"],
-                                   o["gen"], o["dist"], o["act"]])
+                                   o["gen"], o["dist"], o["act"],
+                                   o["need"]])
         return self._chunk_loop(
             res, obs, pipe, pull, table=table, front=front,
             bufs=bufs, fpar=fpar, fact=fact, fprm=fprm,
@@ -884,6 +1347,7 @@ class DeviceBFS:
                 res.states_generated += gen_add
                 fp_count += dist_add
                 self._act_counts += np.asarray(sc[5], np.int64)
+                self._fold_need(sc[6])
 
                 if reason == RUNNING:
                     obs.progress(depth=depth, distinct=fp_count,
@@ -946,15 +1410,7 @@ class DeviceBFS:
                     emit(f"next-frontier buffer grown to "
                          f"{bufs[1].shape[0]}")
                 elif reason == R_EXPAND_GROW:
-                    aid = int(out["grow_aid"])
-                    self.expand_mults[aid] *= 2
-                    self._level = jax.jit(self._make_level(),
-                                          donate_argnums=(0, 4, 5, 6, 7))
-                    self._fresh_jit = True
-                    obs.grow("expand_buffer", self.expand_mults[aid])
-                    emit(f"expand buffer for {self.kern.action_names[aid]} grown "
-                         f"to tile x {self.expand_mults[aid]} "
-                         f"(recompiling)")
+                    self._grow_expand(int(out["grow_aid"]), obs, emit)
                 elif reason == R_SLOT_ERR:
                     raise TLAError(
                         "dense-layout slot collision (a second DVC or "
@@ -983,6 +1439,7 @@ class DeviceBFS:
             # ---- level complete: pull trace pointers, swap buffers ---
             obs.level_done(depth, frontier=n_front, distinct=fp_count,
                            generated=res.states_generated)
+            self._account_tiles(min(start_t, n_tiles))
             nb, nbp, nba, nbprm = bufs
             if n_next:
                 # async pointer fetch: the copies overlap the next
@@ -1003,6 +1460,11 @@ class DeviceBFS:
             n_front = n_next
             if self.debug_checks and n_next:
                 self._debug_assert_widths(front, n_next, depth)
+            # fused commit: shrink the expansion caps onto the exact
+            # observed maxima (the window is drained here, so the
+            # recompile never races an in-flight dispatch)
+            if n_next and stop is None:
+                self._calibrate_caps(obs, emit, n_front)
             # a pending SIGTERM/SIGINT (supervisor's PreemptionGuard)
             # forces a rescue snapshot at this boundary regardless of
             # cadence; at fixpoint (n_next == 0) the run finishes anyway
@@ -1116,11 +1578,14 @@ class DeviceBFS:
         obs = RunObserver.ensure(obs, "device-fused", self.spec, log=log)
         obs.pipeline = 1                # one fused dispatch in flight
         obs.pack = self._pk is not None
+        obs.commit = self.commit
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
+        self._tiles_done = 0
+        self._lanes_disp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend())
@@ -1205,10 +1670,13 @@ class DeviceBFS:
                     [out[k] for k in ("reason", "n_front", "start_t",
                                       "nn", "gen_level", "gen", "depth",
                                       "level_base", "fp_count",
-                                      "lvl_cur", "act")])
+                                      "lvl_cur", "act", "tiles",
+                                      "need")])
             (reason, n_front, start_t, nn, gen_level, gen_add, depth,
              level_base, fp_count, lvl_cur) = (int(x) for x in sc[:10])
             self._act_counts += np.asarray(sc[10], np.int64)
+            self._account_tiles(int(sc[11]))
+            self._fold_need(sc[12])
             res.states_generated += gen_add
             if lvl_cur:
                 # level boundaries inside one dispatch share its
@@ -1288,6 +1756,10 @@ class DeviceBFS:
                          f"resumable")
                     raise Preempted(checkpoint_path, depth, fp_count,
                                     rescue)
+                # quantum boundaries are level boundaries: safe spot
+                # to shrink the fused expansion caps onto the exact
+                # observed maxima (no dispatch in flight)
+                self._calibrate_caps(obs, emit, n_front)
                 # the next quantum starts with level depth+1 — same
                 # depth convention as the chunked engine's per-level
                 # hook.  The host only sees quantum boundaries, so a
@@ -1366,16 +1838,7 @@ class DeviceBFS:
                 obs.grow("next_buffer", f_cap)
                 emit(f"frontier buffers grown to {f_cap}")
             elif reason == R_EXPAND_GROW:
-                aid = int(out["grow_aid"])
-                self.expand_mults[aid] *= 2
-                self._level = jax.jit(self._make_level(),
-                                      donate_argnums=(0, 4, 5, 6, 7))
-                self._fresh_jit = True
-                self._ml = None
-                obs.grow("expand_buffer", self.expand_mults[aid])
-                emit(f"expand buffer for "
-                     f"{self.kern.action_names[aid]} grown to tile x "
-                     f"{self.expand_mults[aid]} (recompiling)")
+                self._grow_expand(int(out["grow_aid"]), obs, emit)
             elif reason == R_SLOT_ERR:
                 raise TLAError(
                     "dense-layout slot collision (a second DVC or "
@@ -1399,6 +1862,7 @@ class DeviceBFS:
     def run_chained(self, max_states=None, max_depth=None,
                     max_seconds=None, check_deadlock=False, log=None,
                     progress_every=10.0, levels_cap=1024,
+                    checkpoint_path=None, checkpoint_every=None,
                     obs=None) -> CheckResult:
         """Like run() with ``-pipeline K``, but the dispatch window
         SURVIVES level transitions (ISSUE 9 tentpole lever 3): run()
@@ -1422,18 +1886,30 @@ class DeviceBFS:
         every K (tests/test_pack.py asserts it).  Trace pointers and
         level sizes accumulate on device fused-style and are pulled per
         collected ticket (level sizes) / at the end (pointers).
-        Checkpointed or resumable runs use run() / run_fused — the
-        chained window has no level-boundary rescue seam."""
+
+        Rescue seam (ISSUE 10 satellite): with ``checkpoint_path`` the
+        chained run is checkpointable — when the cadence fires (or a
+        PreemptionGuard signal is pending) the window stops refilling,
+        drains through normal collects (trailing tickets hold REAL
+        work), and if the chain sits mid-level ONE level-bounded
+        dispatch (``max_lvls=1``, unbounded tile budget) completes the
+        current level exactly; a run()-format snapshot is then written
+        at the boundary, so a checkpointed run no longer has to fall
+        back to run().  The snapshot resumes through ``run()`` (the
+        supervisor journals that as a mode degrade, like fused)."""
         from ..analysis import preflight
         preflight(self.spec, log=log)
         obs = RunObserver.ensure(obs, "device-chained", self.spec,
                                  log=log, progress_every=progress_every)
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
+        obs.commit = self.commit
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
+        self._tiles_done = 0
+        self._lanes_disp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend())
@@ -1468,6 +1944,7 @@ class DeviceBFS:
         self.level_sizes = [n0]
         depth, fp_count, n_front = 0, n0, n0
         level_base, gen_level = 0, 0
+        h_start, h_nn = 0, 0      # collected chain position (seam)
 
         from .pipeline import DispatchPipeline
         pipe = DispatchPipeline(self.pipe_window, obs,
@@ -1477,7 +1954,8 @@ class DeviceBFS:
             return jax.device_get(
                 [o["reason"], o["n_front"], o["depth"], o["fp_count"],
                  o["level_base"], o["lvl_cur"], o["gen"],
-                 o["gen_level"], o["act"]])
+                 o["gen_level"], o["act"], o["start_t"], o["nn"],
+                 o["tiles"], o["need"]])
 
         def set_pointers(n):
             self._h_parent = [np.asarray(tpp[:n]).astype(np.int64)]
@@ -1488,11 +1966,16 @@ class DeviceBFS:
             """Collect the oldest ticket, fold its deltas into the
             host-side totals, and emit its committed levels."""
             nonlocal depth, fp_count, n_front, level_base, gen_level
+            nonlocal h_start, h_nn, levels_unck
             out, sc = pipe.collect(pull)
             (reason, n_front, depth, fp_count, level_base, lvl_cur,
              gen_add, gen_level) = (int(x) for x in sc[:8])
             res.states_generated += gen_add
             self._act_counts += np.asarray(sc[8], np.int64)
+            h_start, h_nn = int(sc[9]), int(sc[10])
+            levels_unck += lvl_cur
+            self._account_tiles(int(sc[11]))
+            self._fold_need(sc[12])
             if lvl_cur:
                 # each dispatch records its own committed levels from
                 # slot 0 of ITS lvl_buf output (which is why lvl_buf is
@@ -1512,38 +1995,54 @@ class DeviceBFS:
 
         emit = obs.log
         stop = None
+        ckpt_due = False
+        levels_unck = 0     # levels committed since the last snapshot
+        last_checkpoint = time.time()
+
+        def launch_next(tile_budget, max_lvls):
+            nonlocal table, front, nb, nbp, nba, nbprm, tpp, tpa, tpm
+            nonlocal lvl_buf, d_n_front, d_start, d_nn, d_gen_level
+            nonlocal d_depth, d_level_base, d_fp
+            fresh = self._fresh_jit or self._wl is None
+            if self._wl is None:
+                # the SAME pass run_fused jits, minus the lvl_buf
+                # donation (argnum 9): collected tickets read their
+                # level counters back while newer dispatches are
+                # already consuming the other buffers
+                self._wl = jax.jit(self._make_multilevel(),
+                                   donate_argnums=tuple(range(9)))
+            out = pipe.launch(
+                self._wl, table["slots"], front, nb, nbp, nba,
+                nbprm, tpp, tpa, tpm, lvl_buf,
+                d_n_front, d_start, d_nn, d_gen_level, d_depth,
+                d_level_base, d_fp,
+                jnp.asarray(bool(check_deadlock)),
+                jnp.asarray(md, I32), jnp.asarray(ms, I32),
+                jnp.asarray(max_lvls, I32),
+                jnp.asarray(0, I32),
+                jnp.asarray(tile_budget, I32),
+                fresh=fresh, label=f"window (depth {depth}+)")
+            self._fresh_jit = False
+            table = {"slots": out["slots"]}
+            front, nb = out["front"], out["nb"]
+            nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
+            tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
+            lvl_buf = out["lvl_buf"]
+            d_n_front, d_start = out["n_front"], out["start_t"]
+            d_nn, d_gen_level = out["nn"], out["gen_level"]
+            d_depth, d_level_base = out["depth"], out["level_base"]
+            d_fp = out["fp_count"]
+
         while True:
-            while pipe.has_room():
-                fresh = self._fresh_jit or self._wl is None
-                if self._wl is None:
-                    # the SAME pass run_fused jits, minus the lvl_buf
-                    # donation (argnum 9): collected tickets read their
-                    # level counters back while newer dispatches are
-                    # already consuming the other buffers
-                    self._wl = jax.jit(self._make_multilevel(),
-                                       donate_argnums=tuple(range(9)))
-                out = pipe.launch(
-                    self._wl, table["slots"], front, nb, nbp, nba,
-                    nbprm, tpp, tpa, tpm, lvl_buf,
-                    d_n_front, d_start, d_nn, d_gen_level, d_depth,
-                    d_level_base, d_fp,
-                    jnp.asarray(bool(check_deadlock)),
-                    jnp.asarray(md, I32), jnp.asarray(ms, I32),
-                    jnp.asarray(levels_cap, I32),
-                    jnp.asarray(0, I32),
-                    jnp.asarray(self.chunk_tiles, I32),
-                    fresh=fresh, label=f"window (depth {depth}+)")
-                self._fresh_jit = False
-                table = {"slots": out["slots"]}
-                front, nb = out["front"], out["nb"]
-                nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
-                tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
-                lvl_buf = out["lvl_buf"]
-                d_n_front, d_start = out["n_front"], out["start_t"]
-                d_nn, d_gen_level = out["nn"], out["gen_level"]
-                d_depth, d_level_base = out["depth"], out["level_base"]
-                d_fp = out["fp_count"]
-            out, reason = collect_one()
+            if not ckpt_due:
+                while pipe.has_room():
+                    launch_next(self.chunk_tiles, levels_cap)
+            if pipe.in_flight:
+                out, reason = collect_one()
+            else:
+                # rescue seam: the window drained while a checkpoint
+                # was pending — fall through to the seam below
+                out, reason = None, RUNNING
             obs.progress(depth=depth, distinct=fp_count,
                          generated=res.states_generated)
 
@@ -1578,6 +2077,72 @@ class DeviceBFS:
                     self._fresh_jit = True   # shape change: retrace
                     obs.grow("trace_pointer_store", tp_cap)
                     emit(f"trace-pointer store grown to {tp_cap}")
+                    continue
+                # ---- level-boundary rescue seam (ISSUE 10 satellite):
+                # stop refilling, drain through normal collects
+                # (trailing tickets hold real work), complete the
+                # current level with ONE level-bounded dispatch when
+                # the chain sits mid-level, then snapshot in run()
+                # format at the boundary
+                rescue = preempt_signal()
+                # checkpoint_every=None means "every level boundary"
+                # (run() parity) — gated on a NEW committed level so
+                # the seam never drains the window without fresh work
+                # to snapshot
+                if rescue is not None or (checkpoint_path and (
+                        (checkpoint_every is None and levels_unck > 0)
+                        or (checkpoint_every is not None
+                            and time.time() - last_checkpoint
+                            >= checkpoint_every))):
+                    ckpt_due = True
+                if ckpt_due:
+                    if pipe.in_flight:
+                        continue
+                    if h_start or h_nn:
+                        launch_next(2**31 - 1, 1)
+                        continue
+                    ckpt_due = False
+                    levels_unck = 0
+                    if checkpoint_path:
+                        from .checkpoint import (save_checkpoint,
+                                                 spec_digest)
+                        with obs.timer("checkpoint"):
+                            set_pointers(level_base + n_front)
+                            save_checkpoint(
+                                checkpoint_path,
+                                slots=table["slots"],
+                                frontier=self._dense_rows(front,
+                                                          n_front),
+                                n_front=n_front,
+                                h_parent=np.concatenate(self._h_parent),
+                                h_action=np.concatenate(self._h_action),
+                                h_param=np.concatenate(self._h_param),
+                                init_dense=self._init_dense,
+                                level_sizes=self.level_sizes,
+                                depth=depth, fp_count=fp_count,
+                                states_generated=res.states_generated,
+                                max_msgs=self.codec.shape.MAX_MSGS,
+                                expand_mults=self.expand_mults,
+                                elapsed=time.time() - t0,
+                                digest=spec_digest(spec),
+                                pack=self._pack_manifest(), obs=obs)
+                        last_checkpoint = time.time()
+                        obs.checkpoint(checkpoint_path, depth, fp_count)
+                        emit(f"checkpoint written to {checkpoint_path} "
+                             f"(depth {depth}, {fp_count} distinct; "
+                             f"resume via the chunked engine)")
+                    if rescue is not None:
+                        obs.rescue(checkpoint_path or "", depth,
+                                   fp_count, rescue)
+                        emit(f"preempted by {rescue}: "
+                             + (f"rescue snapshot at depth {depth} "
+                                f"({checkpoint_path}); exiting "
+                                f"resumable" if checkpoint_path else
+                                f"no checkpoint path — exiting at the "
+                                f"depth-{depth} boundary with no "
+                                f"snapshot"))
+                        raise Preempted(checkpoint_path, depth,
+                                        fp_count, rescue)
                 # else: tile budget (the normal windowed cadence) or a
                 # full per-dispatch level counter (next dispatch resets
                 # it) — just keep the window full
@@ -1638,17 +2203,7 @@ class DeviceBFS:
                 obs.grow("next_buffer", f_cap)
                 emit(f"frontier buffers grown to {f_cap}")
             elif reason == R_EXPAND_GROW:
-                aid = int(out["grow_aid"])
-                self.expand_mults[aid] *= 2
-                self._level = jax.jit(self._make_level(),
-                                      donate_argnums=(0, 4, 5, 6, 7))
-                self._fresh_jit = True
-                self._ml = None
-                self._wl = None
-                obs.grow("expand_buffer", self.expand_mults[aid])
-                emit(f"expand buffer for "
-                     f"{self.kern.action_names[aid]} grown to tile x "
-                     f"{self.expand_mults[aid]} (recompiling)")
+                self._grow_expand(int(out["grow_aid"]), obs, emit)
             elif reason == R_SLOT_ERR:
                 raise TLAError(
                     "dense-layout slot collision (a second DVC or "
@@ -1716,6 +2271,17 @@ class DeviceBFS:
             obs.gauge("action_expansions",
                       {n: int(c) for n, c in
                        zip(self.kern.action_names, acts)})
+        # occupancy = real work items / expand lanes dispatched, and
+        # the structural insert_core batches per frontier tile
+        # (ISSUE 10: 1 fused vs n_actions per-action)
+        lanes = getattr(self, "_lanes_disp", 0)
+        if lanes and acts is not None:
+            obs.gauge("occupancy",
+                      round(float(acts.sum()) / lanes, 4))
+        obs.gauge("inserts_per_tile",
+                  1 if self.commit == "fused"
+                  else len(self.kern.action_names))
+        obs.gauge("commit_mode", self.commit)
         if table is not None and obs.detailed:
             from .fpset import table_stats
             st = table_stats(table["slots"])

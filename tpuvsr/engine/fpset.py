@@ -67,18 +67,24 @@ def _slot_hash(fps):
     return h * jnp.uint32(0x27D4EB2F)
 
 
-def dedup_batch(fps, mask):
+def dedup_batch(fps, mask, tie=None):
     """Keep the first occurrence of each distinct fingerprint.
 
     Returns (perm, keep): `perm` sorts the batch so equal fingerprints
     are adjacent (masked-out lanes sort to the end), `keep[i]` marks
-    lanes of fps[perm] that are valid first occurrences.  (The BFS
-    engine no longer needs this — insert_core tolerates duplicates —
-    but the sharded exchange uses it to shrink traffic.)
+    lanes of fps[perm] that are valid first occurrences.  With `tie`
+    (an int array, one priority per lane) the winner among equal
+    fingerprints is the lane with the SMALLEST tie value instead of
+    the smallest batch position — the sharded fused-commit step passes
+    the canonical state-major flat index so a compacted (reordered)
+    batch picks the same winner the dense batch would (ISSUE 10).
+    (The single-device BFS engine's fused commit relies on the default
+    batch-position tie; the sharded exchange uses both forms.)
     """
     key = [jnp.where(mask, fps[:, i], jnp.uint32(0xFFFFFFFF))
            for i in range(4)]
-    perm = jnp.lexsort((key[3], key[2], key[1], key[0]))
+    minor = (key[3],) if tie is None else (tie, key[3])
+    perm = jnp.lexsort(minor + (key[2], key[1], key[0]))
     sfps = fps[perm]
     smask = mask[perm]
     neq = (sfps[1:] != sfps[:-1]).any(axis=1)

@@ -90,10 +90,10 @@ class PagedBFS(DeviceBFS):
         return self.chunk_tiles * self.tile
 
     def _total_E(self):
-        T = self.tile
-        return sum(min(T * self.kern._lane_count(nm),
-                       max(64, T * self.expand_mults[a]))
-                   for a, nm in enumerate(self.kern.action_names))
+        # same caps the level kernel compacts with (fused commit: the
+        # exact-count caps; per-action: the tile-multiple formula) —
+        # the next-buffer headroom floor must track whichever is live
+        return sum(self._expand_caps())
 
     def _pad_init_dense(self, old):
         for i, d in enumerate(self._init_dense):
@@ -123,10 +123,13 @@ class PagedBFS(DeviceBFS):
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
+        obs.commit = self.commit
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
+        self._tiles_done = 0
+        self._lanes_disp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend(),
@@ -221,7 +224,8 @@ class PagedBFS(DeviceBFS):
 
         def pull(o):
             return jax.device_get([o["reason"], o["t"], o["nn"],
-                                   o["gen"], o["dist"], o["act"]])
+                                   o["gen"], o["dist"], o["act"],
+                                   o["need"]])
 
         while n_front > 0 and stop is None:
             if max_depth is not None and depth >= max_depth:
@@ -318,6 +322,7 @@ class PagedBFS(DeviceBFS):
                     res.states_generated += gen_add
                     fp_count += dist_add
                     self._act_counts += np.asarray(sc[5], np.int64)
+                    self._fold_need(sc[6])
 
                     if reason == RUNNING:
                         obs.progress(depth=depth, distinct=fp_count,
@@ -410,22 +415,13 @@ class PagedBFS(DeviceBFS):
                         obs.grow("fpset", fp_cap)
                         emit(f"FPSet grown to {fp_cap} slots")
                     elif reason == R_EXPAND_GROW:
-                        aid = int(out["grow_aid"])
-                        self.expand_mults[aid] *= 2
-                        self._level = jax.jit(
-                            self._make_level(),
-                            donate_argnums=(0, 4, 5, 6, 7))
-                        self._fresh_jit = True
+                        self._grow_expand(int(out["grow_aid"]), obs,
+                                          emit)
                         if self.next_cap < self._total_E() + self.tile:
                             spill()
                             self.next_cap = self._total_E() + self.tile
                             bufs = self._alloc_bufs(self.next_cap)
                             pend_nn = jnp.asarray(0, I32)
-                        obs.grow("expand_buffer", self.expand_mults[aid])
-                        emit(f"expand buffer for "
-                             f"{self.kern.action_names[aid]} grown to "
-                             f"tile x {self.expand_mults[aid]} "
-                             f"(recompiling)")
                     elif reason == R_SLOT_ERR:
                         raise TLAError(
                             "dense-layout slot collision (a second DVC "
@@ -453,6 +449,7 @@ class PagedBFS(DeviceBFS):
                         stop = f"time budget {max_seconds}s reached"
                         break
                 # chunk done (or stopped): spill whatever accumulated
+                self._account_tiles(min(start_t, n_tiles_c))
                 spill()
                 chunk_start += n_c
 
@@ -478,6 +475,9 @@ class PagedBFS(DeviceBFS):
             if stop:
                 res.error = stop
                 break
+            # fused commit: shrink the expansion caps onto the exact
+            # observed maxima (window drained at the level boundary)
+            self._calibrate_caps(obs, emit, n_front)
             # pending preemption forces a rescue snapshot at this
             # boundary regardless of cadence (see device_bfs)
             rescue = preempt_signal() if n_front else None

@@ -84,6 +84,10 @@ class RunObserver:
         # before start(); journaled on run_start like pipeline so a
         # journal identifies the run's state representation
         self.pack = False
+        # level-kernel commit mode (ISSUE 10): "fused" | "per-action"
+        # on the BFS engines, None on engines without a level kernel —
+        # journaled on run_start with key-set parity across engines
+        self.commit = None
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -153,7 +157,8 @@ class RunObserver:
                            engine=self.engine, module=self.module,
                            backend=self.backend, resumed=bool(resumed),
                            pipeline=int(self.pipeline or 1),
-                           pack=bool(self.pack), **extra)
+                           pack=bool(self.pack),
+                           commit=self.commit, **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")

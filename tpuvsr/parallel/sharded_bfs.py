@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..engine.device_bfs import _align8
 from ..engine.fpset import dedup_batch, insert_core
 from ..obs import closes_observer
 from ..resilience.faults import InjectedExchangeDrop, fault_point
@@ -221,11 +222,13 @@ R_NEXT_GROW = 5
 R_SLOT_ERR = 6
 R_DEADLOCK = 7
 R_BUCKET_GROW = 8
+R_EXPAND_GROW = 9   # fused commit: per-action compaction cap overflow
 
 
 def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                        tile: int, bucket_cap: int,
-                       check_deadlock: bool = False, pack_spec=None):
+                       check_deadlock: bool = False, pack_spec=None,
+                       commit: str = "fused", expand_caps=None):
     """Build the jitted one-tile sharded BFS step.
 
     step(tables, frontier, n_front, start_t, nb, nbp, nba, nbprm, nn,
@@ -252,7 +255,19 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     one's buffers instead of holding K generations of them in HBM,
     which is what lets ``pipeline=2`` be the sharded default.  The
     read-only frontier and base_gid are NOT donated (the level's
-    dispatch chain re-reads them)."""
+    dispatch chain re-reads them).
+
+    Fused commit (ISSUE 10): with ``commit="fused"`` the per-tile
+    expansion is guard-compacted — a guard matrix over every lane of
+    the tile picks the enabled (state, lane) items, which are packed
+    into dense per-action segments sized by ``expand_caps`` and ONLY
+    those are expanded/fingerprinted (``step_all`` expanded all T x L
+    lanes, mostly disabled padding).  A per-action cap overflow is a
+    new rank-agreed R_EXPAND_GROW pause carrying the exact per-action
+    ``need`` so the host grows once to the true count.  The dedup that
+    feeds the exchange tie-breaks on the canonical state-major flat
+    index, so bucket contents — and every downstream result — are
+    bit-identical to ``commit="per-action"`` (the step_all path)."""
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
@@ -260,6 +275,18 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     lane_aid = jnp.asarray(kern.lane_action)
     lane_prm = jnp.asarray(kern.lane_param)
     from ..models.vsr import ERR_BAG_OVERFLOW
+    fused = commit == "fused"
+    if fused:
+        lane_counts = [kern._lane_count(n) for n in kern.action_names]
+        seg_off = np.concatenate(
+            [[0], np.cumsum(lane_counts)[:-1]]).astype(np.int32)
+        caps = [min(T * lc, max(8, int(c)))
+                for lc, c in zip(lane_counts,
+                                 expand_caps or [T] * n_act)]
+        E_tot = sum(caps)
+        caps_v = jnp.asarray(caps, jnp.int32)
+        guards = kern._guard_fns()
+        fns = kern._action_fns()
 
     def step_shard(tables, frontier, n_front, start_t,
                    nb, nbp, nba, nbprm, nn0, base_gid):
@@ -286,17 +313,77 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             else:
                 tile_st = {k: v[jnp.clip(sidx, 0, v.shape[0] - 1)]
                            for k, v in frontier.items()}
-            succs, en = jax.vmap(kern.step_all)(tile_st)
-            en = en & valid[:, None]
-            flat = {k: v.reshape((T * L,) + v.shape[2:])
-                    for k, v in succs.items()}
+            if fused:
+                # -- stage 1 (ISSUE 10): guard matrix, exact counts --
+                en_segs = []
+                for name, guard in zip(kern.action_names, guards):
+                    lanes = jnp.arange(kern._lane_count(name),
+                                       dtype=jnp.int32)
+                    seg = jax.vmap(lambda st: jax.vmap(
+                        lambda ln, g=guard: g(st, ln))(lanes))(tile_st)
+                    en_segs.append(seg & valid[:, None])
+                cnts = jnp.stack([e.sum(dtype=jnp.int32)
+                                  for e in en_segs])
+                n_en = cnts.sum()
+                act_seg = cnts.astype(U32)
+                ovf_vec = cnts > caps_v
+                ovf_e = ovf_vec.any()
+                need = jnp.maximum(c["need"], cnts.astype(U32))
+                en_state = jnp.zeros((T,), bool)
+                for e in en_segs:
+                    en_state = en_state | e.any(axis=1)
+
+                # -- stage 2: per-action work-queue compaction; only
+                # REAL items are expanded (step_all expanded all T x L
+                # lanes, mostly padding)
+                succ_segs, en_q_segs, pos_segs = [], [], []
+                for a, (name, fn) in enumerate(
+                        zip(kern.action_names, fns)):
+                    L_a = lane_counts[a]
+                    TL_a = T * L_a
+                    off = int(seg_off[a])
+                    en_fa = en_segs[a].reshape(TL_a)
+                    (sel,) = jnp.nonzero(en_fa, size=caps[a],
+                                         fill_value=TL_a)
+                    sel_ok = sel < TL_a
+                    pidx = jnp.clip(sel // L_a, 0, T - 1
+                                    ).astype(jnp.int32)
+                    lane_loc = (sel % L_a).astype(jnp.int32)
+                    st_sel = {k: v[pidx] for k, v in tile_st.items()}
+                    s_a, en2 = jax.vmap(fn, in_axes=(0, 0))(
+                        st_sel, lane_loc)
+                    succ_segs.append({k: v for k, v in s_a.items()
+                                      if not k.startswith("_")})
+                    en_q_segs.append(en2 & sel_ok)
+                    # canonical state-major flat position: the dense
+                    # [T, L] index this item would occupy in the
+                    # step_all path — the dedup tie-break and all
+                    # trace metadata derive from it, which is what
+                    # keeps compacted results bit-identical
+                    pos_segs.append(pidx * L + off + lane_loc)
+                flat = {k: jnp.concatenate([s[k] for s in succ_segs])
+                        for k in succ_segs[0]}
+                en_f = jnp.concatenate(en_q_segs)
+                flatpos = jnp.concatenate(pos_segs)
+            else:
+                succs, en = jax.vmap(kern.step_all)(tile_st)
+                en = en & valid[:, None]
+                en_state = en.any(axis=1)
+                flat = {k: v.reshape((T * L,) + v.shape[2:])
+                        for k, v in succs.items()}
+                en_f = en.reshape(-1)
+                n_en = en_f.sum()
+                act_seg = jax.ops.segment_sum(
+                    en_f.astype(U32), jnp.tile(lane_aid, T),
+                    num_segments=n_act)
+                ovf_e = jnp.asarray(False)
+                need = c["need"]
+                flatpos = jnp.arange(T * L, dtype=jnp.int32)
             if pack_spec is not None:
                 # pack successors ONCE, right after expansion: the
                 # buckets, the wire, and the next frontier all move
                 # the packed row from here on
                 flat_rows = jax.vmap(pack_spec.pack)(flat)
-            en_f = en.reshape(-1)
-            n_en = en_f.sum()
             fps = jax.vmap(kern.fingerprint)(flat)
             iok = jax.vmap(inv_fn)(flat)
             errv = jnp.where(en_f, flat["err"], 0)
@@ -304,25 +391,35 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             bag_err = ((errv & ERR_BAG_OVERFLOW) != 0).any()
             slot_err = ((errv & ~ERR_BAG_OVERFLOW) != 0).any()
 
-            # first violating lane, as (parent gid, action, param).
-            # flat successor index i is state-major ([T, L] reshaped),
-            # so the lane tables (length L) are indexed by i % L — a
-            # bare lane_aid[i] silently CLAMPS for i >= L and records
-            # the wrong action/param in the trace metadata
-            vidx = jnp.argmax(viol_l)
+            # first violating lane by CANONICAL state-major position
+            # (== argmax over the dense flat order; the fused queue is
+            # a reordering, so it minimizes flatpos explicitly), as
+            # (parent gid, action, param).  The lane tables (length L)
+            # are indexed by flatpos % L — a bare lane_aid[i] silently
+            # CLAMPS for i >= L and records the wrong action/param in
+            # the trace metadata
+            vidx = jnp.argmin(jnp.where(viol_l, flatpos,
+                                        jnp.int32(2**31 - 1)))
+            vpos = flatpos[vidx]
             vinfo = jnp.stack([
-                base_gid[0] + base + (vidx // L).astype(jnp.int32),
-                lane_aid[vidx % L], lane_prm[vidx % L]])
+                base_gid[0] + base + (vpos // L).astype(jnp.int32),
+                lane_aid[vpos % L], lane_prm[vpos % L]])
             viol = jnp.where(viol_l.any() & (c["viol"][0] < 0), vinfo,
                              c["viol"])
 
-            # local dedup, ownership bucketing (state + meta ride along)
-            perm, cand = dedup_batch(fps, en_f)
+            # local dedup, ownership bucketing (state + meta ride
+            # along).  The tie key makes the winner among equal
+            # fingerprints the canonically-first item, so the fused
+            # (compacted) queue buckets exactly what the dense batch
+            # would
+            perm, cand = dedup_batch(fps, en_f,
+                                     tie=flatpos if fused else None)
             fps_s = fps[perm]
             owner = (route(fps_s) % jnp.uint32(n_dev)).astype(jnp.int32)
-            meta_p = base_gid[0] + (perm // L).astype(jnp.int32) + base
-            meta_a = lane_aid[perm % L]
-            meta_m = lane_prm[perm % L]
+            pos_s = flatpos[perm]
+            meta_p = base_gid[0] + (pos_s // L).astype(jnp.int32) + base
+            meta_a = lane_aid[pos_s % L]
+            meta_m = lane_prm[pos_s % L]
 
             cap = bucket_cap
             b_fps = jnp.zeros((n_dev, cap, 4), U32)
@@ -355,15 +452,20 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                         flat_src[k][perm], mode="drop")
 
             # deadlock: a valid frontier state with no enabled lane
-            dead_l = valid & ~en.any(axis=1) if check_deadlock else \
+            # (en_state comes from the guard matrix in fused commit,
+            # from step_all's enabled matrix in per-action)
+            dead_l = valid & ~en_state if check_deadlock else \
                 jnp.zeros((T,), bool)
             dead_i = jnp.where(dead_l.any() & (c["dead"] < 0),
                                base + jnp.argmax(dead_l), c["dead"]
                                ).astype(jnp.int32)
 
-            # global pre-exchange abort vote
+            # global pre-exchange abort vote (ovf_e: a fused-commit
+            # compaction cap overflowed — the staged queue is
+            # truncated, so nothing may commit until the exact-need
+            # growth recompiles)
             flags = jnp.stack([viol_l.any(), bag_err, slot_err, ovf_b,
-                               dead_l.any()]).astype(jnp.int32)
+                               dead_l.any(), ovf_e]).astype(jnp.int32)
             gflags = jax.lax.psum(flags, axis) > 0
             abort_pre = gflags.any()
 
@@ -418,28 +520,28 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             # the same tile only re-dedups them (nothing lost)
             g_povf = jax.lax.psum(
                 (commit & probe_ovf).astype(jnp.int32), axis) > 0
+            # failure-cause priority (ISSUE 10): violation > slot >
+            # bag > expand-grow > bucket > deadlock > next; fpset
+            # growth last.  Expand outranks bucket because a truncated
+            # queue makes the bucket contents meaningless
             reason = jnp.where(
                 gflags[0], R_VIOLATION,
                 jnp.where(gflags[2], R_SLOT_ERR,
                           jnp.where(gflags[1], R_BAG_GROW,
+                                    jnp.where(gflags[5], R_EXPAND_GROW,
                                     jnp.where(gflags[3], R_BUCKET_GROW,
                                               jnp.where(gflags[4],
                                                         R_DEADLOCK,
                                               jnp.where(abort_room,
                                                         R_NEXT_GROW,
-                                                        RUNNING))))))
+                                                        RUNNING)))))))
             reason = jnp.where((reason == RUNNING) & g_povf,
                                R_FPSET_GROW, reason)
-            # per-action expansion counters (ISSUE 4 satellite): same
-            # commit gating as `gen`, so shard-summed act == gen
-            act_seg = jax.ops.segment_sum(
-                en_f.astype(jnp.uint32), jnp.tile(lane_aid, T),
-                num_segments=n_act)
             return {
                 "t": jnp.where(commit & ~g_povf, t + 1, t),
                 "reason": jnp.where(c["reason"] == RUNNING, reason,
                                     c["reason"]),
-                "viol": viol, "dead": dead_i,
+                "viol": viol, "dead": dead_i, "need": need,
                 "slots": slots2,
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": nn + jnp.where(commit, n_fresh, 0),
@@ -457,6 +559,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             "reason": jnp.asarray(RUNNING, jnp.int32),
             "viol": jnp.full((3,), -1, jnp.int32),
             "dead": jnp.asarray(-1, jnp.int32),
+            "need": jnp.zeros((n_act,), jnp.uint32),
             "slots": tables["slots"],
             "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
             "nn": nn0[0],
@@ -470,7 +573,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 out["nb"], out["nbp"], out["nba"], out["nbprm"],
                 one(out["nn"]), one(out["t"]), one(out["reason"]),
                 out["viol"][None], one(out["gen"]), one(out["sent"]),
-                one(out["dead"]), out["act"][None])
+                one(out["dead"]), out["act"][None], out["need"][None])
 
     sp = P(axis)
     # donate the FPSet shards + the next-frontier buffer set (args 0,
@@ -483,7 +586,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     step = jax.jit(_shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 13), donate_argnums=(0, 4, 5, 6, 7))
+        out_specs=(sp,) * 14), donate_argnums=(0, 4, 5, 6, 7))
     return step
 
 
@@ -501,12 +604,24 @@ class ShardedBFS:
                  fpset_capacity=1 << 14, check_deadlock=False,
                  model_factory=None, pipeline=2, exchange_retries=5,
                  exchange_backoff=0.05, exchange_backoff_cap=2.0,
-                 sleep=time.sleep, pack="auto"):
+                 sleep=time.sleep, pack="auto", commit="fused"):
+        from ..core.values import TLAError
+        if commit not in ("fused", "per-action"):
+            raise TLAError(f"commit must be 'fused' or 'per-action' "
+                           f"(got {commit!r})")
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
+        # level-kernel commit mode (ISSUE 10): "fused" compacts each
+        # tile's enabled lanes through the guard matrix before
+        # expansion (occupancy-packed; exact-need cap growth);
+        # "per-action" is the step_all full-lane expansion.  Results
+        # are bit-identical between the two.
+        self.commit = commit
+        self.expand_caps = None       # fused per-action caps (lanes)
+        self._need_seen = None
         # bounded exponential-backoff budget for transient exchange
         # failures (ISSUE 5): a dropped exchange re-issues the level
         # step (lossless — committed lanes just dedup) up to
@@ -564,11 +679,26 @@ class ShardedBFS:
         else:
             self._pk = build_pack_spec(self.codec, spec=self.spec,
                                        force=self._pack_req is True)
+        if self.commit == "fused":
+            names = self.kern.action_names
+            tl = [self.tile * self.kern._lane_count(n) for n in names]
+            if self.expand_caps is None:
+                self.expand_caps = [min(t, max(8, self.tile))
+                                    for t in tl]
+            else:   # re-clamp after a MAX_MSGS rebuild (lanes grow)
+                self.expand_caps = [min(t, max(8, int(c)))
+                                    for t, c in zip(tl,
+                                                    self.expand_caps)]
+            if self._need_seen is None or \
+                    len(self._need_seen) != len(names):
+                self._need_seen = np.zeros(len(names), np.int64)
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
                                         self.axis, self.tile,
                                         self.bucket_cap,
                                         check_deadlock=self._ckd,
-                                        pack_spec=self._pk)
+                                        pack_spec=self._pk,
+                                        commit=self.commit,
+                                        expand_caps=self.expand_caps)
         self._fresh_jit = True   # first dispatch after a (re)jit is
         #                          charged to the "compile" phase
         self._sh = NamedSharding(self.mesh, P(self.axis))
@@ -648,9 +778,12 @@ class ShardedBFS:
                                  progress_every=progress_every)
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
+        obs.commit = self.commit
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
+        self._tiles_done = 0
+        self._lanes_disp = 0
         # multi-process: every rank collects, only host 0 writes the
         # journal / metrics file / stats table (per-shard numbers are
         # reduced host-side before they reach the collector)
@@ -1123,11 +1256,48 @@ class ShardedBFS:
                     self._step = make_sharded_level(
                         self.kern, self._inv, self.mesh, self.axis,
                         self.tile, self.bucket_cap,
-                        check_deadlock=self._ckd, pack_spec=self._pk)
+                        check_deadlock=self._ckd, pack_spec=self._pk,
+                        commit=self.commit,
+                        expand_caps=self.expand_caps)
                     self._fresh_jit = True
                     obs.grow("exchange_bucket", self.bucket_cap)
                     emit(f"exchange bucket grown to {self.bucket_cap} "
                          f"(recompiling)")
+                elif reason == R_EXPAND_GROW:
+                    # fused commit: grow every cap to the exact
+                    # rank-maxed observed need (ISSUE 10) — one
+                    # recompile, no doubling guesses
+                    need = np.asarray(self._pull(out[13]),
+                                      np.int64).max(axis=0)
+                    self._need_seen = np.maximum(self._need_seen, need)
+                    grown = []
+                    for a, name in enumerate(self.kern.action_names):
+                        cap_a = self.expand_caps[a]
+                        if int(self._need_seen[a]) > cap_a:
+                            self.expand_caps[a] = min(
+                                self.tile * self.kern._lane_count(name),
+                                _align8(self._need_seen[a]))
+                            grown.append((name, self.expand_caps[a]))
+                    if not grown:   # defensive: strict growth anyway
+                        a = int(np.argmax(need))
+                        self.expand_caps[a] = min(
+                            self.tile * self.kern._lane_count(
+                                self.kern.action_names[a]),
+                            self.expand_caps[a] * 2)
+                        grown = [(self.kern.action_names[a],
+                                  self.expand_caps[a])]
+                    self._step = make_sharded_level(
+                        self.kern, self._inv, self.mesh, self.axis,
+                        self.tile, self.bucket_cap,
+                        check_deadlock=self._ckd, pack_spec=self._pk,
+                        commit=self.commit,
+                        expand_caps=self.expand_caps)
+                    self._fresh_jit = True
+                    for _n, cap in grown:
+                        obs.grow("expand_buffer", cap)
+                    emit("expand caps grown to exact need: "
+                         + ", ".join(f"{n}={c}" for n, c in grown)
+                         + " (recompiling)")
                 elif reason == R_NEXT_GROW:
                     new_n = self.N * 2
                     nb = (self._grow_global(nb, self.N, new_n)
@@ -1157,11 +1327,15 @@ class ShardedBFS:
             # committed tiles this level x full static bucket volume
             # (generated was already accumulated per dispatch attempt)
             with obs.timer("host_sync"):
-                wire = (int(self._pull(start_t).max())
-                        * D * D * self.bucket_cap)
+                tiles_lvl = int(self._pull(start_t).max())
+                wire = tiles_lvl * D * D * self.bucket_cap
                 exch_rows_wire += wire
                 exch_bytes_wire += wire * _row_bytes()
                 nn_h = self._pull(nn)
+            # occupancy accounting (ISSUE 10): expand lanes dispatched
+            # this level, under the cap set in effect
+            self._tiles_done += tiles_lvl * D
+            self._lanes_disp += tiles_lvl * D * self._lanes_per_tile()
             n_next = int(nn_h.sum())
             fp_count += n_next
             obs.level_done(depth, frontier=front_total,
@@ -1290,8 +1464,28 @@ class ShardedBFS:
             obs.gauge("action_expansions",
                       {n: int(c) for n, c in
                        zip(self.kern.action_names, acts)})
+        # occupancy = real work items / expand lanes dispatched
+        # (ISSUE 10); the sharded step always commits with ONE insert
+        # batch per tile (the exchange receiver), in both commit modes
+        lanes = getattr(self, "_lanes_disp", 0)
+        if lanes and acts is not None:
+            obs.gauge("occupancy",
+                      round(float(acts.sum()) / lanes, 4))
+        obs.gauge("inserts_per_tile", 1)
+        obs.gauge("commit_mode", self.commit)
         return obs.finish(res,
                           levels=getattr(self, "level_sizes", None))
+
+    def _lanes_per_tile(self):
+        """Expand lanes one tile dispatches on one device: the fused
+        caps, or the full T x L dense expansion in per-action mode."""
+        if self.commit == "fused" and self.expand_caps is not None:
+            return sum(
+                min(self.tile * self.kern._lane_count(n),
+                    max(8, int(c)))
+                for n, c in zip(self.kern.action_names,
+                                self.expand_caps))
+        return self.tile * self.kern.n_lanes
 
 
 def make_sharded_insert(mesh: Mesh, axis: str):

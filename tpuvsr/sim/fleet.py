@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.checkpoint import _crc32_file, _fsync_path, spec_digest
+from ..engine.device_bfs import _align8
 from ..engine.device_sim import materialize_walk
 from ..engine.pipeline import DispatchPipeline
 from ..engine.simulate import SimResult
@@ -305,20 +306,22 @@ class FleetSimulator:
                     out = {k: jnp.where(
                         m.reshape((-1,) + (1,) * (s_a[k].ndim - 1)),
                         s_a[k], v) for k, v in out.items()}
-            return out, jnp.zeros((n_act,), bool)
+            return out, jnp.zeros((n_act,), I32)
 
         def apply_grouped(states, aid, prm, act):
             # guard-gathered grouped dispatch (the DeviceSimulator
             # round-3 win): each action body runs on just the walkers
-            # that chose it; per-action cap overflow is reported so the
-            # host grows the cap and redraws the chunk (same keys ->
-            # same draws, so the redraw is exact)
+            # that chose it.  The EXACT per-action chooser counts ride
+            # out of the chunk (ISSUE 10: the same exact-count packing
+            # the BFS level kernel adopted) so a cap overflow grows
+            # straight to the true high-water mark instead of doubling
+            # blind; the redraw stays exact (same keys -> same draws)
             out = {k: v for k, v in states.items()}
-            ovf = []
+            cnt = []
             for a, f in enumerate(fns):
                 C = caps[a]
                 m = (aid == a) & act
-                ovf.append(m.sum() > C)
+                cnt.append(m.sum(dtype=I32))
                 (sel,) = jnp.nonzero(m, size=C, fill_value=W_loc)
                 ok = sel < W_loc
                 idx = jnp.clip(sel, 0, W_loc - 1)
@@ -327,7 +330,7 @@ class FleetSimulator:
                 dest = jnp.where(ok, sel, W_loc).astype(I32)
                 for k in out:
                     out[k] = out[k].at[dest].set(s_a[k], mode="drop")
-            return out, jnp.stack(ovf)
+            return out, jnp.stack(cnt)
 
         apply_chosen = (apply_grouped if self.dispatch == "grouped"
                         else apply_dense)
@@ -355,7 +358,7 @@ class FleetSimulator:
 
             def step(carry, t):
                 (states, alive, violated_at, dead_at, steps, err_any,
-                 ovf) = carry
+                 need) = carry
                 d = step0 + t
                 on = d < depth_limit
                 keys = jax.vmap(jax.random.fold_in,
@@ -390,7 +393,7 @@ class FleetSimulator:
                                     d, dead_at)
                 aid = lane_aid[lane]
                 prm = lane_prm[lane]
-                succ, ovf_a = apply_chosen(states, aid, prm, act)
+                succ, cnt_a = apply_chosen(states, aid, prm, act)
                 selm = {k: act.reshape((-1,) + (1,) * (v.ndim - 1))
                         for k, v in states.items()}
                 states = {k: jnp.where(selm[k], succ[k], v)
@@ -406,13 +409,13 @@ class FleetSimulator:
                 hist = (jnp.where(act, aid, -1).astype(I32),
                         jnp.where(act, prm, 0).astype(I32))
                 return (states, alive, violated_at, dead_at, steps,
-                        err_any, ovf | ovf_a), hist
+                        err_any, jnp.maximum(need, cnt_a)), hist
 
             init = (states, alive, violated_at, dead_at,
                     jnp.asarray(0, I32), jnp.asarray(False),
-                    jnp.zeros((n_act,), bool))
+                    jnp.zeros((n_act,), I32))
             (states, alive, violated_at, dead_at, steps, err_any,
-             ovf), hist = jax.lax.scan(
+             need), hist = jax.lax.scan(
                 step, init, jnp.arange(n_steps, dtype=I32))
             steps_g = jax.lax.psum(steps, axis)
             n_alive = jax.lax.psum(alive.sum(dtype=I32), axis)
@@ -420,18 +423,34 @@ class FleetSimulator:
                 ((violated_at >= 0) | (dead_at >= 0)).sum(dtype=I32),
                 axis)
             err_g = jax.lax.psum(err_any.astype(I32), axis) > 0
-            ovf_g = jax.lax.psum(ovf.astype(I32), axis) > 0
+            # exact per-action chooser maxima, fleet-maxed: the host
+            # compares against the live caps and grows to the true
+            # need (ISSUE 10 exact-count packing)
+            need_g = jax.lax.pmax(need, axis)
             return (states, alive, violated_at, dead_at, hist,
-                    steps_g, n_alive, n_events, err_g, ovf_g)
+                    steps_g, n_alive, n_events, err_g, need_g)
 
         from jax.sharding import PartitionSpec as P
         sp = P(self.axis)
+        # donate the walker-state carry (ISSUE 10 satellite /
+        # ROADMAP item 2 residual): each chunk writes its successor
+        # states INTO the previous generation's HBM buffers instead of
+        # holding two walker generations.  The small per-walker event
+        # arrays (alive/violated/dead) stay un-donated — deadline
+        # stops and round ends read them off the committed ticket.
+        # Guided (splitter) runs keep the un-donated kernel: the
+        # resample and its redraw paths read the committed states
+        # directly, and a splitter round is not replayable (the seen
+        # set mutates per chunk), so the replay-rebuild the donated
+        # growth/rescue paths use is unavailable there.
+        self._donate = self.splitter is None
         self._chunk = jax.jit(_shard_map(
             chunk_fn, self.mesh,
             in_specs=(P(), sp, sp, sp, sp, sp, P(), P()),
             out_specs=(sp, sp, sp, sp, (P(None, self.axis),
                                         P(None, self.axis)),
-                       P(), P(), P(), P(), P())))
+                       P(), P(), P(), P(), P())),
+            donate_argnums=(1,) if self._donate else ())
         self._fresh_jit = True
         if self.splitter is not None:
             self.splitter.bind(kern)
@@ -447,6 +466,29 @@ class FleetSimulator:
         old = self.codec.shape.MAX_MSGS
         self._build(old * 2)
         return [self.codec.pad_msgs(b, old) for b in batches]
+
+    def _replay_states(self, key, walk_ids, depth_j, upto_step, base):
+        """Rebuild the committed walker STATES at ``upto_step`` by
+        re-executing the round's chunks from the host-side ``base``
+        (the round's entry carry — start or resume point).  Only the
+        donated-carry growth/rescue paths need this: later launches
+        wrote into the committed generation's HBM buffers, and the
+        per-(seed, walk-id) determinism contract makes the replay
+        exact (same keys -> same draws; cap/table growth never changes
+        a draw).  The event arrays (alive/violated/dead) are never
+        donated, so only the states come from the replay."""
+        step0, h_states, h_alive, h_violated, h_dead = base
+        states = {k: jnp.asarray(v) for k, v in h_states.items()}
+        alive = jnp.asarray(h_alive)
+        violated = jnp.asarray(h_violated)
+        dead = jnp.asarray(h_dead)
+        s = step0
+        while s < upto_step:
+            out = self._chunk(key, states, alive, violated, dead,
+                              walk_ids, jnp.asarray(s, I32), depth_j)
+            states, alive, violated, dead = out[0], out[1], out[2], out[3]
+            s += self.chunk
+        return states
 
     # -- replay --------------------------------------------------------
     def replay(self, init_row, hists, slot, n_steps):
@@ -532,6 +574,18 @@ class FleetSimulator:
         walk_ids = jnp.asarray(
             (base + np.arange(self.W_pad)) % (1 << 31), U32)
         depth_j = jnp.asarray(int(depth), I32)
+        # host-side replay base (donated carry, ISSUE 10 satellite):
+        # the round's entry carry, kept on host RAM so the
+        # growth/rescue paths can rebuild the committed STATES by
+        # deterministic replay after later launches consumed their
+        # HBM buffers
+        replay_base = (
+            step,
+            {k: np.asarray(jax.device_get(v))
+             for k, v in states.items()},
+            np.asarray(jax.device_get(alive)),
+            np.asarray(jax.device_get(violated)),
+            np.asarray(jax.device_get(dead)))
 
         pipe = DispatchPipeline(self.pipeline, obs,
                                 ready=lambda out: out[5])
@@ -561,19 +615,38 @@ class FleetSimulator:
                     cur = (out[0], out[1], out[2], out[3])
                     launched += self.chunk
                 out, sc = pipe.collect(pull)
-                steps_k, n_alive, n_events, err_any, ovf = sc
+                steps_k, n_alive, n_events, err_any, need = sc
                 if bool(err_any):
                     # bag overflow inside the chunk: drop the window,
                     # grow the message table, pad the committed entry
                     # states AND the round's init batch, redraw
                     pipe.drain()
-                    st_pad, ini_pad = self._grow_msgs(
-                        [committed[0],
-                         {k: jnp.asarray(v)
-                          for k, v in init_states.items()}])
-                    committed = (st_pad,) + committed[1:]
-                    init_states = {k: np.asarray(v)
-                                   for k, v in ini_pad.items()}
+                    if self._donate:
+                        # the committed state buffers were consumed by
+                        # later launches: pad the HOST copies (init +
+                        # replay base), then rebuild by exact replay
+                        ini_pad, base_pad = self._grow_msgs(
+                            [{k: jnp.asarray(v)
+                              for k, v in init_states.items()},
+                             {k: jnp.asarray(v)
+                              for k, v in replay_base[1].items()}])
+                        init_states = {k: np.asarray(v)
+                                       for k, v in ini_pad.items()}
+                        replay_base = (replay_base[0],
+                                       {k: np.asarray(v)
+                                        for k, v in base_pad.items()}
+                                       ) + replay_base[2:]
+                        committed = (self._replay_states(
+                            key, walk_ids, depth_j, step, replay_base),
+                            ) + committed[1:]
+                    else:
+                        st_pad, ini_pad = self._grow_msgs(
+                            [committed[0],
+                             {k: jnp.asarray(v)
+                              for k, v in init_states.items()}])
+                        committed = (st_pad,) + committed[1:]
+                        init_states = {k: np.asarray(v)
+                                       for k, v in ini_pad.items()}
                     obs.grow("message_table",
                              self.codec.shape.MAX_MSGS)
                     self.log(f"message table grown to "
@@ -581,17 +654,26 @@ class FleetSimulator:
                     launched = step
                     cur = committed
                     continue
-                ovf = np.asarray(ovf)
-                if ovf.any():
-                    # dispatch-group cap overflow: double the flagged
-                    # caps, recompile, redraw (same keys, same draws)
+                need = np.asarray(need)
+                W_loc = self.W_pad // self.D
+                caps_now = np.minimum(
+                    np.asarray(self.group_caps, np.int64), W_loc)
+                over = need > caps_now
+                if over.any():
+                    # dispatch-group cap overflow: grow the flagged
+                    # caps straight to the EXACT fleet-maxed chooser
+                    # count (ISSUE 10 — no doubling guesses),
+                    # recompile, redraw (same keys, same draws)
                     pipe.drain()
-                    W_loc = self.W_pad // self.D
-                    for a in np.nonzero(ovf)[0]:
-                        self.group_caps[a] = min(
-                            W_loc, self.group_caps[a] * 2)
+                    for a in np.nonzero(over)[0]:
+                        self.group_caps[a] = int(min(
+                            W_loc, _align8(need[a])))
                         obs.grow("dispatch_group", self.group_caps[a])
                     self._build(self.codec.shape.MAX_MSGS)
+                    if self._donate:
+                        committed = (self._replay_states(
+                            key, walk_ids, depth_j, step, replay_base),
+                            ) + committed[1:]
                     launched = step
                     cur = committed
                     continue
@@ -626,6 +708,13 @@ class FleetSimulator:
                     cur = committed
                 if preempt_signal() is not None:
                     pipe.drain()
+                    if self._donate and launched > step:
+                        # speculative launches consumed the committed
+                        # state buffers — rebuild them by exact replay
+                        # before the snapshot reads them
+                        committed = (self._replay_states(
+                            key, walk_ids, depth_j, step, replay_base),
+                            ) + committed[1:]
                     raise self._rescue(
                         checkpoint_path, base=base, active=active,
                         step=step, depth=depth, committed=committed,

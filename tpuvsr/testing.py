@@ -191,7 +191,8 @@ def stub_device_engine(cls=None, spec=None, inv_bound=None, **kw):
     return cls(spec or counter_spec(inv_bound),
                model_factory=stub_model_factory(inv_bound=inv_bound),
                hash_mode="full", tile_size=kw.pop("tile_size", 4),
-               fpset_capacity=1 << 8, next_capacity=1 << 6, **kw)
+               fpset_capacity=kw.pop("fpset_capacity", 1 << 8),
+               next_capacity=kw.pop("next_capacity", 1 << 6), **kw)
 
 
 def stub_engine_factory(spec, **engine_kw):
