@@ -347,6 +347,13 @@ def main():
         "gauges", {}).get("occupancy")
     RESULT["inserts_per_tile"] = (res.metrics or {}).get(
         "gauges", {}).get("inserts_per_tile")
+    # symmetry reduction identity (ISSUE 11): the group order the
+    # headline run canonicalized by (1 = off — the shipped cfg
+    # declares SYMMETRY, so the device default is on) and the
+    # generated/distinct-after-canon ratio; compare_bench gates
+    # orbit_ratio drops and distinct growth at matching modes
+    RESULT["symmetry_perms"] = g.get("symmetry_perms")
+    RESULT["orbit_ratio"] = g.get("orbit_ratio")
     # A/B the chunked engine's dispatch window on the same probe
     # (ISSUE 4 acceptance): -pipeline 1 vs -pipeline 2 must explore
     # the identical space; the throughput delta is the window's win
@@ -445,6 +452,35 @@ def main():
                         == ab["pipeline1"]["distinct"]
                         and ab["per_action_commit"]["generated"]
                         == ab["pipeline1"]["generated"])
+            # symmetry A/B (ISSUE 11 acceptance): the shipped cfg
+            # declares SYMMETRY, so the headline already runs
+            # orbit-canonical; the off leg measures how many distinct
+            # states the reduction is folding away.  Counts are NOT
+            # expected to match — the ratio IS the result (bounded by
+            # wall clock: the unreduced space can be |Values|! larger)
+            if time.time() < DEADLINE - 120:
+                e = DeviceBFS(spec, tile_size=tile,
+                              fpset_capacity=1 << 21,
+                              next_capacity=1 << 15, expand_mult=2,
+                              expand_mults={"ReceiveMatchingSVC": 4,
+                                            "SendDVC": 4},
+                              symmetry=False)
+                e.run(max_depth=6)      # compile + warm
+                r = e.run(max_seconds=max(
+                    30.0, min(DEADLINE - time.time(), 300.0)))
+                on = ab["pipeline1"]
+                ab["symmetry_off"] = {
+                    "distinct": r.distinct_states,
+                    "generated": r.states_generated,
+                    "distinct_per_s": round(
+                        r.distinct_states / r.elapsed, 1),
+                    "reached_fixpoint": r.error is None,
+                    "orbit_cut": (round(r.distinct_states
+                                        / on["distinct"], 3)
+                                  if r.error is None
+                                  and on["reached_fixpoint"]
+                                  else None),
+                }
             RESULT["pipeline_ab"] = ab
             print(f"bench: pipeline A/B "
                   f"{ab['pipeline1']['distinct_per_s']} -> "

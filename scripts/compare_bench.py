@@ -221,6 +221,59 @@ def gate_pack(base_doc, cand_doc, max_regression):
     return 0
 
 
+def gate_symmetry(base_doc, cand_doc, max_regression):
+    """The orbit-reduction regression gate (ISSUE 11): 0
+    ok/advisory/absent, 1 when — at matching symmetry modes — the
+    candidate's ``orbit_ratio`` (generated / distinct-after-canon)
+    DROPPED beyond tolerance or its distinct-state count GREW beyond
+    tolerance: either means the canonicalization pass stopped folding
+    orbits it used to fold.  A ``symmetry_perms`` mismatch between the
+    documents (symmetry toggled, or a different declared group)
+    measures different reductions, not a regression — advisory, like
+    pipeline depth."""
+    bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
+    if not (bm and cm):
+        return 0
+    bg, cg = bm.get("gauges", {}), cm.get("gauges", {})
+    b, c = bg.get("orbit_ratio"), cg.get("orbit_ratio")
+    if b is None or c is None:
+        return 0
+    print(f"orbit_ratio: baseline {b} -> candidate {c}  "
+          f"[{fmt_delta(b, c)}]")
+    bp, cp = bg.get("symmetry_perms"), cg.get("symmetry_perms")
+    if bp != cp:
+        print(f"  symmetry_perms: {bp} -> {cp} (different symmetry "
+              f"reductions — comparison is advisory)")
+        return 0
+    if not bp or bp <= 1:
+        # symmetry off (or undeclared) in BOTH documents: orbit_ratio
+        # is then just the plain generated/distinct dedup ratio — a
+        # legitimate exploration change moves it, no orbit fold to
+        # regress.  Informational only
+        print("  symmetry off in both documents — orbit gate not "
+              "applicable")
+        return 0
+    rc = 0
+    if b > 0 and c < b * (1.0 - max_regression / 100.0):
+        print(f"compare_bench: orbit_ratio REGRESSION beyond "
+              f"{max_regression:.1f}% tolerance", file=sys.stderr)
+        rc = 1
+    bd = bm.get("distinct", base_doc.get("distinct"))
+    cd = cm.get("distinct", cand_doc.get("distinct"))
+    # only COMPLETED runs have comparable distinct counts: on a
+    # time/state-budget-bound pin a FASTER candidate explores more
+    # states inside the budget — growth there is the improvement, not
+    # an orbit-fold regression (bench.py guards its A/B the same way)
+    complete = (bm.get("error") is None and cm.get("error") is None)
+    if complete and bd and cd and \
+            cd > bd * (1.0 + max_regression / 100.0):
+        print(f"compare_bench: distinct states GREW beyond "
+              f"{max_regression:.1f}% tolerance at the same symmetry "
+              f"mode (the orbit fold regressed)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("baseline")
@@ -333,7 +386,12 @@ def main(argv=None):
     # at-rest frontier bytes ride the gate too (ISSUE 9): bytes/state
     # growth fails, cross-format comparisons are advisory
     pack_rc = gate_pack(base_doc, cand_doc, args.max_regression)
-    sim_rc = sim_rc or val_rc or pack_rc or (1 if occ_regressed else 0)
+    # orbit reduction likewise (ISSUE 11): orbit_ratio drops and
+    # distinct-state growth fail at matching symmetry modes;
+    # symmetry-mode mismatches are advisory
+    sym_rc = gate_symmetry(base_doc, cand_doc, args.max_regression)
+    sim_rc = (sim_rc or val_rc or pack_rc or sym_rc
+              or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch or commit_mismatch:

@@ -55,6 +55,15 @@ reference mount, no TPU, seconds on the CPU backend:
                      snapshot + Preempted; the resumed hunt's deduped
                      violation set and headline trace are
                      bit-identical to an uninterrupted oracle hunt
+  kill-canon-resume  SIGTERM mid-run with symmetry canonicalization
+                     ON (ISSUE 11) -> rescue snapshot recording the
+                     canon spec; a -symmetry off engine REFUSES it
+                     (policy error) and a symmetry-on engine resumes
+                     to the exact orbit fixpoint
+  kill-spill-resume  SIGTERM on a paged run spilling to DISK level
+                     files (ISSUE 11, 2-row RAM budget) -> rescue
+                     checkpoint; the resume reloads the frontier
+                     through the tier and completes the exact fixpoint
   kill-validate-resume  SIGTERM mid-batch on a kind="validate" job
                      (ISSUE 8) -> candidate-frontier rescue at the
                      committed chunk boundary, preempt-requeue through
@@ -287,6 +296,99 @@ def scenario_kill_fused_commit_resume(tmp):
         "rescue_depth": preempted.depth,
         "distinct_fused": res_fused.distinct_states,
         "distinct_per_action": res_pa.distinct_states,
+    }
+
+
+def scenario_kill_canon_resume(tmp):
+    """ISSUE 11 satellite: kill mid-run with symmetry canonicalization
+    ON -> rescue checkpoint recording the canon spec, then (a) a
+    symmetry-on engine resumes to the exact orbit fixpoint, (b) a
+    symmetry-off engine REFUSES the snapshot (policy error — the
+    stored fingerprints live in the canonical space)."""
+    from tpuvsr.core.values import TLAError
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import SYMPAIR_ORBIT_LEVELS, SYMPAIR_ORBITS, \
+        stub_sym_engine
+    ck = os.path.join(tmp, "canon-ck")
+    jp = os.path.join(tmp, "canon.jsonl")
+    faults.install("kill@level=2")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                eng = stub_sym_engine()         # symmetry auto -> ON
+                assert eng._canon is not None
+                eng.run(checkpoint_path=ck,
+                        obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    refused = False
+    try:
+        stub_sym_engine(symmetry=False).run(resume_from=ck)
+    except TLAError as e:
+        refused = "symmetry canonicalization" in str(e)
+    res = stub_sym_engine().run(resume_from=ck)
+    starts = [e for e in read_journal(jp)
+              if e["event"] == "run_start"]
+    return {
+        "ok": (refused and res.ok
+               and res.distinct_states == SYMPAIR_ORBITS
+               and res.levels == SYMPAIR_ORBIT_LEVELS
+               and all(e.get("symmetry") for e in starts)),
+        "rescue_depth": preempted.depth, "flip_refused": refused,
+        "distinct": res.distinct_states,
+    }
+
+
+def scenario_kill_spill_resume(tmp):
+    """ISSUE 11 satellite: kill a paged run whose frontier is spilling
+    to DISK level files (2-row RAM budget) -> rescue checkpoint, then
+    the resumed run reloads the frontier THROUGH the tier and
+    completes the exact fixpoint."""
+    ORACLE = _oracle()
+    from tpuvsr.engine.paged_bfs import PagedBFS
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_device_engine
+    ck = os.path.join(tmp, "spill-ck")
+    jp = os.path.join(tmp, "spill.jsonl")
+    sd = os.path.join(tmp, "spill-tier")
+    faults.install("kill@level=4")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                stub_device_engine(
+                    cls=PagedBFS, spill_dir=sd, spill_ram_rows=2,
+                    chunk_tiles=1).run(
+                    checkpoint_path=ck,
+                    obs=RunObserver(journal_path=jp))
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    res = stub_device_engine(cls=PagedBFS, spill_dir=sd,
+                             spill_ram_rows=2,
+                             chunk_tiles=1).run(resume_from=ck)
+    disk = [e for e in read_journal(jp)
+            if e["event"] == "spill" and e.get("tier") == "disk"]
+    return {
+        "ok": (res.ok and res.distinct_states == ORACLE["distinct"]
+               and res.levels == ORACLE["levels"] and len(disk) > 0),
+        "rescue_depth": preempted.depth,
+        "disk_spills": len(disk),
+        "distinct": res.distinct_states,
     }
 
 
@@ -728,6 +830,8 @@ SCENARIOS = [
     ("kill-rescue", scenario_kill_rescue),
     ("pack-kill-rescue", scenario_pack_kill_rescue),
     ("kill-fused-commit-resume", scenario_kill_fused_commit_resume),
+    ("kill-canon-resume", scenario_kill_canon_resume),
+    ("kill-spill-resume", scenario_kill_spill_resume),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),

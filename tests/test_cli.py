@@ -137,6 +137,60 @@ def test_cli_pack_flag_conflicts_exit_2(bad):
     assert "usage" in r.stderr or "error" in r.stderr
 
 
+@pytest.mark.parametrize("bad", [
+    ["-symmetry", "on", "-engine", "interp"],
+    ["-symmetry", "off", "-fpset", "host"],
+    ["-symmetry", "on", "-validate", "t.jsonl"],
+    ["-symmetry", "maybe"],
+    ["-spill", "/tmp/sp", "-engine", "device"],
+    ["-spill", "/tmp/sp", "-engine", "sharded"],
+    ["-spill", "/tmp/sp", "-fpset", "hbm"],
+    ["-spill", "/tmp/sp", "-fpset", "host"],
+    ["-spill", "/tmp/sp", "-simulate"],
+    ["-spill", "/tmp/sp", "-supervise"],
+], ids=["symmetry-interp", "symmetry-fpset-host",
+        "symmetry-validate", "symmetry-bad-mode", "spill-device",
+        "spill-sharded", "spill-fpset-hbm", "spill-fpset-host",
+        "spill-simulate", "spill-supervise"])
+def test_cli_symmetry_spill_flag_conflicts_exit_2(bad):
+    """ISSUE 11 satellite: -symmetry configures the device
+    canonicalization kernel and -spill the paged engine's disk tier;
+    their documented conflicts are argparse errors (exit 2) before
+    any spec is loaded."""
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
+def test_cli_symmetry_on_with_liveness_spec_exit_2(tmp_path):
+    """-symmetry on with a PROPERTY cfg is the liveness conflict the
+    reference cfg comments insist on — checked right after the cfg
+    loads, still exit 2 (no engine is ever built)."""
+    spec = """---- MODULE Sy ----
+EXTENDS Naturals
+VARIABLES x
+Init == x = 0
+Incr == x' = (x + 1) % 3
+Next == Incr
+vars == <<x>>
+AtZero == x = 0
+Prop == []<>AtZero
+Spec == Init /\\ [][Next]_vars
+====
+"""
+    (tmp_path / "Sy.tla").write_text(spec)
+    (tmp_path / "Sy.cfg").write_text(
+        "SPECIFICATION Spec\nPROPERTY Prop\n")
+    r = _run(str(tmp_path / "Sy.tla"), "-symmetry", "on")
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "temporal" in r.stderr
+    # and -symmetry on against a cfg with no SYMMETRY at all
+    (tmp_path / "Sy.cfg").write_text("INIT Init\nNEXT Next\n")
+    r2 = _run(str(tmp_path / "Sy.tla"), "-symmetry", "on")
+    assert r2.returncode == 2, (r2.stdout, r2.stderr)
+    assert "SYMMETRY" in r2.stderr
+
+
 @pytest.mark.parametrize("good", [
     ["-supervise", "-engine", "sharded"],
     ["-engine", "sharded", "-supervise", "-inject", "oom@shard=0"],
@@ -145,8 +199,12 @@ def test_cli_pack_flag_conflicts_exit_2(bad):
     ["-pack", "on", "-engine", "sharded"],
     ["-pack", "off", "-engine", "interp"],
     ["-pack", "off", "-fpset", "host"],
+    ["-symmetry", "off", "-engine", "sharded"],
+    ["-spill", "/tmp/sp", "-fpset", "paged"],
+    ["-spill", "/tmp/sp"],
 ], ids=["supervise", "supervise-oom-shard", "drop-count", "recover",
-        "pack-sharded", "pack-off-interp", "pack-off-fpset-host"])
+        "pack-sharded", "pack-off-interp", "pack-off-fpset-host",
+        "symmetry-off-sharded", "spill-paged", "spill-auto"])
 def test_cli_sharded_valid_combos_pass_parsing(good):
     """Valid sharded combinations get past flag validation: the run
     fails on the nonexistent spec path (not exit 2)."""

@@ -642,8 +642,9 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 17 scenarios since ISSUE 10 (kill-fused-commit-resume)
-    assert out["ok"] and len(out["scenarios"]) == 17
+    # 19 scenarios since ISSUE 11 (kill-canon-resume,
+    # kill-spill-resume)
+    assert out["ok"] and len(out["scenarios"]) == 19
 
 
 # ---------------------------------------------------------------------

@@ -25,6 +25,22 @@ the corpus (or a grown config) can break that:
 this port resolve it deterministically over a canonical order, which
 is sound for state exploration but makes the chosen element
 orbit-dependent — worth knowing when debugging a trace.
+
+Device-soundness (ISSUE 11): the engines now canonicalize states to
+orbit representatives ON DEVICE (engine/canon.py), which adds two
+machine-checkable preconditions this pass enforces:
+
+3. The evaluated permutation set plus identity must be CLOSED under
+   composition — min-over-enumerated-images is only orbit-invariant
+   for a group (``Permutations(S)`` always is; a hand-written subset
+   may not be).
+
+4. Each permutation must act on the encoded layout as a bijection of
+   value ids that fixes the padding id 0, and only through planes the
+   kernel's orbit table names.  The pass EMITS that table (via
+   ``canon.orbit_planes`` — the same function the canonicalization
+   kernel consumes), so lint and kernel cannot disagree about which
+   planes a permutation touches.
 """
 
 from __future__ import annotations
@@ -78,6 +94,9 @@ def run(spec, report):
                        f"{len(universe)} values — canonicalization "
                        f"would merge non-isomorphic states")
 
+    # device-soundness: group closure + encoded orbit table (ISSUE 11)
+    _device_orbit_check(spec, perms, report)
+
     # cfg constants that pin a NAME to one symmetric value
     pinned = {cname for cname, cval in spec.ev.constants.items()
               if isinstance(cval, ModelValue) and cval in universe
@@ -94,6 +113,66 @@ def run(spec, report):
     walker = _Taint(spec, frozenset(sym_set_consts), pinned, report)
     for root in roots:
         walker.walk(root, frozenset())
+
+
+def _device_orbit_check(spec, perms, report):
+    """Checks 3 and 4 (module docstring): closure of the evaluated
+    group, and the kernel/codec orbit table the device
+    canonicalization pass consumes."""
+    from ...engine.canon import group_closed, orbit_planes
+    if not group_closed(perms):
+        report.add(PASS, SEV_ERROR, "group",
+                   "SYMMETRY permutation set (plus identity) is not "
+                   "closed under composition: the orbit-least image "
+                   "is then orbit-DEPENDENT and device "
+                   "canonicalization (and the host min-image "
+                   "fingerprint) would merge or split orbits "
+                   "inconsistently.  TLC's Permutations(S) is always "
+                   "closed; hand-written SYMMETRY sets must be too")
+    try:
+        from ...models.registry import _resolve, has_device_model
+        from ...models.registry import value_perm_table
+    except ImportError:
+        return
+    if not has_device_model(spec):
+        report.add(PASS, SEV_INFO, spec.module.name,
+                   "no compiled device kernel for this module; orbit "
+                   "table check skipped (the interpreter's "
+                   "view-value canonicalization needs no table)")
+        return
+    codec_cls, kern_cls = _resolve(spec.module.name)
+    codec = codec_cls(spec.ev.constants)
+    planes = orbit_planes(kern_cls)
+    if planes is None:
+        report.add(PASS, SEV_ERROR, kern_cls.__name__,
+                   "kernel declares no orbit plane table (SYM_PLANES "
+                   "or PERM_REP_KEYS/PERM_MSG_KEYS): device "
+                   "canonicalization cannot know which planes a "
+                   "permutation touches; -symmetry on would fail at "
+                   "engine build")
+        return
+    zero = codec.zero_state()
+    missing = sorted(k for k in planes if k not in zero)
+    if missing:
+        report.add(PASS, SEV_ERROR, kern_cls.__name__,
+                   f"orbit table names planes {missing} the codec "
+                   f"layout does not declare — lint/kernel drift")
+    table = value_perm_table(spec, codec)
+    V = int(codec.shape.V)
+    for i, row in enumerate(table):
+        bad = (int(row[0]) != 0
+               or sorted(int(x) for x in row) != list(range(V + 1)))
+        if bad:
+            report.add(PASS, SEV_ERROR, f"perm #{i}",
+                       "permutation does not act as a bijection of "
+                       "the encoded value ids fixing the padding id "
+                       "0: canonicalizing through this row would "
+                       "corrupt non-symmetric fields")
+    report.add(PASS, SEV_INFO, kern_cls.__name__,
+               f"device orbit table: group order {len(table)} "
+               f"(identity included), planes "
+               f"{sorted(planes)} — emitted by canon.orbit_planes, "
+               f"the same source the canonicalization kernel reads")
 
 
 class _Taint:

@@ -104,6 +104,32 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    pre-pipeline behavior).  Counts, level sizes and
                    violation traces are bit-identical for every K
                    (README "Pipelining")
+  -symmetry MODE   on | off (default: on when the cfg declares
+                   SYMMETRY, off otherwise — TLC's semantics, where
+                   declaring Permutations IS enabling the reduction):
+                   device-native symmetry reduction (engine/canon.py).
+                   With on, every successor is canonicalized to the
+                   least element of its symmetry orbit PRE-FINGERPRINT
+                   inside the jitted level kernel, so the FPSet and
+                   frontier hold ONE entry per orbit (up to |Values|!
+                   fewer distinct states); verdicts are identical to
+                   off (traces may differ by orbit representative).
+                   Snapshots record the canonicalization spec —
+                   resuming with a flipped -symmetry or changed group
+                   is a policy error.  Liveness checking keeps its
+                   existing SYMMETRY-off requirement, and trace
+                   validation tracks concrete states (-symmetry on
+                   conflicts with PROPERTY cfgs and -validate)
+  -spill DIR       paged engine: NVMe/disk spill tier for the host
+                   frontier pages (ISSUE 11, CAPACITY.md mitigation
+                   2).  Pages beyond the RAM budget flush to
+                   append-only level files under DIR and re-read
+                   sequentially; the 189 M host-RAM packed-state
+                   ceiling becomes a disk-priced 10^9-state one.
+                   Implies -fpset paged; conflicts with -engine
+                   device/interp/sharded, -fpset host/hbm,
+                   -simulate/-validate/-supervise and temporal
+                   properties (retain_levels needs resident levels)
   -pack MODE       on | off (default on): packed bit-planed frontier
                    encoding (engine/pack.py) — the at-rest frontier,
                    host spill pages and the sharded exchange move
@@ -173,7 +199,14 @@ frontier to pack); -chained with -fused/-engine sharded/-engine
 interp/-fpset host/-simulate/-validate, or with -recover unless
 -supervise (the chained window has no resume path of its own);
 explicit -commit with -engine interp/-fpset host/-simulate/-validate
-(it configures the BFS level kernel);
+(it configures the BFS level kernel); explicit -symmetry with
+-engine interp/-fpset host (the interpreter always applies the
+declared SYMMETRY itself) and -symmetry on with -validate (trace
+validation tracks concrete states) or a PROPERTY cfg (liveness keeps
+SYMMETRY off — checked after the cfg loads); -spill with
+-engine device/interp/sharded, -fpset host/hbm,
+-simulate/-validate/-supervise (the spill tier is the paged engine's
+host-page store);
 -validate with -simulate/-hunt/-fused/-supervise/-deadlock/
 -maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
 is its own engine mode: rescue checkpoints are preemption-driven, the
@@ -292,6 +325,21 @@ def build_parser():
                         "every device engine — the sharded step "
                         "donates its buffers; 1 = synchronous).  "
                         "Results are bit-identical for every K")
+    p.add_argument("-symmetry", choices=["on", "off"], default=None,
+                   metavar="MODE",
+                   help="device-native symmetry reduction (default: "
+                        "on iff the cfg declares SYMMETRY): states "
+                        "are canonicalized to orbit representatives "
+                        "pre-fingerprint inside the level kernel, so "
+                        "the FPSet/frontier hold one entry per orbit "
+                        "(engine/canon.py).  Verdicts are identical "
+                        "on/off; traces may differ by orbit "
+                        "representative")
+    p.add_argument("-spill", default=None, metavar="DIR",
+                   help="paged engine: disk spill tier for host "
+                        "frontier pages — pages beyond the RAM "
+                        "budget flush to append-only level files "
+                        "under DIR (implies -fpset paged)")
     p.add_argument("-pack", choices=["on", "off"], default=None,
                    metavar="MODE",
                    help="packed bit-planed frontier encoding "
@@ -418,6 +466,38 @@ def validate_args(parser, args):
         parser.error("-supervise needs the device/paged/sharded "
                      "engine (the interpreter has no "
                      "checkpoint/degrade ladder)")
+    if args.symmetry is not None and (args.engine == "interp"
+                                      or args.fpset == "host"):
+        parser.error("-symmetry configures the device "
+                     "canonicalization kernel; the interpreter "
+                     "always applies the declared SYMMETRY itself "
+                     "(drop the flag or the -engine interp/-fpset "
+                     "host selection)")
+    if args.symmetry == "on" and args.validate is not None:
+        parser.error("-symmetry on cannot be combined with -validate: "
+                     "trace validation tracks CONCRETE states (an "
+                     "observation may pin any variable to a specific "
+                     "value), so orbit-equivalent candidates are not "
+                     "interchangeable")
+    if args.spill is not None:
+        if args.engine in ("device", "interp", "sharded"):
+            parser.error(f"-spill is the paged engine's host-page "
+                         f"disk tier; it cannot be combined with "
+                         f"-engine {args.engine} (device is HBM-only, "
+                         f"sharded shards over HBM, the interpreter "
+                         f"has no paged frontier)")
+        if args.fpset in ("host", "hbm"):
+            parser.error(f"-spill needs -fpset paged (or auto); "
+                         f"-fpset {args.fpset} selects an engine "
+                         f"without host frontier pages")
+        if args.simulate or args.validate is not None:
+            parser.error("-spill tiers the BFS frontier; it cannot "
+                         "be combined with -simulate/-validate")
+        if args.supervise:
+            parser.error("-spill cannot be combined with -supervise "
+                         "(the supervisor's degrade ladder manages "
+                         "its own hbm -> paged fallback; run -fpset "
+                         "paged -spill directly)")
     if args.pack == "on" and (args.engine == "interp"
                               or args.fpset == "host"):
         parser.error("-pack on needs a device engine (the packed "
@@ -649,7 +729,32 @@ def main(argv=None):
         print(report.to_json() if args.json else report.render())
         return report.exit_code
 
+    # spec-dependent -symmetry/-spill conflicts (exit 2, like the
+    # parse-time ones — the cfg had to load first)
+    if args.symmetry == "on" and spec.temporal_props:
+        parser.error("-symmetry on cannot be combined with temporal "
+                     "properties: liveness checking requires SYMMETRY "
+                     "off (the reference cfg comments insist, and the "
+                     "behavior graph must distinguish orbit members)")
+    if args.symmetry == "on" and not spec.symmetry_perms:
+        parser.error("-symmetry on: the cfg declares no SYMMETRY — "
+                     "there is no permutation group to reduce by")
+    if args.spill is not None and spec.temporal_props:
+        parser.error("-spill cannot be combined with temporal "
+                     "properties (the liveness graph enumeration "
+                     "needs whole levels resident)")
+
     engine = _pick_engine(args.engine, args.fpset, spec)
+    if args.spill is not None:
+        if engine == "interp":
+            # auto-resolution landed on the interpreter (no compiled
+            # kernel): dropping the disk-tier request silently would
+            # betray the flag — same loud contract as the explicit
+            # -engine interp conflict
+            parser.error("-spill needs the paged device engine; this "
+                         "spec resolved to the interpreter (no "
+                         "compiled device kernel)")
+        engine = "paged"            # -spill implies the paged engine
     if args.pipeline is None:
         # default 2 on every device engine (ISSUE 9: the sharded step
         # now donates its buffers, so the K-generations-in-HBM cost
@@ -661,6 +766,11 @@ def main(argv=None):
     pack_kw = False if args.pack == "off" else "auto"
     # level-kernel commit mode (ISSUE 10): fused is the default
     commit_kw = args.commit or "fused"
+    # symmetry canonicalization (ISSUE 11): on iff declared, unless
+    # the flag forces it
+    symmetry_kw = {"on": True, "off": False}.get(args.symmetry, "auto")
+    spill_kw = ({"spill_dir": args.spill} if args.spill is not None
+                else {})
 
     def log(msg):
         print(f"[tpuvsr] {msg}", file=sys.stderr)
@@ -738,6 +848,7 @@ def main(argv=None):
                                num=args.num, split=split,
                                pipeline=args.pipeline,
                                max_seconds=args.maxseconds,
+                               symmetry=symmetry_kw,
                                obs=obs, log=log)
             else:
                 res = fleet_simulate(
@@ -745,7 +856,8 @@ def main(argv=None):
                     seed=args.seed, walkers=walkers, split=split,
                     pipeline=args.pipeline,
                     check_deadlock=args.deadlock, log=log,
-                    max_seconds=args.maxseconds, obs=obs)
+                    max_seconds=args.maxseconds, obs=obs,
+                    symmetry=symmetry_kw)
         else:
             from ..engine.simulate import simulate
             res = simulate(spec, num=args.num, depth=args.depth,
@@ -792,7 +904,8 @@ def main(argv=None):
                     chained=args.chained and engine == "device",
                     engine_kwargs={"pipeline": args.pipeline,
                                    "pack": pack_kw,
-                                   "commit": commit_kw})
+                                   "commit": commit_kw,
+                                   "symmetry": symmetry_kw})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -818,7 +931,8 @@ def main(argv=None):
                 mesh = Mesh(np.array(jax.devices()), ("d",))
                 log(f"sharded mesh: {mesh.shape['d']} devices")
                 eng = ShardedBFS(spec, mesh, pipeline=args.pipeline,
-                                 pack=pack_kw, commit=commit_kw)
+                                 pack=pack_kw, commit=commit_kw,
+                                 symmetry=symmetry_kw)
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
@@ -840,13 +954,16 @@ def main(argv=None):
                 if want_graph:
                     eng = PagedBFS(spec, retain_levels=True,
                                    pipeline=args.pipeline,
-                                   pack=pack_kw, commit=commit_kw)
+                                   pack=pack_kw, commit=commit_kw,
+                                   symmetry=symmetry_kw)
+                elif engine == "paged":
+                    eng = PagedBFS(spec, pipeline=args.pipeline,
+                                   pack=pack_kw, commit=commit_kw,
+                                   symmetry=symmetry_kw, **spill_kw)
                 else:
-                    eng = (PagedBFS if engine == "paged"
-                           else DeviceBFS)(spec,
-                                           pipeline=args.pipeline,
-                                           pack=pack_kw,
-                                           commit=commit_kw)
+                    eng = DeviceBFS(spec, pipeline=args.pipeline,
+                                    pack=pack_kw, commit=commit_kw,
+                                    symmetry=symmetry_kw)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:

@@ -92,7 +92,7 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
                     elapsed, digest=None, extra=None, pack=None,
-                    obs=None):
+                    canon=None, obs=None):
     """Write a complete engine snapshot to `path` (atomic + durable).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
@@ -142,6 +142,12 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         # packed-frontier spec identity (ISSUE 9): version digest +
         # plane table of the writer's packing spec, None when dense
         "pack": pack,
+        # symmetry canonicalization spec (ISSUE 11): version digest +
+        # group order + orbit plane table of the writer's CanonSpec,
+        # None when the run stored raw (non-canonical) fingerprints.
+        # Resuming under a flipped -symmetry or a changed group is a
+        # policy error — the FPSet's fingerprint space would not match
+        "canon": canon,
         # engine-specific payload (e.g. the sharded driver's per-shard
         # frontier counts and exchange capacities)
         "extra": extra,
@@ -310,5 +316,6 @@ def load_checkpoint(path, expect_digest=None, log=None):
         "elapsed": manifest["elapsed"],
         "extra": manifest.get("extra"),
         "pack": manifest.get("pack"),
+        "canon": manifest.get("canon"),
         "restored_from": used,
     }

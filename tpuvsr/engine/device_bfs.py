@@ -130,7 +130,7 @@ class DeviceBFS:
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
                  expand_mults=None, model_factory=None, pipeline=2,
-                 pack="auto", commit="fused"):
+                 pack="auto", commit="fused", symmetry="auto"):
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
                            f"(got {commit!r})")
@@ -177,10 +177,23 @@ class DeviceBFS:
         self.expand_caps = None
         self._need_seen = None
         self.inv_names = list(spec.cfg.invariants)
+        # symmetry canonicalization (ISSUE 11): "auto" = on iff the
+        # cfg declares SYMMETRY (TLC's semantics — declaring
+        # Permutations IS enabling the reduction); True/False force.
+        # When on, a CanonSpec (engine/canon.py) maps every successor
+        # to the least element of its symmetry orbit PRE-FINGERPRINT
+        # inside the jitted level kernel, so the FPSet and frontier
+        # hold one entry per orbit; the kernel itself is built with an
+        # identity-only perm table (fold_symmetry=False) — the engine
+        # seam, not the P-fold hash, owns the reduction, which makes
+        # -symmetry off a real A/B lever
+        self._symmetry_req = symmetry
         # model_factory(spec, max_msgs=..) -> (codec, kernel); default
         # is the hand-kernel registry, tests/the CLI can pass the
         # AST-compiled factory (lower/compile.make_compiled_model)
-        self._model_factory = model_factory or registry.make_model
+        self._model_factory = model_factory or (
+            lambda spec, max_msgs=None: registry.make_model(
+                spec, max_msgs=max_msgs, fold_symmetry=False))
         # packed frontier encoding (ISSUE 9): "auto" packs whenever the
         # codec declares plane_bounds (every registered layout + the
         # stub harness); False runs dense; True forces the interchange
@@ -229,6 +242,29 @@ class DeviceBFS:
         self.L = self.kern.n_lanes
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}          # action id -> jitted single-action fn
+        # symmetry canonicalization spec (ISSUE 11): rebuilt with the
+        # codec (the group table depends on V, the orbit plane table
+        # on the kernel class); None = no reduction.  A custom
+        # model_factory may hand us a pre-ISSUE-11 FOLDED kernel (its
+        # fingerprint already min-hashes over the group): the fold IS
+        # the reduction then — the canon seam stands down rather than
+        # double-reduce, and -symmetry off is impossible to honor
+        # (the fold is baked into the kernel), so forcing it is a
+        # loud error, not a silent no-op
+        from .canon import build_canon_spec, kernel_fold_order
+        self._sym_fold = kernel_fold_order(self.kern)
+        if spec.symmetry_perms and self._sym_fold > 1:
+            if self._symmetry_req is False:
+                raise TLAError(
+                    "symmetry=False requested but the model factory "
+                    "built a kernel with a FOLDED perm table (its "
+                    "fingerprints min-hash over the group); rebuild "
+                    "it with fold_symmetry=False "
+                    "(registry.make_model) to make -symmetry off real")
+            self._canon = None
+        else:
+            self._canon = build_canon_spec(spec, self.codec, self.kern,
+                                           self._symmetry_req)
         # packed-frontier spec for THIS codec binding (rebuilt with the
         # codec on bag growth: MAX_MSGS changes the lane count)
         from .pack import build_pack_spec
@@ -301,7 +337,15 @@ class DeviceBFS:
         inv = self._inv
         pk = self._pk
         T = self.tile
-        incremental = self.hash_mode == "incremental"
+        # symmetry canonicalization (ISSUE 11): fingerprints are taken
+        # on the orbit-least image, which cannot be reconstituted from
+        # the parent's per-row hash parts — canon runs force the full
+        # hash path (the orbit-factor state cut dwarfs the incremental
+        # saving)
+        canon = self._canon
+        incremental = self.hash_mode == "incremental" and canon is None
+        fpf = (canon.fingerprint_fn(kern) if canon is not None
+               else kern.fingerprint)
 
         # per-action compaction capacities (adaptive; R_EXPAND_GROW
         # carries the overflowing action so only it grows)
@@ -398,8 +442,10 @@ class DeviceBFS:
                     else:
                         def one(st, lane, fn=fn):
                             succ, en1 = fn(st, lane)
-                            return (succ, kern.fingerprint(succ), en1,
-                                    inv(succ), succ["err"])
+                            clean = {k: v for k, v in succ.items()
+                                     if not k.startswith("_")}
+                            return (clean, fpf(clean), en1,
+                                    inv(clean), clean["err"])
                         succ_f, fp, en2, iok, errv = jax.vmap(one)(
                             st_sel, lane_sel)
 
@@ -516,7 +562,12 @@ class DeviceBFS:
         inv = self._inv
         pk = self._pk
         T = self.tile
-        incremental = self.hash_mode == "incremental"
+        # canon runs hash the orbit-least image — full hash path only
+        # (see _tile_body_factory)
+        canon = self._canon
+        incremental = self.hash_mode == "incremental" and canon is None
+        fpf = (canon.fingerprint_fn(kern) if canon is not None
+               else kern.fingerprint)
         n_act = len(kern.action_names)
         caps = self._expand_caps()
         total_E = sum(caps)
@@ -613,8 +664,15 @@ class DeviceBFS:
                     else:
                         def one(st, lane, fn=fn):
                             succ, en1 = fn(st, lane)
-                            return (succ, kern.fingerprint(succ), en1,
-                                    inv(succ), succ["err"])
+                            clean = {k: v for k, v in succ.items()
+                                     if not k.startswith("_")}
+                            # ISSUE 11 commit stage: the fingerprint
+                            # is taken on the canonical orbit image
+                            # (fpf) while the staged queue keeps the
+                            # generated state — orbit-mates dedup to
+                            # one committed representative
+                            return (clean, fpf(clean), en1,
+                                    inv(clean), clean["err"])
                         succ_f, fp, en2, iok, errv = jax.vmap(one)(
                             st_sel, lane_sel)
 
@@ -1100,6 +1158,46 @@ class DeviceBFS:
     def _pack_manifest(self):
         return self._pk.manifest() if self._pk is not None else None
 
+    def _fp_batch(self, batch):
+        """Fingerprint a dense batch through the canonical seam (the
+        host-side twin of the in-kernel fpf closure: init
+        registration, resume re-routing)."""
+        if self._canon is None:
+            return self.kern.fingerprint_batch(batch)
+        arr = {k: jnp.asarray(v) for k, v in batch.items()}
+        return jax.vmap(self._canon.fingerprint_fn(self.kern))(arr)
+
+    def _canon_manifest(self):
+        return (self._canon.manifest() if self._canon is not None
+                else None)
+
+    def _symmetry_on(self):
+        """True when this run's fingerprints are orbit-reduced —
+        through the canon seam OR a factory-supplied folded kernel."""
+        return self._canon is not None or (
+            bool(self.spec.symmetry_perms) and self._sym_fold > 1)
+
+    def _check_canon_manifest(self, ck, path):
+        """Resume-seam policy (ISSUE 11 satellite): a snapshot records
+        the canonicalization spec it was fingerprinted under; resuming
+        a symmetry-on snapshot with -symmetry off (or vice versa, or
+        under a changed group/orbit table) is a loud policy error —
+        the FPSet slots hold fingerprints of a different space, so the
+        resumed run would silently re-admit or drop states.  (A
+        changed SYMMETRY *definition* already fails the spec-digest
+        check; this guards the engine-level switch.)  Mirrors the
+        pack-spec mismatch rule."""
+        ckc = ck.get("canon")
+        mine = self._canon.version if self._canon is not None else None
+        theirs = (ckc or {}).get("version")
+        if theirs != mine:
+            raise TLAError(
+                f"checkpoint {path} was written with symmetry "
+                f"canonicalization {theirs or 'off'} but this engine "
+                f"runs {mine or 'off'}; the stored fingerprints are "
+                f"not comparable — resume with the matching "
+                f"-symmetry setting/group")
+
     def _check_pack_manifest(self, ck, path):
         """Resume-seam policy (ISSUE 9 satellite): a snapshot records
         the packing-spec version it was written under; resuming with a
@@ -1142,7 +1240,7 @@ class DeviceBFS:
         init_dense = [codec.encode(st) for st in init_states]
         init_batch = {k: np.stack([d[k] for d in init_dense])
                       for k in init_dense[0]}
-        fps = np.asarray(self.kern.fingerprint_batch(init_batch))
+        fps = np.asarray(self._fp_batch(init_batch))
         keep, seen = [], set()
         for i in range(len(init_dense)):
             key = tuple(fps[i])
@@ -1181,6 +1279,7 @@ class DeviceBFS:
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
         obs.commit = self.commit
+        obs.symmetry = self._symmetry_on()
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
@@ -1217,6 +1316,7 @@ class DeviceBFS:
                 self._build(ck["max_msgs"])
                 codec = self.codec
             self._check_pack_manifest(ck, resume_from)
+            self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
             self._init_dense = ck["init_dense"]
@@ -1492,7 +1592,8 @@ class DeviceBFS:
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
                         digest=spec_digest(spec),
-                        pack=self._pack_manifest(), obs=obs)
+                        pack=self._pack_manifest(),
+                        canon=self._canon_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
@@ -1579,6 +1680,7 @@ class DeviceBFS:
         obs.pipeline = 1                # one fused dispatch in flight
         obs.pack = self._pk is not None
         obs.commit = self.commit
+        obs.symmetry = self._symmetry_on()
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
@@ -1742,7 +1844,8 @@ class DeviceBFS:
                             expand_mults=self.expand_mults,
                             elapsed=time.time() - t0,
                             digest=spec_digest(spec),
-                            pack=self._pack_manifest(), obs=obs)
+                            pack=self._pack_manifest(),
+                            canon=self._canon_manifest(), obs=obs)
                     last_checkpoint = time.time()
                     obs.checkpoint(checkpoint_path, depth, fp_count)
                     emit(f"checkpoint written to {checkpoint_path} "
@@ -1904,6 +2007,7 @@ class DeviceBFS:
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
         obs.commit = self.commit
+        obs.symmetry = self._symmetry_on()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -2125,7 +2229,8 @@ class DeviceBFS:
                                 expand_mults=self.expand_mults,
                                 elapsed=time.time() - t0,
                                 digest=spec_digest(spec),
-                                pack=self._pack_manifest(), obs=obs)
+                                pack=self._pack_manifest(),
+                                canon=self._canon_manifest(), obs=obs)
                         last_checkpoint = time.time()
                         obs.checkpoint(checkpoint_path, depth, fp_count)
                         emit(f"checkpoint written to {checkpoint_path} "
@@ -2260,6 +2365,17 @@ class DeviceBFS:
         satellite — no more post-hoc res.elapsed patching)."""
         res.distinct_states = fp_count
         self._pack_gauges(obs)
+        # symmetry canonicalization gauges (ISSUE 11): group order
+        # this run reduced by (1 = off), and the headline
+        # generated/distinct-after-canon ratio — on a symmetry-on run
+        # it folds the orbit factor on top of ordinary dedup, so the
+        # on-vs-off A/B reads the orbit cut straight off the journal
+        obs.gauge("symmetry_perms",
+                  self._canon.perms if self._canon is not None
+                  else self._sym_fold)
+        if res.states_generated and fp_count:
+            obs.gauge("orbit_ratio",
+                      round(res.states_generated / fp_count, 4))
         if fp_cap:
             obs.gauge("fpset_capacity", int(fp_cap))
             obs.gauge("fpset_occupancy", fp_count / fp_cap)

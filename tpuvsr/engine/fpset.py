@@ -80,6 +80,13 @@ def dedup_batch(fps, mask, tie=None):
     batch picks the same winner the dense batch would (ISSUE 10).
     (The single-device BFS engine's fused commit relies on the default
     batch-position tie; the sharded exchange uses both forms.)
+
+    With symmetry canonicalization on (ISSUE 11, engine/canon.py) the
+    fps in a batch are orbit-least images, so ORBIT-MATES carry equal
+    keys here: the stable first-occurrence winner is what decides
+    which generated representative a whole orbit commits to the
+    frontier — the same earliest-queue-item rule, now doing the
+    orbit-level dedup too.
     """
     key = [jnp.where(mask, fps[:, i], jnp.uint32(0xFFFFFFFF))
            for i in range(4)]

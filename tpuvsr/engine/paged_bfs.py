@@ -63,10 +63,56 @@ class PagedBFS(DeviceBFS):
     enumeration pass the device liveness graph builder reuses
     (engine/device_liveness.py)."""
 
-    def __init__(self, *args, retain_levels=False, **kwargs):
+    def __init__(self, *args, retain_levels=False, spill_dir=None,
+                 spill_ram_rows=None, **kwargs):
         self.retain_levels = retain_levels
         self.level_blocks = []
+        # disk spill tier (ISSUE 11, CAPACITY.md mitigation 2): with a
+        # spill directory, each level's host pages live in a SpillTier
+        # — at most `spill_ram_rows` rows resident, the rest in
+        # append-only level files re-read sequentially when the level
+        # pages through the device.  The host-RAM frontier ceiling
+        # becomes a disk-priced one; results are bit-identical (the
+        # tier only changes WHERE at-rest rows live)
+        self._spill_dir = spill_dir
+        self._spill_ram_rows = int(spill_ram_rows or (1 << 20))
+        self._tiers = []
+        if spill_dir and retain_levels:
+            raise TLAError(
+                "retain_levels (the liveness graph enumeration) needs "
+                "the whole level resident; it cannot be combined with "
+                "the disk spill tier")
         super().__init__(*args, **kwargs)
+
+    # -- disk-tier helpers (no-ops when spill_dir is None) -------------
+    def _tier(self, level, block, obs):
+        from .spill import SpillTier
+        t = SpillTier(self._spill_dir, level, self._spill_ram_rows,
+                      obs=obs, depth=level)
+        self._tiers.append(t)
+        if block is not None:
+            t.append(block)
+        return t
+
+    def _front_block(self, host_front, start, n):
+        """Rows [start, start+n) of the (possibly disk-tiered) host
+        frontier, in the at-rest row format."""
+        from .spill import SpillTier
+        if isinstance(host_front, SpillTier):
+            return host_front.block(start, n)
+        if self._pk is not None:
+            return host_front[start:start + n]
+        return {k: v[start:start + n] for k, v in host_front.items()}
+
+    def _front_dense(self, host_front, n):
+        """First `n` rows as dense planes (the checkpoint interchange
+        format)."""
+        from .spill import SpillTier
+        if isinstance(host_front, SpillTier):
+            host_front = host_front.all_rows()
+        if self._pk is not None:
+            return self._pk.unpack_np(np.asarray(host_front)[:n])
+        return {k: np.asarray(v)[:n] for k, v in host_front.items()}
 
     # -- host-side helpers ---------------------------------------------
     def _host_zero(self, n):
@@ -81,7 +127,14 @@ class PagedBFS(DeviceBFS):
                 for k, v in zero.items()}
 
     def _host_row(self, host_front, i):
-        """One dense state row of the (possibly packed) host frontier."""
+        """One dense state row of the (possibly packed, possibly
+        disk-tiered) host frontier."""
+        from .spill import SpillTier
+        if isinstance(host_front, SpillTier):
+            block = host_front.row(i)
+            if self._pk is not None:
+                return self._pk.unpack_row_np(np.asarray(block)[0])
+            return {k: v[0] for k, v in block.items()}
         if self._pk is not None:
             return self._pk.unpack_row_np(host_front[i])
         return {k: host_front[k][i] for k in host_front}
@@ -124,6 +177,7 @@ class PagedBFS(DeviceBFS):
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
         obs.commit = self.commit
+        obs.symmetry = self._symmetry_on()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -158,6 +212,7 @@ class PagedBFS(DeviceBFS):
                     self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
             self._check_pack_manifest(ck, resume_from)
+            self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
             self._init_dense = ck["init_dense"]
@@ -179,6 +234,10 @@ class PagedBFS(DeviceBFS):
                 {k: np.asarray(v) for k, v in ck["frontier"].items()})
                 if self._pk is not None else
                 {k: np.asarray(v) for k, v in ck["frontier"].items()})
+            if self._spill_dir is not None:
+                # reload through the tier: a resumed frontier larger
+                # than the RAM budget spills right back to disk
+                host_front = self._tier(depth, host_front, obs)
             level_base = sum(self.level_sizes[:-1])
             emit(f"resumed from {resume_from}: depth {depth}, "
                  f"{fp_count} distinct, frontier {n_front}")
@@ -194,6 +253,8 @@ class PagedBFS(DeviceBFS):
                          for k in init_batch}
             host_front = (self._pk.pack_np(init_rows)
                           if self._pk is not None else init_rows)
+            if self._spill_dir is not None:
+                host_front = self._tier(0, host_front, obs)
             n_front = n0
             level_base = 0
             depth = 0
@@ -240,8 +301,11 @@ class PagedBFS(DeviceBFS):
             depth += 1
             fault_point("level", depth=depth, obs=obs)
             # per-level host accumulators for drained next states and
-            # their (level-relative) trace pointers
-            drained = []
+            # their (level-relative) trace pointers.  Disk tier:
+            # `drained` is a SpillTier — same .append seam, but pages
+            # beyond the RAM budget flush to level files
+            drained = (self._tier(depth, None, obs)
+                       if self._spill_dir is not None else [])
             d_par, d_act, d_prm = [], [], []
             n_next_total = 0
             chunk_start = 0
@@ -278,20 +342,20 @@ class PagedBFS(DeviceBFS):
             def put_chunk():
                 nonlocal dev_chunk
                 cc = self._chunk_cap()
+                block = self._front_block(host_front, chunk_start,
+                                          n_c)
                 if self._pk is not None:
                     if dev_chunk is None:
                         dev_chunk = jnp.zeros((cc, self._pk.words),
                                               jnp.uint32)
-                    dev_chunk = dev_chunk.at[:n_c].set(
-                        host_front[chunk_start:chunk_start + n_c])
+                    dev_chunk = dev_chunk.at[:n_c].set(block)
                     return
                 if dev_chunk is None:
                     dev_chunk = {
                         k: jnp.zeros((cc,) + np.shape(v), np.int32)
                         for k, v in self.codec.zero_state().items()}
                 dev_chunk = {
-                    k: dev_chunk[k].at[:n_c].set(
-                        host_front[k][chunk_start:chunk_start + n_c])
+                    k: dev_chunk[k].at[:n_c].set(block[k])
                     for k in dev_chunk}
 
             while chunk_start < n_front and stop is None:
@@ -387,13 +451,15 @@ class PagedBFS(DeviceBFS):
                                 d = self.codec.pad_msgs(
                                     old_pk.unpack_np(rows), old)
                                 return self._pk.pack_np(d)
+                        else:
+                            def regrow(rows):
+                                return self.codec.pad_msgs(rows, old)
+                        if self._spill_dir is not None:
+                            host_front.map_pages(regrow)
+                            drained.map_pages(regrow)
+                        else:
                             host_front = regrow(host_front)
                             drained = [regrow(d) for d in drained]
-                        else:
-                            host_front = self.codec.pad_msgs(
-                                host_front, old)
-                            drained = [self.codec.pad_msgs(d, old)
-                                       for d in drained]
                         self.level_blocks = [
                             self.codec.pad_msgs(b, old)
                             for b in self.level_blocks]
@@ -457,18 +523,26 @@ class PagedBFS(DeviceBFS):
             obs.level_done(depth, frontier=n_front, distinct=fp_count,
                            generated=res.states_generated)
             if n_next_total:
-                host_next = (np.concatenate(drained)
-                             if self._pk is not None else
-                             {k: np.concatenate([d[k] for d in drained])
-                              for k in host_front})
+                if self._spill_dir is not None:
+                    host_next = drained       # the tier holds the rows
+                elif self._pk is not None:
+                    host_next = np.concatenate(drained)
+                else:
+                    host_next = {k: np.concatenate(
+                        [d[k] for d in drained]) for k in host_front}
                 self._h_parent.append(
                     np.concatenate(d_par) + level_base)
                 self._h_action.append(np.concatenate(d_act))
                 self._h_param.append(np.concatenate(d_prm))
                 self.level_sizes.append(n_next_total)
             else:
-                host_next = self._host_zero(0)
+                host_next = (drained if self._spill_dir is not None
+                             else self._host_zero(0))
             level_base += n_front
+            if self._spill_dir is not None:
+                # the consumed level's files are dead weight now:
+                # steady-state disk holds two levels' worth of rows
+                host_front.drop()
             host_front = host_next
             n_front = n_next_total
 
@@ -490,9 +564,8 @@ class PagedBFS(DeviceBFS):
                     save_checkpoint(
                         checkpoint_path,
                         slots=table["slots"],
-                        frontier=(self._pk.unpack_np(host_front)
-                                  if self._pk is not None
-                                  else host_front),
+                        frontier=self._front_dense(host_front,
+                                                   n_front),
                         n_front=n_front,
                         h_parent=np.concatenate(self._h_parent),
                         h_action=np.concatenate(self._h_action),
@@ -505,7 +578,8 @@ class PagedBFS(DeviceBFS):
                         expand_mults=self.expand_mults,
                         elapsed=time.time() - t0,
                         digest=spec_digest(spec),
-                        pack=self._pack_manifest(), obs=obs)
+                        pack=self._pack_manifest(),
+                        canon=self._canon_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
@@ -532,6 +606,19 @@ class PagedBFS(DeviceBFS):
         res.diameter = depth
         return self._finish(res, obs, fp_count,
                             table=table, fp_cap=fp_cap)
+
+
+    def _finish(self, res, obs, fp_count, table=None, fp_cap=None):
+        if self._spill_dir is not None:
+            # cumulative bytes the run wrote to the disk tier (files
+            # of consumed levels included), then release what is left
+            obs.gauge("spill_tier_bytes",
+                      int(sum(t.disk_bytes for t in self._tiers)))
+            for t in self._tiers:
+                t.drop()
+            self._tiers = []
+        return super()._finish(res, obs, fp_count, table=table,
+                               fp_cap=fp_cap)
 
 
 def paged_bfs_check(spec, max_states=None, max_depth=None,

@@ -1991,7 +1991,7 @@ class Lowerer:
 # ======================================================================
 # compiled kernel factory
 # ======================================================================
-def make_compiled_model(spec, max_msgs=None):
+def make_compiled_model(spec, max_msgs=None, fold_symmetry=True):
     """Build (codec, kernel) where every guard/action/invariant fn is
     COMPILED FROM THE SPEC AST (ir.extract_action -> Lowerer) instead of
     hand-written.  The dense layout, bag primitives, fingerprint and
@@ -2006,7 +2006,8 @@ def make_compiled_model(spec, max_msgs=None):
     registry.ensure_compile_cache()
     codec_cls, base_cls = registry._resolve(spec.module.name)
     codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
-    perms = registry.value_perm_table(spec, codec)
+    perms = registry.value_perm_table(spec, codec,
+                                      fold_symmetry=fold_symmetry)
 
     class CompiledKernel(base_cls):
         compiled_from_ast = True

@@ -64,16 +64,23 @@ def ensure_debug_flags():
     return True
 
 
-def value_perm_table(spec, codec):
+def value_perm_table(spec, codec, fold_symmetry=True):
     """spec.symmetry_perms (ModelValue maps) -> [P, V+1] id table with
-    the identity first (kernels take the min over rows)."""
+    the identity first (kernels take the min over rows).  With
+    ``fold_symmetry=False`` only the identity row is emitted — the
+    ISSUE 11 mode where the engine's CanonSpec (engine/canon.py) owns
+    orbit reduction by state canonicalization instead of the kernel's
+    min-over-permuted-hashes fold (one relabel-and-compare network per
+    state beats P full-state hashes, and ``-symmetry off`` becomes a
+    real A/B lever)."""
     V = codec.shape.V
     rows = [np.arange(V + 1, dtype=np.int32)]
-    for p in spec.symmetry_perms:
-        row = np.arange(V + 1, dtype=np.int32)
-        for mv_from, mv_to in p.items():
-            row[codec.value_id[mv_from]] = codec.value_id[mv_to]
-        rows.append(row)
+    if fold_symmetry:
+        for p in spec.symmetry_perms:
+            row = np.arange(V + 1, dtype=np.int32)
+            for mv_from, mv_to in p.items():
+                row[codec.value_id[mv_from]] = codec.value_id[mv_to]
+            rows.append(row)
     return np.stack(rows)
 
 
@@ -98,19 +105,27 @@ def has_device_model(spec) -> bool:
         return False
 
 
-def make_model(spec, max_msgs=None):
+def make_model(spec, max_msgs=None, fold_symmetry=True):
     """Build (codec, kernel) for a bound spec.
 
     With TPUVSR_COMPILED=1 the kernel's guard/action/invariant fns are
     compiled from the spec AST (lower/compile.py) instead of using the
     hand-written kernel — the hand kernel stays the differential
-    oracle (tests/test_lower.py)."""
+    oracle (tests/test_lower.py).
+
+    ``fold_symmetry=False`` builds the kernel with an identity-only
+    permutation table: its fingerprints hash the state AS GIVEN, and
+    symmetry reduction (when the cfg declares it) is the caller's job
+    via engine/canon.py's pre-fingerprint canonicalization — the
+    ISSUE 11 engine mode.  Direct kernel users (device_sim, the
+    liveness graph, kernel tests) keep the historical folded default."""
     ensure_compile_cache()
     if os.environ.get("TPUVSR_COMPILED") == "1":
         from ..core.values import TLAError
         from ..lower.compile import make_compiled_model
         try:
-            return make_compiled_model(spec, max_msgs=max_msgs)
+            return make_compiled_model(spec, max_msgs=max_msgs,
+                                       fold_symmetry=fold_symmetry)
         except TLAError as e:
             # modules beyond the lowerer's current layout surface
             # (I01/AS04/recovery-era vars) degrade to the hand kernel
@@ -120,7 +135,8 @@ def make_model(spec, max_msgs=None):
                   file=sys.stderr)
     codec_cls, kern_cls = _resolve(spec.module.name)
     codec = codec_cls(spec.ev.constants, max_msgs=max_msgs)
-    return codec, kern_cls(codec, perms=value_perm_table(spec, codec))
+    return codec, kern_cls(codec, perms=value_perm_table(
+        spec, codec, fold_symmetry=fold_symmetry))
 
 
 def _resolve(name):

@@ -76,7 +76,13 @@ class ST03Kernel:
     MSG_KEYS = MSG_KEYS
     AUX_KEYS = AUX_KEYS
     GLOBAL_KEYS = GLOBAL_KEYS
-    # value-id planes a symmetry permutation must remap
+    # value-id planes a symmetry permutation must remap.  These ARE
+    # the family's plane -> orbit table (ISSUE 11): engine/canon.py's
+    # orbit_planes derives the device canonicalization table from
+    # them (subclasses extend the tuples as their layouts grow), and
+    # the packed-entry subclasses keep the ACTION correct by
+    # overriding _perm_vals — canon prefers the kernel's _permuted,
+    # so the table only names what is touched, never how
     PERM_REP_KEYS = ("log",)
     PERM_MSG_KEYS = ("m_entry", "m_log")
     # bag-row payload pieces -> their slot planes (CP06 adds a second

@@ -107,6 +107,14 @@ class VSRKernel:
     REP_KEYS = REP_KEYS
     MSG_KEYS = MSG_KEYS
     AUX_KEYS = AUX_KEYS
+    # plane -> orbit table (ISSUE 11): which planes a symmetry value
+    # permutation touches, and how — value ids live in the operation
+    # column of every log-entry row (_permuted applies exactly this;
+    # engine/canon.py and the speclint symmetry pass both consume the
+    # table via canon.orbit_planes, so lint and kernel cannot drift)
+    SYM_PLANES = {"log": ("col", E_OPER), "dvc_log": ("col", E_OPER),
+                  "rec_log": ("col", E_OPER), "m_log": ("col", E_OPER),
+                  "m_entry": ("col", E_OPER)}
 
     def __init__(self, codec: VSRCodec, perms: np.ndarray = None):
         self.codec = codec

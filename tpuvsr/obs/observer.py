@@ -88,6 +88,11 @@ class RunObserver:
         # on the BFS engines, None on engines without a level kernel —
         # journaled on run_start with key-set parity across engines
         self.commit = None
+        # symmetry canonicalization in effect (ISSUE 11): True when
+        # the run fingerprints orbit-least images (engine/canon.py),
+        # False when reduction is off, None on engines without the
+        # seam — journaled on run_start with key-set parity
+        self.symmetry = None
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -158,7 +163,8 @@ class RunObserver:
                            backend=self.backend, resumed=bool(resumed),
                            pipeline=int(self.pipeline or 1),
                            pack=bool(self.pack),
-                           commit=self.commit, **extra)
+                           commit=self.commit,
+                           symmetry=self.symmetry, **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")
@@ -218,13 +224,18 @@ class RunObserver:
                            distinct=int(distinct),
                            elapsed_s=round(self.elapsed(), 3))
 
-    def spill(self, depth, rows, nbytes):
+    def spill(self, depth, rows, nbytes, **extra):
+        """A frontier page moved down a tier: device -> host RAM (the
+        paged drain; no ``tier`` key), or host RAM -> disk
+        (``tier: "disk"`` — the ISSUE 11 spill tier's level files)."""
         self.count("spills")
         self.count("spill_rows", rows)
         self.count("spill_bytes", nbytes)
+        if extra.get("tier") == "disk":
+            self.count("spill_disk_bytes", nbytes)
         self.journal.write("spill", depth=int(depth), rows=int(rows),
                            bytes=int(nbytes),
-                           elapsed_s=round(self.elapsed(), 3))
+                           elapsed_s=round(self.elapsed(), 3), **extra)
 
     def grow(self, what, to):
         """A growth pause (message table / FPSet / buffers / exchange

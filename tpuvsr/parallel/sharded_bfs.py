@@ -228,7 +228,8 @@ R_EXPAND_GROW = 9   # fused commit: per-action compaction cap overflow
 def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                        tile: int, bucket_cap: int,
                        check_deadlock: bool = False, pack_spec=None,
-                       commit: str = "fused", expand_caps=None):
+                       commit: str = "fused", expand_caps=None,
+                       canon=None):
     """Build the jitted one-tile sharded BFS step.
 
     step(tables, frontier, n_front, start_t, nb, nbp, nba, nbprm, nn,
@@ -271,6 +272,12 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
+    # symmetry canonicalization (ISSUE 11): fingerprints are taken on
+    # the orbit-least image BEFORE ownership bucketing, so orbit-mates
+    # hash — and therefore route — to the same shard and dedup there;
+    # the exchanged STATE stays the generated representative
+    fpf = (canon.fingerprint_fn(kern) if canon is not None
+           else kern.fingerprint)
     n_act = len(kern.action_names)
     lane_aid = jnp.asarray(kern.lane_action)
     lane_prm = jnp.asarray(kern.lane_param)
@@ -384,7 +391,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 # buckets, the wire, and the next frontier all move
                 # the packed row from here on
                 flat_rows = jax.vmap(pack_spec.pack)(flat)
-            fps = jax.vmap(kern.fingerprint)(flat)
+            fps = jax.vmap(fpf)(flat)
             iok = jax.vmap(inv_fn)(flat)
             errv = jnp.where(en_f, flat["err"], 0)
             viol_l = en_f & ~iok & (errv == 0)
@@ -604,7 +611,8 @@ class ShardedBFS:
                  fpset_capacity=1 << 14, check_deadlock=False,
                  model_factory=None, pipeline=2, exchange_retries=5,
                  exchange_backoff=0.05, exchange_backoff_cap=2.0,
-                 sleep=time.sleep, pack="auto", commit="fused"):
+                 sleep=time.sleep, pack="auto", commit="fused",
+                 symmetry="auto"):
         from ..core.values import TLAError
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
@@ -647,6 +655,10 @@ class ShardedBFS:
         # the interchange format (ratio 1.0 without bounds).  Results
         # are bit-identical either way.
         self._pack_req = pack
+        # symmetry canonicalization (ISSUE 11): "auto" = on iff the
+        # cfg declares SYMMETRY; the CanonSpec runs inside the sharded
+        # step, pre-bucketing (see make_sharded_level)
+        self._symmetry_req = symmetry
         # model_factory(spec, max_msgs=..) -> (codec, kernel); default
         # is the hand-kernel registry (DeviceBFS parity — tests drive
         # the driver with stub kernels through this hook)
@@ -667,10 +679,31 @@ class ShardedBFS:
         from ..models import registry
         registry.ensure_compile_cache()
         registry.ensure_debug_flags()
-        factory = self._model_factory or registry.make_model
+        factory = self._model_factory or (
+            lambda spec, max_msgs=None: registry.make_model(
+                spec, max_msgs=max_msgs, fold_symmetry=False))
         self.codec, self.kern = factory(self.spec, max_msgs=max_msgs)
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}
+        # symmetry canonicalization spec (rebuilt with the codec).
+        # A factory-supplied FOLDED kernel already owns the reduction:
+        # the canon seam stands down, and forcing -symmetry off is a
+        # loud error (see DeviceBFS._build)
+        from ..core.values import TLAError
+        from ..engine.canon import build_canon_spec, kernel_fold_order
+        self._sym_fold = kernel_fold_order(self.kern)
+        if self.spec.symmetry_perms and self._sym_fold > 1:
+            if self._symmetry_req is False:
+                raise TLAError(
+                    "symmetry=False requested but the model factory "
+                    "built a kernel with a FOLDED perm table; rebuild "
+                    "it with fold_symmetry=False "
+                    "(registry.make_model) to make -symmetry off real")
+            self._canon = None
+        else:
+            self._canon = build_canon_spec(self.spec, self.codec,
+                                           self.kern,
+                                           self._symmetry_req)
         # packed-frontier spec for THIS codec binding (rebuilt with the
         # codec on bag growth — MAX_MSGS changes the lane count)
         from ..engine.pack import build_pack_spec
@@ -698,7 +731,8 @@ class ShardedBFS:
                                         check_deadlock=self._ckd,
                                         pack_spec=self._pk,
                                         commit=self.commit,
-                                        expand_caps=self.expand_caps)
+                                        expand_caps=self.expand_caps,
+                                        canon=self._canon)
         self._fresh_jit = True   # first dispatch after a (re)jit is
         #                          charged to the "compile" phase
         self._sh = NamedSharding(self.mesh, P(self.axis))
@@ -715,6 +749,10 @@ class ShardedBFS:
     _pack_manifest = _DB._pack_manifest
     _check_pack_manifest = _DB._check_pack_manifest
     _pack_gauges = _DB._pack_gauges
+    _fp_batch = _DB._fp_batch
+    _canon_manifest = _DB._canon_manifest
+    _check_canon_manifest = _DB._check_canon_manifest
+    _symmetry_on = _DB._symmetry_on
 
     def _flush_pointers(self):
         """No-op: the sharded driver's pointer pulls are synchronous
@@ -779,6 +817,7 @@ class ShardedBFS:
         obs.pipeline = self.pipe_window
         obs.pack = self._pk is not None
         obs.commit = self.commit
+        obs.symmetry = self._symmetry_on()
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
@@ -861,6 +900,7 @@ class ShardedBFS:
             # matches the spec rebuilt at ITS MAX_MSGS (DeviceBFS
             # orders these the same way)
             self._check_pack_manifest(ck, resume_from)
+            self._check_canon_manifest(ck, resume_from)
             rows = ck["frontier"]
             h_parent = np.asarray(ck["h_parent"])
             h_action = np.asarray(ck["h_action"])
@@ -890,7 +930,9 @@ class ShardedBFS:
                 # gid -> (parent, action, param) stays aligned (the
                 # frontier IS the last level_sizes entry, saved in the
                 # same global order as the trace tail)
-                ffps = np.asarray(self.kern.fingerprint_batch(
+                # canonical fingerprints (when symmetry is on) so the
+                # re-route matches the live exchange's ownership rule
+                ffps = np.asarray(self._fp_batch(
                     {k: np.asarray(v) for k, v in rows.items()}))
                 fowner = (np.asarray(route(jnp.asarray(ffps)))
                           % np.uint32(D)).astype(np.int64)
@@ -964,7 +1006,7 @@ class ShardedBFS:
             init_states = list(spec.init_states())
             dense = [codec.encode(st) for st in init_states]
             batch = {k: np.stack([d[k] for d in dense]) for k in dense[0]}
-            fps = np.asarray(self.kern.fingerprint_batch(batch))
+            fps = np.asarray(self._fp_batch(batch))
             keep, seen = [], set()
             for i in range(len(dense)):
                 t = tuple(fps[i])
@@ -1258,7 +1300,8 @@ class ShardedBFS:
                         self.tile, self.bucket_cap,
                         check_deadlock=self._ckd, pack_spec=self._pk,
                         commit=self.commit,
-                        expand_caps=self.expand_caps)
+                        expand_caps=self.expand_caps,
+                        canon=self._canon)
                     self._fresh_jit = True
                     obs.grow("exchange_bucket", self.bucket_cap)
                     emit(f"exchange bucket grown to {self.bucket_cap} "
@@ -1291,7 +1334,8 @@ class ShardedBFS:
                         self.tile, self.bucket_cap,
                         check_deadlock=self._ckd, pack_spec=self._pk,
                         commit=self.commit,
-                        expand_caps=self.expand_caps)
+                        expand_caps=self.expand_caps,
+                        canon=self._canon)
                     self._fresh_jit = True
                     for _n, cap in grown:
                         obs.grow("expand_buffer", cap)
@@ -1395,7 +1439,8 @@ class ShardedBFS:
                         expand_mults=[],
                         elapsed=_time.time() - t0,
                         digest=spec_digest(spec),
-                        pack=self._pack_manifest(), obs=obs,
+                        pack=self._pack_manifest(),
+                        canon=self._canon_manifest(), obs=obs,
                         extra={"sharded": True,
                                "shard_counts": [int(x) for x in nn_h],
                                "bucket_cap": self.bucket_cap,
@@ -1446,6 +1491,12 @@ class ShardedBFS:
     def _finish(self, res, obs, fp_count):
         res.distinct_states = fp_count
         self._pack_gauges(obs)
+        obs.gauge("symmetry_perms",
+                  self._canon.perms if self._canon is not None
+                  else self._sym_fold)
+        if res.states_generated and fp_count:
+            obs.gauge("orbit_ratio",
+                      round(res.states_generated / fp_count, 4))
         cap_total = self.fp_cap * self.D
         obs.gauge("fpset_capacity", cap_total)
         obs.gauge("fpset_occupancy",

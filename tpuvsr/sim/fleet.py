@@ -188,8 +188,18 @@ class FleetSimulator:
                  action_weights=None, swarm_sigma=0.0, split=None,
                  pipeline=2, dispatch="grouped", group_caps=None,
                  min_walkers=64, max_retries=4, model_factory=None,
-                 seen_capacity=1 << 14, log=None):
-        self._model_factory = model_factory or registry.make_model
+                 seen_capacity=1 << 14, log=None, symmetry="auto"):
+        # symmetry canonicalization (ISSUE 11): fleet fingerprints
+        # only feed the novelty seen-set (splitting.py), so the canon
+        # seam makes novelty count ORBITS — a walker exploring a
+        # permuted replay of seen territory scores as revisiting.
+        # "auto" = on iff the cfg declares SYMMETRY; verdicts and the
+        # (seed, walk-id) determinism contract are untouched (canon is
+        # a pure function applied pre-insert)
+        self._symmetry_req = symmetry
+        self._model_factory = model_factory or (
+            lambda spec, max_msgs=None: registry.make_model(
+                spec, max_msgs=max_msgs, fold_symmetry=False))
         self.spec = spec
         self.inv_names = list(spec.cfg.invariants)
         self.chunk = int(chunk_steps)
@@ -249,6 +259,14 @@ class FleetSimulator:
             self.group_caps = None   # re-derived for the new local size
         self._build(self._max_msgs)
 
+    def _symmetry_on(self):
+        """True when novelty fingerprints are orbit-reduced — via the
+        canon seam or a factory-supplied folded kernel (NOT merely
+        because the cfg declares SYMMETRY: symmetry=False really
+        turns the fold off)."""
+        return self._canon is not None or (
+            bool(self.spec.symmetry_perms) and self._sym_fold > 1)
+
     def _build(self, max_msgs):
         """Compile the fused multi-step chunk kernel for the current
         (walkers, mesh, message-table, dispatch-cap) shape."""
@@ -256,6 +274,16 @@ class FleetSimulator:
         self._max_msgs = max_msgs
         self.codec, self.kern = self._model_factory(self.spec,
                                                     max_msgs=max_msgs)
+        from ..engine.canon import build_canon_spec, kernel_fold_order
+        self._sym_fold = kernel_fold_order(self.kern)
+        if self._sym_fold > 1:
+            # a factory-supplied folded kernel already orbit-folds its
+            # fingerprints — the novelty seen-set needs no extra canon
+            self._canon = None
+        else:
+            self._canon = build_canon_spec(self.spec, self.codec,
+                                           self.kern,
+                                           self._symmetry_req)
         kern = self.kern
         names = kern.action_names
         n_act = len(names)
@@ -453,7 +481,7 @@ class FleetSimulator:
             donate_argnums=(1,) if self._donate else ())
         self._fresh_jit = True
         if self.splitter is not None:
-            self.splitter.bind(kern)
+            self.splitter.bind(kern, canon=self._canon)
         self._mat = {}
         # the encoded init batch is a pure function of the codec (and
         # the codec only changes on a rebuild) — cache it per build
@@ -894,6 +922,7 @@ class FleetSimulator:
         if log is not None:
             self._log = self._log or log
         obs = RunObserver.ensure(obs, "fleet-sim", self.spec, log=log)
+        obs.symmetry = self._symmetry_on()
         res = SimResult()
 
         def on_round(rr):
@@ -1133,13 +1162,14 @@ def fleet_simulate(spec, num=1000, depth=100, seed=0, walkers=4096,
                    action_weights=None, swarm_sigma=0.0, split=None,
                    pipeline=2, check_deadlock=False, log=None,
                    max_seconds=None, obs=None, checkpoint_path=None,
-                   resume_from=None, model_factory=None) -> SimResult:
+                   resume_from=None, model_factory=None,
+                   symmetry="auto") -> SimResult:
     """One-call fleet simulation (the ``device_simulate`` successor)."""
     sim = FleetSimulator(spec, walkers=walkers, n_devices=n_devices,
                          max_msgs=max_msgs, chunk_steps=chunk_steps,
                          action_weights=action_weights,
                          swarm_sigma=swarm_sigma, split=split,
-                         pipeline=pipeline,
+                         pipeline=pipeline, symmetry=symmetry,
                          model_factory=model_factory, log=log)
     return sim.run(num=num, depth=depth, seed=seed,
                    check_deadlock=check_deadlock, log=log,

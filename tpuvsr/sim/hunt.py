@@ -84,7 +84,8 @@ def run_hunt(spec, *, walkers=4096, depth=100, seed=0, num=None,
              pipeline=2, n_devices=None, mesh=None, max_msgs=None,
              model_factory=None, checkpoint_path=None,
              resume_from=None, obs=None, log=None, on_chunk=None,
-             elastic=None, min_walkers=64, sim=None) -> SimResult:
+             elastic=None, min_walkers=64, sim=None,
+             symmetry="auto") -> SimResult:
     """Drive a defect hunt; returns a :class:`SimResult` whose
     ``violations`` list holds one record per UNIQUE violation
     (``{name, walk, depth, dedup, trace}``), with ``trace`` already in
@@ -99,8 +100,9 @@ def run_hunt(spec, *, walkers=4096, depth=100, seed=0, num=None,
         chunk_steps=chunk_steps, max_msgs=max_msgs,
         action_weights=action_weights, swarm_sigma=swarm_sigma,
         split=split, pipeline=pipeline, min_walkers=min_walkers,
-        model_factory=model_factory, log=log)
+        model_factory=model_factory, log=log, symmetry=symmetry)
     obs = RunObserver.ensure(obs, "fleet-hunt", spec, log=log)
+    obs.symmetry = sim._symmetry_on()
     res = SimResult()
     res.violations = []
     dedup = set()

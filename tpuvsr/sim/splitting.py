@@ -64,9 +64,17 @@ class NoveltySplitter:
         self._fp = None
         self._score = None
 
-    def bind(self, kern):
-        """(Re)bind the kernel-derived jits after a fleet rebuild."""
-        self._fp = jax.jit(kern.fingerprint_batch)
+    def bind(self, kern, canon=None):
+        """(Re)bind the kernel-derived jits after a fleet rebuild.
+        With a CanonSpec (ISSUE 11) the seen-set holds orbit-least
+        fingerprints, so novelty is counted per symmetry ORBIT."""
+        if canon is not None:
+            fpf = canon.fingerprint_fn(kern)
+            self._fp = jax.jit(
+                lambda batch: jax.vmap(fpf)(
+                    {k: jnp.asarray(v) for k, v in batch.items()}))
+        else:
+            self._fp = jax.jit(kern.fingerprint_batch)
         self._score = None
         if self.hunt_beta > 0.0 and hasattr(kern, "hunt_score"):
             self._score = jax.jit(jax.vmap(kern.hunt_score))

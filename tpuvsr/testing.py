@@ -316,6 +316,205 @@ def stub_validator(spec=None, batch=64, n_devices=1, cand_cap=4,
                           model_factory=stub_model_factory(), **kw)
 
 
+# ---------------------------------------------------------------------
+# symmetric fixture (ISSUE 11): a two-slot write-once register over a
+# symmetric model-value set — the tier-1 stand-in for the defect
+# fixture's SYMMETRY Permutations(Values).  16 reachable states
+# collapse to 5 orbits under the full S3 group (orbit factor 3.2), and
+# every orbit invariant (NoPair) has reachable violations, so the
+# symmetry-on-vs-off verdict/trace oracles run without the reference
+# mount.
+# ---------------------------------------------------------------------
+SYMPAIR = """---- MODULE ObsSymPair ----
+CONSTANTS Vals
+VARIABLES a, b
+
+Init == a = 0 /\\ b = 0
+
+WriteA ==
+    /\\ a = 0
+    /\\ \\E v \\in Vals : a' = v
+    /\\ UNCHANGED b
+
+WriteB ==
+    /\\ b = 0
+    /\\ \\E v \\in Vals : b' = v
+    /\\ UNCHANGED a
+
+Next == WriteA \\/ WriteB
+
+Symm == Permutations(Vals)
+
+NoPair == a = 0 \\/ b = 0
+
+AllOk == TRUE
+====
+"""
+SYMPAIR_CFG = ("CONSTANTS\n    Vals = {v1, v2, v3}\n"
+               "INIT Init\nNEXT Next\nSYMMETRY Symm\nINVARIANT {inv}\n")
+
+#: exact fixpoints of the SymPair fixture — the symmetry A/B oracle
+SYMPAIR_DISTINCT = 16          # symmetry off: all orbit members
+SYMPAIR_ORBITS = 5             # symmetry on: one state per orbit
+SYMPAIR_LEVELS = [1, 6, 9]
+SYMPAIR_ORBIT_LEVELS = [1, 2, 2]
+
+
+def sym_pair_spec(inv_pair=False, symmetry=True):
+    """The symmetric two-slot fixture.  ``inv_pair`` swaps in the
+    NoPair invariant (first violations at depth 2 — a full orbit of
+    9 witnesses, so traces agree between symmetry on/off only modulo
+    orbit representative).  ``symmetry=False`` drops the SYMMETRY
+    declaration (the cfg-level A/B leg)."""
+    cfg = SYMPAIR_CFG.replace("{inv}",
+                              "NoPair" if inv_pair else "AllOk")
+    if not symmetry:
+        cfg = cfg.replace("SYMMETRY Symm\n", "")
+    return SpecModel(parse_module_text(SYMPAIR), parse_cfg_text(cfg))
+
+
+def stub_sym_factory(inv_pair=False):
+    """``model_factory`` for the SymPair fixture: a codec/kernel pair
+    whose ``a``/``b`` planes hold value ids (0 = unset) and declare
+    the ``SYM_PLANES`` orbit table engine/canon.py consumes."""
+    import jax
+    import jax.numpy as jnp
+
+    from .core.values import ModelValue
+
+    class _Shape:
+        MAX_MSGS = 4
+        V = 3
+
+    class SymCodec:
+        MSG_KEYS = ()
+
+        def __init__(self, values):
+            self.shape = _Shape()
+            self.values = values                   # id-1 -> ModelValue
+            self.value_id = {v: i + 1 for i, v in enumerate(values)}
+
+        def zero_state(self):
+            return {"status": 0, "a": 0, "b": 0, "err": 0}
+
+        def plane_bounds(self, ranges):
+            V = self.shape.V
+            return {"status": (0, 1), "a": (0, V), "b": (0, V),
+                    "err": (0, 1)}
+
+        def encode(self, st):
+            def enc(v):
+                return np.int32(self.value_id.get(v, 0))
+            return {"status": np.int32(0), "a": enc(st["a"]),
+                    "b": enc(st["b"]), "err": np.int32(0)}
+
+        def decode(self, d):
+            def dec(x):
+                i = int(np.asarray(x))
+                return self.values[i - 1] if i else 0
+            return {"a": dec(d["a"]), "b": dec(d["b"])}
+
+        def pad_msgs(self, batch, old):
+            return batch
+
+    class SymKern:
+        action_names = ["WriteA", "WriteB"]
+        V = 3
+        n_lanes = 6
+        # the plane -> orbit table (ISSUE 11): both registers hold
+        # bare value ids, so a permutation remaps every lane
+        SYM_PLANES = {"a": "all", "b": "all"}
+
+        def _lane_count(self, name):
+            return self.V
+
+        def _guard_fns(self):
+            return [lambda st, ln: st["a"] == 0,
+                    lambda st, ln: st["b"] == 0]
+
+        def _action_fns(self):
+            def wa(st, ln):
+                succ = {"status": st["status"], "a": ln + 1,
+                        "b": st["b"], "err": jnp.int32(0)}
+                return succ, st["a"] == 0
+
+            def wb(st, ln):
+                succ = {"status": st["status"], "a": st["a"],
+                        "b": ln + 1, "err": jnp.int32(0)}
+                return succ, st["b"] == 0
+            return [wa, wb]
+
+        lane_action = np.array([0] * 3 + [1] * 3, np.int32)
+        lane_param = np.array([0, 1, 2, 0, 1, 2], np.int32)
+
+        def step_all(self, st):
+            succs, ens = [], []
+            for fn in self._action_fns():
+                for ln in range(self.V):
+                    s, e = fn(st, jnp.int32(ln))
+                    succs.append(s)
+                    ens.append(e)
+            return ({k: jnp.stack([s[k] for s in succs])
+                     for k in succs[0]}, jnp.stack(ens))
+
+        def fingerprint(self, st):
+            a = jnp.uint32(st["a"])
+            b = jnp.uint32(st["b"])
+            return jnp.stack([a * jnp.uint32(8) + b + jnp.uint32(1),
+                              a + jnp.uint32(1), b + jnp.uint32(1),
+                              jnp.uint32(77)])
+
+        def fingerprint_batch(self, batch):
+            arr = {k: jnp.asarray(v) for k, v in batch.items()}
+            return jax.vmap(self.fingerprint)(arr)
+
+        def invariant_fn(self, names):
+            if inv_pair:
+                return lambda st: (st["a"] == 0) | (st["b"] == 0)
+            return lambda st: jnp.asarray(True)
+
+        def hunt_score(self, st):
+            return jnp.asarray(st["a"] + st["b"], jnp.float32)
+
+    def make(spec, max_msgs=None):
+        values = sorted((v for v in spec.ev.constants["Vals"]
+                         if isinstance(v, ModelValue)),
+                        key=lambda v: v.name)
+        return SymCodec(values), SymKern()
+    return make
+
+
+def stub_sym_engine(cls=None, symmetry="auto", inv_pair=False, **kw):
+    """A small DeviceBFS (or `cls`) over the SymPair fixture — the
+    tier-1 harness for the symmetry-on-vs-off oracles (ISSUE 11)."""
+    from .engine.device_bfs import DeviceBFS
+    cls = cls or DeviceBFS
+    return cls(sym_pair_spec(inv_pair=inv_pair),
+               model_factory=stub_sym_factory(inv_pair=inv_pair),
+               hash_mode="full", symmetry=symmetry,
+               tile_size=kw.pop("tile_size", 4),
+               fpset_capacity=kw.pop("fpset_capacity", 1 << 8),
+               next_capacity=kw.pop("next_capacity", 1 << 6), **kw)
+
+
+def stub_sym_sharded(n_devices=2, symmetry="auto", inv_pair=False,
+                     **kw):
+    """ShardedBFS over the SymPair fixture (canonicalize-before-
+    bucketing: orbit-mates must hash to one shard)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from .parallel.sharded_bfs import ShardedBFS
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("d",))
+    return ShardedBFS(
+        sym_pair_spec(inv_pair=inv_pair), mesh,
+        model_factory=stub_sym_factory(inv_pair=inv_pair),
+        symmetry=symmetry, tile=kw.pop("tile", 4),
+        bucket_cap=kw.pop("bucket_cap", 64),
+        next_capacity=kw.pop("next_capacity", 1 << 6),
+        fpset_capacity=kw.pop("fpset_capacity", 1 << 8), **kw)
+
+
 def bad_counter_spec():
     """A counter-spec variant that FAILS the speclint frames pass
     (IncX leaves ``y`` unframed) — the admission-rejection fixture for

@@ -129,7 +129,16 @@ class BatchValidator:
                  mesh=None, chunk_steps=8, cand_cap=4, max_msgs=None,
                  pipeline=2, min_batch=8, max_retries=4,
                  model_factory=None, confirm=True, log=None):
-        self._model_factory = model_factory or registry.make_model
+        # trace validation tracks CONCRETE states: an observation may
+        # pin any variable to a specific (model) value, so two
+        # orbit-equivalent candidates are NOT interchangeable and
+        # symmetry reduction never applies here (ISSUE 11: the default
+        # kernel is built with fold_symmetry=False so orbit-folded
+        # fingerprints can't merge distinct candidates; the CLI
+        # rejects -symmetry on with -validate)
+        self._model_factory = model_factory or (
+            lambda spec, max_msgs=None: registry.make_model(
+                spec, max_msgs=max_msgs, fold_symmetry=False))
         self.spec = spec
         self.inv_names = list(spec.cfg.invariants)
         self.chunk = int(chunk_steps)
