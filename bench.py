@@ -354,6 +354,12 @@ def main():
     # orbit_ratio drops and distinct growth at matching modes
     RESULT["symmetry_perms"] = g.get("symmetry_perms")
     RESULT["orbit_ratio"] = g.get("orbit_ratio")
+    # bounds pre-pass identity (ISSUE 13): pack bits saved by interval
+    # tightening (1.0 = untightened/off) and the static state bound;
+    # compare_bench treats ratio mismatches between docs as advisory,
+    # like pipeline depth
+    RESULT["bound_tightening_ratio"] = g.get("bound_tightening_ratio")
+    RESULT["state_bound"] = g.get("state_bound")
     # A/B the chunked engine's dispatch window on the same probe
     # (ISSUE 4 acceptance): -pipeline 1 vs -pipeline 2 must explore
     # the identical space; the throughput delta is the window's win
@@ -481,6 +487,36 @@ def main():
                                   and on["reached_fixpoint"]
                                   else None),
                 }
+            # bounds A/B (ISSUE 13 acceptance): declared-widths
+            # packing + full action lists vs the tightened default —
+            # counts must be IDENTICAL (the facts only change the
+            # representation, never the explored space); the
+            # bound_tightening_ratio is the static win
+            if time.time() < DEADLINE - 90:
+                e = DeviceBFS(spec, tile_size=tile,
+                              fpset_capacity=1 << 21,
+                              next_capacity=1 << 15, expand_mult=2,
+                              expand_mults={"ReceiveMatchingSVC": 4,
+                                            "SendDVC": 4},
+                              pipeline=2, bounds=False)
+                e.run(max_depth=6)      # compile + warm
+                r = e.run(max_seconds=max(30.0,
+                                          DEADLINE - time.time()))
+                ab["bounds_off"] = {
+                    "distinct": r.distinct_states,
+                    "generated": r.states_generated,
+                    "distinct_per_s": round(
+                        r.distinct_states / r.elapsed, 1),
+                    "elapsed_s": round(r.elapsed, 2),
+                    "reached_fixpoint": r.error is None,
+                }
+                if ab["bounds_off"]["reached_fixpoint"] and \
+                        ab["counts_identical"]:
+                    ab["counts_identical"] = (
+                        ab["bounds_off"]["distinct"]
+                        == ab["pipeline1"]["distinct"]
+                        and ab["bounds_off"]["generated"]
+                        == ab["pipeline1"]["generated"])
             RESULT["pipeline_ab"] = ab
             print(f"bench: pipeline A/B "
                   f"{ab['pipeline1']['distinct_per_s']} -> "

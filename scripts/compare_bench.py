@@ -338,6 +338,19 @@ def main(argv=None):
             commit_mismatch = True
             print(f"  commit: {bc} -> {cc} (different level-kernel "
                   f"commit modes — comparison is advisory)")
+        # bounds-tightening mismatch (ISSUE 13): a bounds-off (ratio
+        # 1.0) doc vs a tightened one measures different at-rest
+        # representations — advisory, like pipeline depth (results
+        # are bit-identical; bench's bounds_off A/B leg gates
+        # counts_identical)
+        br = (base_doc.get("bound_tightening_ratio")
+              or bm.get("gauges", {}).get("bound_tightening_ratio"))
+        cr = (cand_doc.get("bound_tightening_ratio")
+              or cm.get("gauges", {}).get("bound_tightening_ratio"))
+        if br is not None and cr is not None and br != cr:
+            print(f"  bound_tightening_ratio: {br} -> {cr} "
+                  f"(different bounds-pass tightening — comparison "
+                  f"is advisory)")
         # occupancy regression gate (ISSUE 10): the fraction of expand
         # lanes doing real work dropping means the exact-count packing
         # regressed (caps ballooned past the observed need)

@@ -64,6 +64,12 @@ reference mount, no TPU, seconds on the CPU backend:
                      files (ISSUE 11, 2-row RAM budget) -> rescue
                      checkpoint; the resume reloads the frontier
                      through the tier and completes the exact fixpoint
+  kill-bounds-resume SIGTERM mid-run under bounds-TIGHTENED packing
+                     (ISSUE 13) -> rescue snapshot recording the
+                     facts digest; tightened AND untightened (bounds
+                     off) kill/resume pairs both reach the exact
+                     fixpoint, and a flipped -bounds resume is
+                     REFUSED (policy error)
   kill-validate-resume  SIGTERM mid-batch on a kind="validate" job
                      (ISSUE 8) -> candidate-frontier rescue at the
                      committed chunk boundary, preempt-requeue through
@@ -389,6 +395,72 @@ def scenario_kill_spill_resume(tmp):
         "rescue_depth": preempted.depth,
         "disk_spills": len(disk),
         "distinct": res.distinct_states,
+    }
+
+
+def scenario_kill_bounds_resume(tmp):
+    """ISSUE 13 satellite: kill mid-run under bounds-TIGHTENED packing
+    -> rescue checkpoint recording the facts digest; the tightened
+    resume completes the exact fixpoint, a flipped -bounds resume is
+    REFUSED (policy error), and an untightened (bounds-off) kill/
+    resume pair is bit-identical too."""
+    ORACLE = _oracle()
+    from tpuvsr.core.values import TLAError
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import stub_device_engine
+
+    def kill_run(ck, jp, **kw):
+        faults.install("kill@level=3")
+        preempted = None
+        try:
+            with PreemptionGuard():
+                try:
+                    eng = stub_device_engine(**kw)
+                    eng.run(checkpoint_path=ck,
+                            obs=RunObserver(journal_path=jp))
+                except Preempted as p:
+                    preempted = p
+        finally:
+            faults.clear()
+        return preempted
+
+    ck_on = os.path.join(tmp, "bounds-on-ck")
+    jp = os.path.join(tmp, "bounds.jsonl")
+    p_on = kill_run(ck_on, jp)                 # bounds default ON
+    if p_on is None:
+        return {"ok": False, "why": "no Preempted raised (on leg)"}
+    eng_on = stub_device_engine()
+    assert eng_on._pk.total_bits < eng_on._pk_decl.total_bits
+    res_on = eng_on.run(resume_from=ck_on)
+    flipped = False
+    try:
+        stub_device_engine(bounds=False).run(resume_from=ck_on)
+    except TLAError:
+        flipped = True
+    ck_off = os.path.join(tmp, "bounds-off-ck")
+    p_off = kill_run(ck_off, os.path.join(tmp, "bounds-off.jsonl"),
+                     bounds=False)
+    if p_off is None:
+        return {"ok": False, "why": "no Preempted raised (off leg)"}
+    res_off = stub_device_engine(bounds=False).run(resume_from=ck_off)
+    starts = [e for e in read_journal(jp)
+              if e["event"] == "run_start"]
+    return {
+        "ok": (p_on.depth == 3 and res_on.ok and res_off.ok
+               and res_on.distinct_states == ORACLE["distinct"]
+               and res_off.distinct_states == ORACLE["distinct"]
+               and res_on.levels == ORACLE["levels"]
+               and res_off.levels == ORACLE["levels"]
+               and flipped
+               and all((e.get("bounds") or {}).get("tightened")
+                       for e in starts)),
+        "rescue_depth": p_on.depth,
+        "distinct_tightened": res_on.distinct_states,
+        "distinct_untightened": res_off.distinct_states,
+        "flip_refused": flipped,
     }
 
 
@@ -832,6 +904,7 @@ SCENARIOS = [
     ("kill-fused-commit-resume", scenario_kill_fused_commit_resume),
     ("kill-canon-resume", scenario_kill_canon_resume),
     ("kill-spill-resume", scenario_kill_spill_resume),
+    ("kill-bounds-resume", scenario_kill_bounds_resume),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),

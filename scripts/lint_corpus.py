@@ -9,10 +9,13 @@ kernel).  Exit code 0 when every model is clean of error-severity
 findings, 1 otherwise, 3 when the reference corpus is not mounted.
 
 Usage:
-    python scripts/lint_corpus.py [--json] [only_stem_substr]
+    python scripts/lint_corpus.py [--json] [--bounds] [only_stem_substr]
 
 --json emits one JSON object: {model: report_dict, ...} plus an "ok"
 summary key, mirroring the CLI's `-lint -json` per-spec shape.
+--bounds adds a per-model bounds-pass column (ISSUE 13): tightened?,
+dead-action count and the static state bound — the facts the engines
+consume, read straight off each report's extras["bounds"] section.
 """
 
 import json
@@ -129,8 +132,20 @@ def load_all(only=""):
     return specs
 
 
+def _bounds_col(report):
+    """One-line bounds summary column from a report's extras."""
+    b = report.extras.get("bounds") or {}
+    if not b:
+        return "bounds: (pass did not run)"
+    sb = b.get("state_bound")
+    return (f"bounds: tightened={b.get('tightened')} "
+            f"dead={len(b.get('dead_actions') or [])} "
+            f"state_bound={'unbounded' if sb is None else sb}")
+
+
 def main(argv):
     as_json = "--json" in argv
+    with_bounds = "--bounds" in argv
     rest = [a for a in argv if not a.startswith("--")]
     only = rest[0] if rest else ""
 
@@ -154,6 +169,8 @@ def main(argv):
     else:
         for stem, (r, dt) in reports.items():
             print(f"==== {stem} ({dt:.2f}s)")
+            if with_bounds:
+                print(_bounds_col(r))
             print(r.render())
         print(f"==== corpus {'CLEAN' if ok else 'HAS ERRORS'} "
               f"({time.time() - t0:.2f}s total)")

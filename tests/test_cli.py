@@ -162,6 +162,25 @@ def test_cli_symmetry_spill_flag_conflicts_exit_2(bad):
     assert "usage" in r.stderr or "error" in r.stderr
 
 
+@pytest.mark.parametrize("bad", [
+    ["-bounds", "on", "-lint=off"],
+    ["-bounds", "on", "-engine", "interp"],
+    ["-bounds", "on", "-fpset", "host"],
+    ["-bounds", "on", "-simulate"],
+    ["-bounds", "on", "-validate", "t.jsonl"],
+    ["-bounds", "maybe"],
+], ids=["lint-off", "interp", "fpset-host", "simulate", "validate",
+        "bad-mode"])
+def test_cli_bounds_flag_conflicts_exit_2(bad):
+    """ISSUE 13 satellite: -bounds on consumes the speclint bounds
+    pass, so combining it with -lint=off (untrusted facts) or the
+    interpreter engine (no pack/lane tables to tighten) is an
+    argparse error (exit 2) before any spec is loaded."""
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
 def test_cli_symmetry_on_with_liveness_spec_exit_2(tmp_path):
     """-symmetry on with a PROPERTY cfg is the liveness conflict the
     reference cfg comments insist on — checked right after the cfg

@@ -321,17 +321,23 @@ def test_packed_checkpoint_resume_bit_identical(tmp_path):
 def test_pack_version_mismatch_is_policy_error(tmp_path):
     """Resume under a MISMATCHED widths table (different bit budgets
     -> different spec version) is a loud TLAError, not a silent
-    re-encode."""
+    re-encode.  Run with bounds=False on both sides: the ISSUE 13
+    reachable-interval tightening would otherwise intersect BOTH
+    tables down to the same (identical, compatible) reachable budgets
+    — this test pins the DECLARED-widths policy seam."""
     from tpuvsr.engine.device_bfs import DeviceBFS
     ck = str(tmp_path / "mismatch.ckpt")
-    r1 = stub_device_engine().run(max_depth=3, checkpoint_path=ck)
+    r1 = stub_device_engine(bounds=False).run(max_depth=3,
+                                              checkpoint_path=ck)
     assert r1.error
     # limit=7 widens x/y to 4-bit budgets: a different packing spec
     eng = DeviceBFS(counter_spec(),
                     model_factory=stub_model_factory(limit=7),
                     hash_mode="full", tile_size=4,
-                    fpset_capacity=1 << 8, next_capacity=1 << 6)
-    assert eng._pk.version != stub_device_engine()._pk.version
+                    fpset_capacity=1 << 8, next_capacity=1 << 6,
+                    bounds=False)
+    assert eng._pk.version != \
+        stub_device_engine(bounds=False)._pk.version
     with pytest.raises(TLAError, match="packing spec"):
         eng.run(resume_from=ck)
 
@@ -344,10 +350,14 @@ def test_sharded_packed_checkpoint_resume(tmp_path):
     sharded resume-side manifest check fires on a drifted table."""
     ck = str(tmp_path / "sh.ckpt")
     oracle = stub_sharded_engine(n_devices=2, pack=False).run()
-    r1 = stub_sharded_engine(n_devices=2).run(
+    # bounds=False on the checkpoint chain: the drifted-table check
+    # below pins the DECLARED-widths seam, which the ISSUE 13
+    # reachable-interval tightening would otherwise normalize away
+    r1 = stub_sharded_engine(n_devices=2, bounds=False).run(
         max_states=6, checkpoint_path=ck, checkpoint_every=0.0)
     assert r1.error
-    res = stub_sharded_engine(n_devices=2).run(resume_from=ck)
+    res = stub_sharded_engine(n_devices=2,
+                              bounds=False).run(resume_from=ck)
     assert res.ok and res.distinct_states == oracle.distinct_states
     assert res.levels == oracle.levels
     import jax
@@ -357,7 +367,7 @@ def test_sharded_packed_checkpoint_resume(tmp_path):
     drifted = ShardedBFS(counter_spec(), mesh,
                          model_factory=stub_model_factory(limit=7),
                          tile=4, bucket_cap=64, next_capacity=1 << 6,
-                         fpset_capacity=1 << 8)
+                         fpset_capacity=1 << 8, bounds=False)
     with pytest.raises(TLAError, match="packing spec"):
         drifted.run(resume_from=ck)
 

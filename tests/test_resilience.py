@@ -642,9 +642,8 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 19 scenarios since ISSUE 11 (kill-canon-resume,
-    # kill-spill-resume)
-    assert out["ok"] and len(out["scenarios"]) == 19
+    # 20 scenarios since ISSUE 13 (kill-bounds-resume)
+    assert out["ok"] and len(out["scenarios"]) == 20
 
 
 # ---------------------------------------------------------------------

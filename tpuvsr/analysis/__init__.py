@@ -12,16 +12,21 @@ properties that are provable BEFORE dispatch:
   vacuity   dead actions / vacuous invariants under the cfg (pass 3)
   symmetry  SYMMETRY perms are structural automorphisms (pass 4)
   drift     hand kernel vs lowerer-derived ActionIR divergence (pass 5)
+  bounds    symbolic interval pre-pass (pass 6, ISSUE 13): reachable
+            per-variable intervals, statically dead actions, fanout
+            and state-space upper bounds — FACTS the engines consume
+            (tightened packing, pruned lane tables, exact expansion
+            caps, service admission), not just properties they check
 
 Entry points:
 
 * ``run_lint(spec)`` — full report (CLI ``-lint``,
   scripts/lint_corpus.py);
-* ``preflight(spec)`` — the engine gate: all five passes (the drift
+* ``preflight(spec)`` — the engine gate: all six passes (the drift
   kernel cross-check became cheap once the key tables moved to class
-  attributes), raises ``LintError`` on error-severity findings, caches
-  per spec object, honors ``TPUVSR_LINT=off`` (the CLI's
-  ``-lint=off``).
+  attributes; the bounds fixpoint is pure-AST and cached), raises
+  ``LintError`` on error-severity findings, caches per spec object,
+  honors ``TPUVSR_LINT=off`` (the CLI's ``-lint=off``).
 """
 
 from __future__ import annotations
@@ -38,7 +43,7 @@ __all__ = ["run_lint", "preflight", "lint_enabled", "Finding",
 
 
 def run_lint(spec, passes=None) -> LintReport:
-    """Run the requested passes (default: all five, in canonical
+    """Run the requested passes (default: all six, in canonical
     order) over a bound spec and return the report."""
     report = LintReport(module=spec.module.name)
     for name in (passes if passes is not None else PASS_ORDER):
@@ -55,7 +60,7 @@ def lint_enabled() -> bool:
 def preflight(spec, log=None):
     """Fail-fast gate the engines call before dispatch.
 
-    Runs all five passes (including the kernel drift cross-check) once
+    Runs all six passes (including the kernel drift cross-check) once
     per spec object; raises ``LintError`` if any error-severity finding
     survives.  Returns the report (or None when disabled via
     TPUVSR_LINT=off)."""

@@ -12,9 +12,10 @@ five-pass set runs at every ``run()`` entry (ROADMAP open item, PR 2);
 
 from __future__ import annotations
 
-from . import drift, frames, symmetry, vacuity, widths
+from . import bounds, drift, frames, symmetry, vacuity, widths
 
 PASSES = {m.PASS: m.run for m in (frames, widths, vacuity, symmetry,
-                                  drift)}
-PASS_ORDER = ("frames", "widths", "vacuity", "symmetry", "drift")
+                                  drift, bounds)}
+PASS_ORDER = ("frames", "widths", "vacuity", "symmetry", "drift",
+              "bounds")
 PREFLIGHT_PASSES = PASS_ORDER

@@ -88,6 +88,7 @@ def run(spec, report):
         return
     check_drift(spec, codec, kern, report)
     check_pack_drift(spec, codec, report)
+    check_bounds_drift(spec, codec, report)
 
 
 def check_drift(spec, codec, kern, report):
@@ -211,3 +212,58 @@ def check_pack_drift(spec, codec, report):
                f"packed layout {pk.packed_bytes} B/state "
                f"({pk.ratio:.2f}x vs dense); zero row and {ok} init "
                f"state(s) round-trip exactly")
+
+
+def check_bounds_drift(spec, codec, report):
+    """Bounds-tightened packing drift (ISSUE 13 satellite, extending
+    the PR 9 pack-drift fixture): the widths table, the codec's
+    ``plane_bounds`` and the bounds pass's tightened intervals must
+    agree on ONE layout — a codec width edit that diverges from the
+    shared range table shows up as a tightened round-trip failure
+    here, at lint time, not as a silent wrap inside a ``-bounds on``
+    run.  Checks: every encoded init state round-trips the TIGHTENED
+    packing exactly (the reachable intervals over-approximate
+    reachability, so init states are always inside them)."""
+    import numpy as np
+
+    if not hasattr(codec, "plane_bounds"):
+        return
+    from ...engine.pack import build_pack_spec
+    from .bounds import analyze
+    from .widths import derive_ranges
+    facts = analyze(spec)
+    tighten = facts.plane_tighten()
+    if not tighten:
+        return                      # untightened = pack-drift covered
+    ranges = derive_ranges(spec)
+    try:
+        pk = build_pack_spec(codec, ranges=ranges, tighten=tighten)
+    except TLAError as e:
+        report.add(PASS, SEV_ERROR, spec.module.name,
+                   f"bounds-tightened packing-spec construction "
+                   f"failed ({e}) — the tightened intervals have "
+                   f"drifted from the dense layout")
+        return
+    if pk is None:
+        return
+    bad = []
+    for i, st in enumerate(spec.init_states()):
+        if i >= 64:
+            break
+        row = codec.encode(st)
+        batch = {k: np.asarray(v)[None] for k, v in row.items()}
+        rt = pk.unpack_np(pk.pack_np(batch))
+        bad = sorted(k for k in batch
+                     if not np.array_equal(batch[k], rt[k]))
+        if bad:
+            for k in bad:
+                report.add(PASS, SEV_ERROR, k,
+                           f"init state {i} does not round-trip the "
+                           f"bounds-TIGHTENED packing (plane {k!r}): "
+                           f"the codec layout stores values outside "
+                           f"the reachable interval the bounds pass "
+                           f"derived — width tables have drifted")
+            return
+    report.add(PASS, SEV_INFO, spec.module.name,
+               f"bounds-tightened packing ({pk.total_bits} bits/state "
+               f"vs declared) round-trips every init state exactly")

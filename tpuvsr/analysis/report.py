@@ -51,6 +51,10 @@ class LintReport:
     module: str = ""
     findings: list = field(default_factory=list)
     passes_run: list = field(default_factory=list)
+    # structured per-pass sections beyond findings (ISSUE 13: the
+    # bounds pass attaches its facts under extras["bounds"] so
+    # `-lint -json` surfaces intervals/dead actions/state_bound)
+    extras: dict = field(default_factory=dict)
 
     def add(self, passname, severity, subject, message):
         self.findings.append(Finding(passname, severity, subject, message))
@@ -75,11 +79,13 @@ class LintReport:
         return 0 if self.ok else 1
 
     def to_dict(self):
-        return {"module": self.module, "ok": self.ok,
-                "passes": list(self.passes_run),
-                "errors": len(self.errors),
-                "warnings": len(self.warnings),
-                "findings": [f.to_dict() for f in self.findings]}
+        out = {"module": self.module, "ok": self.ok,
+               "passes": list(self.passes_run),
+               "errors": len(self.errors),
+               "warnings": len(self.warnings),
+               "findings": [f.to_dict() for f in self.findings]}
+        out.update(self.extras)
+        return out
 
     def to_json(self):
         return json.dumps(self.to_dict())

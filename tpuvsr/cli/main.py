@@ -206,7 +206,10 @@ validation tracks concrete states) or a PROPERTY cfg (liveness keeps
 SYMMETRY off — checked after the cfg loads); -spill with
 -engine device/interp/sharded, -fpset host/hbm,
 -simulate/-validate/-supervise (the spill tier is the paged engine's
-host-page store);
+host-page store); -bounds on with -lint=off (tightened facts from an
+unverified spec cannot be trusted), with -engine interp/-fpset host,
+or with -simulate/-validate (the fleet and the validator consume no
+bounds facts — a forced flag must not be silently inert);
 -validate with -simulate/-hunt/-fused/-supervise/-deadlock/
 -maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
 is its own engine mode: rescue checkpoints are preemption-driven, the
@@ -354,6 +357,20 @@ def build_parser():
                         "instead of the hand-written kernel; falls "
                         "back to the hand kernel for modules beyond "
                         "the lowerer's surface")
+    p.add_argument("-bounds", choices=["on", "off"], default=None,
+                   metavar="MODE",
+                   help="speclint bounds pre-pass consumption (default "
+                        "on while the lint gate is live): the symbolic "
+                        "interval analysis (pass 6) tightens the "
+                        "packed-frontier bit budgets to REACHABLE "
+                        "ranges, prunes statically dead actions from "
+                        "the kernel lane tables, and seeds the fused "
+                        "commit's expansion caps from static fanout "
+                        "bounds.  off runs declared-widths packing and "
+                        "full action lists.  Results are bit-identical "
+                        "on/off; snapshots record the facts digest "
+                        "(resuming under a flipped -bounds is a policy "
+                        "error)")
     p.add_argument("-lint", nargs="?", const="full", default=None,
                    choices=["full", "off"], metavar="MODE",
                    help="run the speclint static analyzer and exit "
@@ -504,6 +521,24 @@ def validate_args(parser, args):
                      "frontier is the device engines' interchange "
                      "format; the interpreter has no dense frontier "
                      "to pack)")
+    if args.bounds == "on":
+        if args.lint == "off":
+            parser.error("-bounds on cannot be combined with "
+                         "-lint=off: the tightened packing and pruned "
+                         "action lists consume the speclint bounds "
+                         "pass — an unverified spec's bounds cannot "
+                         "be trusted (drop -lint=off or run "
+                         "-bounds off)")
+        if args.engine == "interp" or args.fpset == "host":
+            parser.error("-bounds on configures the device engines' "
+                         "static pre-pass consumption (tightened "
+                         "packing, pruned lane tables); it cannot be "
+                         "combined with -engine interp/-fpset host")
+        if args.simulate or args.validate is not None:
+            parser.error("-bounds on configures the BFS engines; the "
+                         "fleet and the validator consume no bounds "
+                         "facts (a forced flag must not be silently "
+                         "inert) — drop -bounds on or run BFS mode")
     if args.validate is not None:
         # trace validation is its own engine mode (ISSUE 8): the
         # check/simulate mode switches and their engine shapes don't
@@ -769,6 +804,9 @@ def main(argv=None):
     # symmetry canonicalization (ISSUE 11): on iff declared, unless
     # the flag forces it
     symmetry_kw = {"on": True, "off": False}.get(args.symmetry, "auto")
+    # bounds pre-pass consumption (ISSUE 13): "auto" = on iff the
+    # speclint gate is live (engine/bounds.resolve_bounds)
+    bounds_kw = {"on": True, "off": False}.get(args.bounds, "auto")
     spill_kw = ({"spill_dir": args.spill} if args.spill is not None
                 else {})
 
@@ -905,7 +943,8 @@ def main(argv=None):
                     engine_kwargs={"pipeline": args.pipeline,
                                    "pack": pack_kw,
                                    "commit": commit_kw,
-                                   "symmetry": symmetry_kw})
+                                   "symmetry": symmetry_kw,
+                                   "bounds": bounds_kw})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -932,7 +971,8 @@ def main(argv=None):
                 log(f"sharded mesh: {mesh.shape['d']} devices")
                 eng = ShardedBFS(spec, mesh, pipeline=args.pipeline,
                                  pack=pack_kw, commit=commit_kw,
-                                 symmetry=symmetry_kw)
+                                 symmetry=symmetry_kw,
+                                 bounds=bounds_kw)
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
@@ -955,15 +995,18 @@ def main(argv=None):
                     eng = PagedBFS(spec, retain_levels=True,
                                    pipeline=args.pipeline,
                                    pack=pack_kw, commit=commit_kw,
-                                   symmetry=symmetry_kw)
+                                   symmetry=symmetry_kw,
+                                   bounds=bounds_kw)
                 elif engine == "paged":
                     eng = PagedBFS(spec, pipeline=args.pipeline,
                                    pack=pack_kw, commit=commit_kw,
-                                   symmetry=symmetry_kw, **spill_kw)
+                                   symmetry=symmetry_kw,
+                                   bounds=bounds_kw, **spill_kw)
                 else:
                     eng = DeviceBFS(spec, pipeline=args.pipeline,
                                     pack=pack_kw, commit=commit_kw,
-                                    symmetry=symmetry_kw)
+                                    symmetry=symmetry_kw,
+                                    bounds=bounds_kw)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:

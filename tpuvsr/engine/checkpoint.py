@@ -33,10 +33,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import shutil
+import zipfile
 import zlib
 
 import numpy as np
+from numpy.lib import format as _npformat
 
 FORMAT_VERSION = 3
 
@@ -88,11 +91,56 @@ def _fsync_path(path):
         os.close(fd)
 
 
-def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
+#: chunked frontier payload member name: "<plane>.<chunk index>"
+_CHUNK_RE = re.compile(r"^(.+)\.(\d{6})$")
+
+
+def _write_frontier_chunks(path, blocks):
+    """Stream an iterable of dense plane-dict blocks into one npz
+    (ISSUE 13 satellite — the PR 11 residual): each block is written
+    as it arrives (member ``<plane>.<i:06d>``) and dropped, so a
+    disk-spilled frontier is checkpointed WITHOUT materializing it in
+    RAM.  ``load_checkpoint`` reassembles the chunks transparently.
+    Returns the number of rows written."""
+    rows = 0
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for i, block in enumerate(blocks):
+            n = None
+            for k, v in block.items():
+                arr = np.ascontiguousarray(np.asarray(v))
+                n = arr.shape[0] if n is None else n
+                with zf.open(f"{k}.{i:06d}.npy", "w") as f:
+                    _npformat.write_array(f, arr, allow_pickle=False)
+            rows += int(n or 0)
+    return rows
+
+
+def _assemble_frontier(fr):
+    """Reassemble a frontier payload dict: plain per-plane arrays pass
+    through; chunked members (``<plane>.<i>``) concatenate in chunk
+    order."""
+    if not any(_CHUNK_RE.match(k) for k in fr):
+        return dict(fr)
+    chunks = {}
+    for k, v in fr.items():
+        m = _CHUNK_RE.match(k)
+        if m is None:
+            raise CheckpointCorrupt(
+                f"frontier payload mixes chunked and plain members "
+                f"({k!r})")
+        chunks.setdefault(m.group(1), []).append((int(m.group(2)), v))
+    return {plane: np.concatenate(
+        [v for _i, v in sorted(parts)]) if len(parts) > 1
+        else sorted(parts)[0][1]
+        for plane, parts in chunks.items()}
+
+
+def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
                     elapsed, digest=None, extra=None, pack=None,
-                    canon=None, obs=None):
+                    canon=None, bounds=None, frontier_blocks=None,
+                    obs=None):
     """Write a complete engine snapshot to `path` (atomic + durable).
 
     `frontier` rows beyond `n_front` are dropped; `h_*` are the
@@ -104,7 +152,14 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
     frontier payload itself is ALWAYS dense planes — the interchange
     format any engine/pack configuration can resume — but the manifest
     records the spec version so resuming under a MISMATCHED widths
-    table is a loud policy error (ISSUE 9 satellite)."""
+    table is a loud policy error (ISSUE 9 satellite).
+
+    `frontier_blocks` (ISSUE 13 satellite) replaces `frontier` with an
+    ITERATOR of dense plane-dict blocks: each block is streamed into
+    the staged frontier.npz and released, so a disk-spilled frontier
+    (engine/spill.py) checkpoints at page-sized peak residency instead
+    of materializing `n_front` dense rows.  The chunked payload is
+    read back transparently by ``load_checkpoint``."""
     from ..resilience.faults import fault_point
     tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
@@ -112,9 +167,17 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
     os.makedirs(tmp)
     np.savez_compressed(os.path.join(tmp, "fpset.npz"),
                         slots=np.asarray(slots))
-    np.savez_compressed(
-        os.path.join(tmp, "frontier.npz"),
-        **{k: np.asarray(v)[:n_front] for k, v in frontier.items()})
+    if frontier_blocks is not None:
+        rows = _write_frontier_chunks(
+            os.path.join(tmp, "frontier.npz"), frontier_blocks)
+        if rows != int(n_front):
+            raise ValueError(
+                f"frontier_blocks yielded {rows} rows, n_front is "
+                f"{n_front}")
+    else:
+        np.savez_compressed(
+            os.path.join(tmp, "frontier.npz"),
+            **{k: np.asarray(v)[:n_front] for k, v in frontier.items()})
     np.savez_compressed(os.path.join(tmp, "trace.npz"),
                         parent=h_parent, action=h_action, param=h_param)
     np.savez_compressed(
@@ -148,6 +211,12 @@ def save_checkpoint(path, *, slots, frontier, n_front, h_parent,
         # Resuming under a flipped -symmetry or a changed group is a
         # policy error — the FPSet's fingerprint space would not match
         "canon": canon,
+        # bounds-facts identity (ISSUE 13): digest of the speclint
+        # bounds pass facts the writer consumed (tightened packing +
+        # pruned action ids depend on them), None when bounds off.
+        # Resuming under a flipped -bounds or changed cfg constants
+        # is a policy error, mirroring the pack/canon rules
+        "bounds": bounds,
         # engine-specific payload (e.g. the sharded driver's per-shard
         # frontier counts and exchange capacities)
         "extra": extra,
@@ -263,6 +332,7 @@ def _read_snapshot(path, expect_digest):
                 f"{p}: unreadable payload "
                 f"({type(e).__name__}: {e})")
     n_front = int(manifest["n_front"])
+    arrs["frontier.npz"] = _assemble_frontier(arrs["frontier.npz"])
     for k, v in arrs["frontier.npz"].items():
         if v.shape[0] != n_front:
             raise CheckpointCorrupt(
@@ -317,5 +387,6 @@ def load_checkpoint(path, expect_digest=None, log=None):
         "extra": manifest.get("extra"),
         "pack": manifest.get("pack"),
         "canon": manifest.get("canon"),
+        "bounds": manifest.get("bounds"),
         "restored_from": used,
     }

@@ -130,7 +130,8 @@ class DeviceBFS:
                  fpset_capacity=1 << 20, hash_mode="incremental",
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
                  expand_mults=None, model_factory=None, pipeline=2,
-                 pack="auto", commit="fused", symmetry="auto"):
+                 pack="auto", commit="fused", symmetry="auto",
+                 bounds="auto"):
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
                            f"(got {commit!r})")
@@ -201,6 +202,16 @@ class DeviceBFS:
         # bit-identical either way — the pack/unpack round trip is
         # exact for in-range values, which the widths lint pass proves.
         self._pack_req = pack
+        # speclint bounds pre-pass (ISSUE 13): "auto" consumes the
+        # interval-analysis facts iff the lint gate is live — dead
+        # actions pruned from the kernel lane tables, packing
+        # tightened to reachable intervals, fused expansion caps
+        # seeded from static fanout.  False runs declared widths and
+        # full action lists (the A/B lever); results are bit-identical
+        # either way (tests/test_bounds.py oracles)
+        from .bounds import resolve_bounds
+        self._facts = resolve_bounds(spec, bounds)
+        self._pruned = []
         registry.ensure_compile_cache()
         self.debug_checks = registry.ensure_debug_flags()
         self._build(max_msgs)
@@ -214,6 +225,17 @@ class DeviceBFS:
         spec = self.spec
         self.codec, self.kern = self._model_factory(spec,
                                                     max_msgs=max_msgs)
+        # statically dead actions (bounds pass): drop them from the
+        # kernel's lane tables — the fused commit's guard matrix and
+        # staging queue shrink, and a dead guard is never evaluated.
+        # Dead actions are never enabled, so results are bit-identical
+        if self._facts is not None and self._facts.dead_actions:
+            from .bounds import prune_kernel
+            dead = [n for n in self._facts.dead_actions
+                    if n in self.kern.action_names]
+            if dead and len(dead) < len(self.kern.action_names):
+                self.kern = prune_kernel(self.kern, dead)
+                self._pruned = dead
         names = self.kern.action_names
         if self.expand_mults is None:
             self.expand_mults = [self._expand_mult_default] * len(names)
@@ -232,6 +254,18 @@ class DeviceBFS:
                 # caps onto the observed per-tile maxima
                 self.expand_caps = [min(t, max(8, _align8(self.tile)))
                                     for t in tl]
+                # static fanout bounds (ISSUE 13): the bounds pass
+                # proves at most `fanout` lanes of an action enable
+                # per state, so tile*fanout is a sound initial cap —
+                # on exact-bounds fixtures the growth redraw count is
+                # ZERO (the cap already covers the true maximum)
+                if self._facts is not None:
+                    for a, n in enumerate(names):
+                        fo = self._facts.fanout.get(n)
+                        if fo:
+                            self.expand_caps[a] = min(
+                                tl[a],
+                                max(8, _align8(self.tile * fo)))
             else:
                 # re-clamp after a MAX_MSGS rebuild (lane counts grow)
                 self.expand_caps = [min(t, max(8, int(c)))
@@ -266,13 +300,24 @@ class DeviceBFS:
             self._canon = build_canon_spec(spec, self.codec, self.kern,
                                            self._symmetry_req)
         # packed-frontier spec for THIS codec binding (rebuilt with the
-        # codec on bag growth: MAX_MSGS changes the lane count)
+        # codec on bag growth: MAX_MSGS changes the lane count).
+        # Bounds tightening (ISSUE 13): reachable intervals intersect
+        # the declared plane bounds — fewer bits/state, exact round
+        # trip for every reachable state.  _pk_decl keeps the
+        # untightened spec for the bound_tightening_ratio gauge
         from .pack import build_pack_spec
+        tighten = (self._facts.plane_tighten()
+                   if self._facts is not None else {})
         if self._pack_req is False:
             self._pk = None
+            self._pk_decl = None
         else:
             self._pk = build_pack_spec(self.codec, spec=spec,
-                                       force=self._pack_req is True)
+                                       force=self._pack_req is True,
+                                       tighten=tighten or None)
+            self._pk_decl = (build_pack_spec(
+                self.codec, spec=spec,
+                force=self._pack_req is True) if tighten else self._pk)
         self._level = jax.jit(self._make_level(),
                               donate_argnums=(0, 4, 5, 6, 7))
         self._ml = None         # fused pass, built lazily (run_fused)
@@ -1227,6 +1272,56 @@ class DeviceBFS:
         obs.gauge("frontier_bytes_per_state", int(packed))
         obs.gauge("pack_ratio", round(dense / packed, 3))
 
+    # -- bounds pre-pass consumption (ISSUE 13) ------------------------
+    def _bounds_doc(self):
+        """The run_start journal `bounds` object (None = off)."""
+        return (self._facts.journal_doc()
+                if self._facts is not None else None)
+
+    def _bounds_manifest(self):
+        """Checkpoint manifest record of the consumed facts (None =
+        bounds off): the digest resume compatibility is judged by."""
+        if self._facts is None:
+            return None
+        return {"digest": self._facts.digest,
+                "tightened": self._facts.tightened}
+
+    def _check_bounds_manifest(self, ck, path):
+        """Resume-seam policy (ISSUE 13 satellite): a snapshot records
+        the bounds facts it consumed (tightened packing + pruned lane
+        ids both depend on them); resuming under a flipped ``-bounds``
+        or changed facts is a loud policy error, mirroring the
+        pack/canon rules.  (Changed cfg constants already fail the
+        spec-digest check; this guards the engine-level switch.)"""
+        theirs = (ck.get("bounds") or {}).get("digest")
+        mine = (self._facts.digest if self._facts is not None
+                else None)
+        if theirs != mine:
+            raise TLAError(
+                f"checkpoint {path} was written under bounds facts "
+                f"{theirs or 'off'} but this engine consumes "
+                f"{mine or 'off'}; the tightened packing and pruned "
+                f"action ids are not comparable — resume with the "
+                f"matching -bounds setting (and the same cfg "
+                f"constants)")
+
+    def _bounds_gauges(self, obs):
+        """state_bound / dead_actions / bound_tightening_ratio
+        (ISSUE 13): what the static pre-pass proved and how many
+        pack bits it saved (declared bits / tightened bits; 1.0 when
+        untightened or bounds off)."""
+        if self._facts is None:
+            return
+        f = self._facts
+        if f.state_bound is not None:
+            obs.gauge("state_bound", int(f.state_bound))
+        obs.gauge("dead_actions", len(self._pruned))
+        ratio = 1.0
+        if self._pk is not None and self._pk_decl is not None and \
+                self._pk.total_bits:
+            ratio = self._pk_decl.total_bits / self._pk.total_bits
+        obs.gauge("bound_tightening_ratio", round(ratio, 4))
+
     def _register_init(self, res):
         """Encode, dedup, and FPSet-register the initial states; seed
         the host pointer store and check invariants on them (shared by
@@ -1280,6 +1375,7 @@ class DeviceBFS:
         obs.pack = self._pk is not None
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
+        obs.bounds = self._bounds_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
@@ -1315,6 +1411,7 @@ class DeviceBFS:
                     self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
                 codec = self.codec
+            self._check_bounds_manifest(ck, resume_from)
             self._check_pack_manifest(ck, resume_from)
             self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
@@ -1593,7 +1690,8 @@ class DeviceBFS:
                         elapsed=time.time() - t0,
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
-                        canon=self._canon_manifest(), obs=obs)
+                        canon=self._canon_manifest(),
+                        bounds=self._bounds_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
@@ -1681,6 +1779,7 @@ class DeviceBFS:
         obs.pack = self._pk is not None
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
+        obs.bounds = self._bounds_doc()
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
@@ -1845,7 +1944,8 @@ class DeviceBFS:
                             elapsed=time.time() - t0,
                             digest=spec_digest(spec),
                             pack=self._pack_manifest(),
-                            canon=self._canon_manifest(), obs=obs)
+                            canon=self._canon_manifest(),
+                        bounds=self._bounds_manifest(), obs=obs)
                     last_checkpoint = time.time()
                     obs.checkpoint(checkpoint_path, depth, fp_count)
                     emit(f"checkpoint written to {checkpoint_path} "
@@ -2008,6 +2108,7 @@ class DeviceBFS:
         obs.pack = self._pk is not None
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
+        obs.bounds = self._bounds_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -2230,7 +2331,8 @@ class DeviceBFS:
                                 elapsed=time.time() - t0,
                                 digest=spec_digest(spec),
                                 pack=self._pack_manifest(),
-                                canon=self._canon_manifest(), obs=obs)
+                                canon=self._canon_manifest(),
+                        bounds=self._bounds_manifest(), obs=obs)
                         last_checkpoint = time.time()
                         obs.checkpoint(checkpoint_path, depth, fp_count)
                         emit(f"checkpoint written to {checkpoint_path} "
@@ -2365,6 +2467,7 @@ class DeviceBFS:
         satellite — no more post-hoc res.elapsed patching)."""
         res.distinct_states = fp_count
         self._pack_gauges(obs)
+        self._bounds_gauges(obs)
         # symmetry canonicalization gauges (ISSUE 11): group order
         # this run reduced by (1 = off), and the headline
         # generated/distinct-after-canon ratio — on a symmetry-on run

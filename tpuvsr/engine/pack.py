@@ -284,15 +284,27 @@ class PackSpec:
         return self.unpack_np(np.asarray(row).reshape(-1))
 
 
-def build_pack_spec(codec, spec=None, ranges=None, force=False):
+def build_pack_spec(codec, spec=None, ranges=None, force=False,
+                    tighten=None):
     """Derive the :class:`PackSpec` for a codec binding.
 
     ``ranges`` is the widths-pass field-range table
     (``analysis.passes.widths.derive_ranges``); when absent it is
-    derived from ``spec``.  Codecs that declare no ``plane_bounds``
-    return None (dense is already optimal knowledge-free) unless
-    ``force`` — then every lane keeps 32 bits (ratio 1.0) so the
-    interchange format still exists."""
+    derived from ``spec`` — the ONE declared-range source the lint
+    table, the codecs' ``plane_bounds`` hooks and the bounds pass all
+    read (ISSUE 13 satellite).  Codecs that declare no
+    ``plane_bounds`` return None (dense is already optimal
+    knowledge-free) unless ``force`` — then every lane keeps 32 bits
+    (ratio 1.0) so the interchange format still exists.
+
+    ``tighten`` is the bounds pass's reachable-interval map
+    (``BoundsFacts.plane_tighten()``, ISSUE 13): plane keys matching a
+    tightened state variable have their declared bound INTERSECTED
+    with the reachable interval — fewer bits per lane, and since the
+    intervals over-approximate reachability the round trip stays
+    exact for every reachable state (the bit-identity oracle in
+    tests/test_bounds.py).  Only uniform (or absent) declared bounds
+    tighten; per-column planes keep their declared table."""
     bounds = {}
     if hasattr(codec, "plane_bounds"):
         if ranges is None and spec is not None:
@@ -302,6 +314,20 @@ def build_pack_spec(codec, spec=None, ranges=None, force=False):
     elif not force:
         return None
     zero = codec.zero_state()
+    if tighten:
+        bounds = dict(bounds)
+        for key, (tlo, thi) in tighten.items():
+            if key not in zero:
+                continue                    # not a plane of this codec
+            cur = bounds.get(key)
+            if cur is None:
+                bounds[key] = (int(tlo), int(thi))
+            elif isinstance(cur, tuple) and len(cur) == 2 and \
+                    not isinstance(cur[0], (tuple, list)):
+                lo, hi = max(cur[0], int(tlo)), min(cur[1], int(thi))
+                if lo <= hi:
+                    bounds[key] = (lo, hi)  # reachable ∩ declared
+            # per-column declared tables keep their own budgets
     entries = []
     for key, z in zero.items():
         shape = tuple(np.shape(z))

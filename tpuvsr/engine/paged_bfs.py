@@ -114,6 +114,32 @@ class PagedBFS(DeviceBFS):
             return self._pk.unpack_np(np.asarray(host_front)[:n])
         return {k: np.asarray(v)[:n] for k, v in host_front.items()}
 
+    def _front_dense_blocks(self, tier, n):
+        """Generator of dense plane-dict blocks over a disk-tiered
+        frontier, page by page — the streaming checkpoint writer's
+        input (ISSUE 13 satellite: the PR 11 save_checkpoint residual).
+        Peak residency is ONE page, tracked run-wide on
+        ``_ckpt_peak_rows`` / ``_ckpt_blocks`` (the test assertion
+        hooks)."""
+        self._ckpt_peak_rows = getattr(self, "_ckpt_peak_rows", 0)
+        self._ckpt_blocks = max(getattr(self, "_ckpt_blocks", 0), 0)
+        done = 0
+        for _pos, rows, load in tier._iter_pages():
+            if done >= n:
+                break
+            take = min(rows, n - done)
+            block = load()
+            if take < rows:
+                from .spill import _slice
+                block = _slice(block, 0, take)
+            dense = (self._pk.unpack_np(np.asarray(block))
+                     if self._pk is not None else
+                     {k: np.asarray(v) for k, v in block.items()})
+            done += take
+            self._ckpt_peak_rows = max(self._ckpt_peak_rows, take)
+            self._ckpt_blocks += 1
+            yield dense
+
     # -- host-side helpers ---------------------------------------------
     def _host_zero(self, n):
         if self._pk is not None:
@@ -178,6 +204,7 @@ class PagedBFS(DeviceBFS):
         obs.pack = self._pk is not None
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
+        obs.bounds = self._bounds_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -211,6 +238,7 @@ class PagedBFS(DeviceBFS):
                 if ck["expand_mults"]:
                     self.expand_mults = list(ck["expand_mults"])
                 self._build(ck["max_msgs"])
+            self._check_bounds_manifest(ck, resume_from)
             self._check_pack_manifest(ck, resume_from)
             self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
@@ -560,13 +588,23 @@ class PagedBFS(DeviceBFS):
                     or checkpoint_every is None
                     or time.time() - last_checkpoint >= checkpoint_every):
                 from .checkpoint import save_checkpoint, spec_digest
+                from .spill import SpillTier
+                # disk-tiered frontier: STREAM pages into the staged
+                # npz (peak residency = one page) instead of
+                # materializing n_front dense rows (ISSUE 13 satellite
+                # — the PR 11 save_checkpoint residual)
+                fr_kw = (
+                    {"frontier_blocks":
+                     self._front_dense_blocks(host_front, n_front)}
+                    if isinstance(host_front, SpillTier) else
+                    {"frontier": self._front_dense(host_front,
+                                                   n_front)})
                 with obs.timer("checkpoint"):
                     save_checkpoint(
                         checkpoint_path,
                         slots=table["slots"],
-                        frontier=self._front_dense(host_front,
-                                                   n_front),
                         n_front=n_front,
+                        **fr_kw,
                         h_parent=np.concatenate(self._h_parent),
                         h_action=np.concatenate(self._h_action),
                         h_param=np.concatenate(self._h_param),
@@ -579,7 +617,8 @@ class PagedBFS(DeviceBFS):
                         elapsed=time.time() - t0,
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
-                        canon=self._canon_manifest(), obs=obs)
+                        canon=self._canon_manifest(),
+                        bounds=self._bounds_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "

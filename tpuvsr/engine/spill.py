@@ -31,11 +31,13 @@ Design:
   MAX_MSGS bag-growth re-pack rides it;
 * checkpoints store DENSE planes regardless (the engine-agnostic
   interchange format), so a resume re-packs and re-spills under the
-  resuming run's own budget.  KNOWN LIMIT: writing a snapshot
-  materializes the spilled frontier in RAM (``all_rows`` + dense
-  unpack) — ``save_checkpoint``'s one-npz-per-payload format has no
-  streaming writer yet, so checkpoint cadence on a disk-bound run
-  must fit the dense frontier in host RAM (ROADMAP residual).
+  resuming run's own budget.  Snapshot WRITES stream (ISSUE 13
+  satellite — the PR 11 residual): ``save_checkpoint`` accepts a
+  block iterator (``frontier_blocks``) and the paged engine feeds it
+  the tier's pages one at a time (``PagedBFS._front_dense_blocks``),
+  so peak residency during a checkpoint is one page, not the dense
+  frontier; ``load_checkpoint`` reassembles the chunked payload
+  transparently and a resume re-spills past the RAM budget as before.
 
 The journal records each disk flush as a ``spill`` event with
 ``tier: "disk"`` (device->host RAM drains carry no ``tier`` key), and

@@ -93,6 +93,12 @@ class RunObserver:
         # False when reduction is off, None on engines without the
         # seam — journaled on run_start with key-set parity
         self.symmetry = None
+        # bounds pre-pass facts in effect (ISSUE 13): the compact
+        # {tightened, dead_actions, state_bound} object on the BFS
+        # engines consuming the speclint bounds pass, None when off or
+        # on engines without the seam — journaled on run_start with
+        # key-set parity
+        self.bounds = None
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -164,7 +170,8 @@ class RunObserver:
                            pipeline=int(self.pipeline or 1),
                            pack=bool(self.pack),
                            commit=self.commit,
-                           symmetry=self.symmetry, **extra)
+                           symmetry=self.symmetry,
+                           bounds=self.bounds, **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")

@@ -177,7 +177,12 @@ def convert_sharded_snapshot(path, spec, log=None):
         depth=ck["depth"], fp_count=ck["fp_count"],
         states_generated=ck["states_generated"],
         max_msgs=ck["max_msgs"], expand_mults=[],
-        elapsed=ck["elapsed"], digest=digest, extra=None)
+        elapsed=ck["elapsed"], digest=digest,
+        # the identity manifests ride the conversion unchanged: the
+        # merged fingerprints are still canon/bounds-dependent, and
+        # the resuming engine's policy checks compare against them
+        pack=ck.get("pack"), canon=ck.get("canon"),
+        bounds=ck.get("bounds"), extra=None)
     return True
 
 
@@ -612,7 +617,7 @@ class ShardedBFS:
                  model_factory=None, pipeline=2, exchange_retries=5,
                  exchange_backoff=0.05, exchange_backoff_cap=2.0,
                  sleep=time.sleep, pack="auto", commit="fused",
-                 symmetry="auto"):
+                 symmetry="auto", bounds="auto"):
         from ..core.values import TLAError
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
@@ -673,6 +678,12 @@ class ShardedBFS:
         self.inv_names = list(spec.cfg.invariants)
         self._ckd = bool(check_deadlock)
         self._mat = {}
+        # speclint bounds pre-pass (ISSUE 13): same consumption seam
+        # as DeviceBFS — dead-action pruning, tightened packing, exact
+        # fanout caps; see engine/bounds.resolve_bounds
+        from ..engine.bounds import resolve_bounds
+        self._facts = resolve_bounds(spec, bounds)
+        self._pruned = []
         self._build(max_msgs)
 
     def _build(self, max_msgs):
@@ -683,6 +694,15 @@ class ShardedBFS:
             lambda spec, max_msgs=None: registry.make_model(
                 spec, max_msgs=max_msgs, fold_symmetry=False))
         self.codec, self.kern = factory(self.spec, max_msgs=max_msgs)
+        # statically dead actions (bounds pass): prune the kernel lane
+        # tables before the step builds its guard segments (ISSUE 13)
+        if self._facts is not None and self._facts.dead_actions:
+            from ..engine.bounds import prune_kernel
+            dead = [n for n in self._facts.dead_actions
+                    if n in self.kern.action_names]
+            if dead and len(dead) < len(self.kern.action_names):
+                self.kern = prune_kernel(self.kern, dead)
+                self._pruned = dead
         self._inv = self.kern.invariant_fn(self.inv_names)
         self._mat = {}
         # symmetry canonicalization spec (rebuilt with the codec).
@@ -707,17 +727,32 @@ class ShardedBFS:
         # packed-frontier spec for THIS codec binding (rebuilt with the
         # codec on bag growth — MAX_MSGS changes the lane count)
         from ..engine.pack import build_pack_spec
+        tighten = (self._facts.plane_tighten()
+                   if self._facts is not None else {})
         if self._pack_req is False:
             self._pk = None
+            self._pk_decl = None
         else:
             self._pk = build_pack_spec(self.codec, spec=self.spec,
-                                       force=self._pack_req is True)
+                                       force=self._pack_req is True,
+                                       tighten=tighten or None)
+            self._pk_decl = (build_pack_spec(
+                self.codec, spec=self.spec,
+                force=self._pack_req is True) if tighten else self._pk)
         if self.commit == "fused":
             names = self.kern.action_names
             tl = [self.tile * self.kern._lane_count(n) for n in names]
             if self.expand_caps is None:
                 self.expand_caps = [min(t, max(8, self.tile))
                                     for t in tl]
+                # static fanout bounds seed the caps (ISSUE 13): zero
+                # growth redraws on exact-bounds fixtures
+                if self._facts is not None:
+                    for a, n in enumerate(names):
+                        fo = self._facts.fanout.get(n)
+                        if fo:
+                            self.expand_caps[a] = min(
+                                tl[a], max(8, self.tile * fo))
             else:   # re-clamp after a MAX_MSGS rebuild (lanes grow)
                 self.expand_caps = [min(t, max(8, int(c)))
                                     for t, c in zip(tl,
@@ -753,6 +788,10 @@ class ShardedBFS:
     _canon_manifest = _DB._canon_manifest
     _check_canon_manifest = _DB._check_canon_manifest
     _symmetry_on = _DB._symmetry_on
+    _bounds_doc = _DB._bounds_doc
+    _bounds_manifest = _DB._bounds_manifest
+    _check_bounds_manifest = _DB._check_bounds_manifest
+    _bounds_gauges = _DB._bounds_gauges
 
     def _flush_pointers(self):
         """No-op: the sharded driver's pointer pulls are synchronous
@@ -818,6 +857,7 @@ class ShardedBFS:
         obs.pack = self._pk is not None
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
+        obs.bounds = self._bounds_doc()
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
@@ -899,6 +939,7 @@ class ShardedBFS:
             # the lane count, so a snapshot from a grown-bag run only
             # matches the spec rebuilt at ITS MAX_MSGS (DeviceBFS
             # orders these the same way)
+            self._check_bounds_manifest(ck, resume_from)
             self._check_pack_manifest(ck, resume_from)
             self._check_canon_manifest(ck, resume_from)
             rows = ck["frontier"]
@@ -1440,7 +1481,8 @@ class ShardedBFS:
                         elapsed=_time.time() - t0,
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
-                        canon=self._canon_manifest(), obs=obs,
+                        canon=self._canon_manifest(),
+                        bounds=self._bounds_manifest(), obs=obs,
                         extra={"sharded": True,
                                "shard_counts": [int(x) for x in nn_h],
                                "bucket_cap": self.bucket_cap,
@@ -1489,6 +1531,7 @@ class ShardedBFS:
         return self._finish(res, obs, fp_count)
 
     def _finish(self, res, obs, fp_count):
+        self._bounds_gauges(obs)
         res.distinct_states = fp_count
         self._pack_gauges(obs)
         obs.gauge("symmetry_perms",

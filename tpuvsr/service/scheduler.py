@@ -204,6 +204,24 @@ class Scheduler:
 #: below this many states a run is compile-dominated on an accelerator
 SMALL_JOB_STATES = 50_000
 
+#: CAPACITY.md tier math: ~16 GB of HBM holds ~8e8 fingerprint slots
+#: (16 B/state, the device_bfs scale note) — the per-device distinct-
+#: state capacity the admission gate prices a requested tier at.
+#: Jobs carrying an explicit ``flags.tier_states`` override it.
+TIER_STATES_PER_DEVICE = 800_000_000
+
+
+def tier_states_for(job):
+    """Distinct-state capacity of the tier a job requested:
+    ``flags.tier_states`` when explicit, else requested devices x the
+    CAPACITY.md per-device FPSet price.  The bounds-pass admission
+    gate (worker._admit_one, ISSUE 13) rejects jobs whose static
+    ``state_bound`` provably exceeds it — before any device time."""
+    t = job.flags.get("tier_states")
+    if t is not None:
+        return int(t)
+    return max(1, int(job.devices or 1)) * TIER_STATES_PER_DEVICE
+
 
 def _doc_throughput(doc):
     """distinct_per_s of one bench/metrics document — the same lookup

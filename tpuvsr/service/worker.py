@@ -223,9 +223,45 @@ class Worker:
                 self.log(f"job {job.job_id} rejected by speclint "
                          f"({len(findings)} error(s))")
                 return
+            if self._reject_oversized(job, spec):
+                return
         self._specs[job.job_id] = spec
         self.queue.transition(job.job_id, "admitted")
         self._journal(job, "job_admitted")
+
+    def _reject_oversized(self, job, spec):
+        """Bounds-pass admission gate (ISSUE 13): a check job whose
+        static state-space upper bound provably exceeds the requested
+        tier's capacity (``scheduler.tier_states_for``) is rejected —
+        with the minimum tier that WOULD fit as the re-advisory —
+        before any device time.  Returns True when the job was
+        finished (rejected)."""
+        if job.kind != "check":
+            return False
+        try:
+            from ..analysis.passes.bounds import analyze
+            facts = analyze(spec)
+        except Exception:  # noqa: BLE001 — advisory gate, never fatal
+            return False
+        if facts.state_bound is None:
+            return False
+        from .scheduler import TIER_STATES_PER_DEVICE, tier_states_for
+        cap = tier_states_for(job)
+        if facts.state_bound <= cap:
+            return False
+        advised = -(-facts.state_bound // TIER_STATES_PER_DEVICE)
+        self.queue.finish(
+            job.job_id, "failed", reason="bounds-admission",
+            result={"state_bound": int(facts.state_bound),
+                    "tier_states": int(cap),
+                    "advised_devices": int(advised)})
+        self._journal(job, "job_done", state="failed",
+                      reason="bounds-admission")
+        self.log(f"job {job.job_id} rejected at admission: static "
+                 f"state bound {facts.state_bound} exceeds the "
+                 f"requested tier's {cap} states (re-advise: "
+                 f">= {advised} device(s) or a paged/spill tier)")
+        return True
 
     # -- the level-boundary tick ---------------------------------------
     def _tick(self, job, depth):

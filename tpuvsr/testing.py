@@ -53,7 +53,19 @@ STUB_DISTINCT = 16
 STUB_LEVELS = [1, 2, 3, 4, 3, 2, 1]
 
 
-def counter_spec(inv_bound=None, inv_x_bound=None):
+#: the dead-action fixture text (ISSUE 13): `Limit > 5` folds FALSE
+#: under the cfg's Limit = 3, so Jump can never fire — the bounds
+#: pass proves it dead and the engines prune it from the lane tables
+DEAD_ACTION = """Jump ==
+    /\\ Limit > 5
+    /\\ x' = x + 2
+    /\\ UNCHANGED y
+
+"""
+
+
+def counter_spec(inv_bound=None, inv_x_bound=None, dead_action=False,
+                 nonlinear_guard=False, limit=None):
     """The inline two-counter spec (16 states, diameter 6).
 
     With ``inv_bound`` the Bound invariant tightens to
@@ -67,7 +79,16 @@ def counter_spec(inv_bound=None, inv_x_bound=None):
     ``(inv_x_bound + 1, 0)``, which is the only violation at its BFS
     level and has exactly one parent/action, so every engine on every
     mesh size must surface the bit-identical counterexample trace
-    (the elastic-resume trace oracle, ISSUE 5)."""
+    (the elastic-resume trace oracle, ISSUE 5).
+
+    ``dead_action`` adds a Jump action whose guard constant-folds to
+    FALSE under the cfg (the ISSUE 13 dead-action-pruning fixture;
+    pair with ``stub_model_factory(dead_action=True)``).
+    ``nonlinear_guard`` makes IncX's guard ``x * x < Limit`` — outside
+    the bounds pass's interval domain, so tightening must be REFUSED
+    (bounds{tightened:false}); note it also shrinks the reachable
+    space (x stops at 2 under Limit = 3).  ``limit`` overrides the
+    cfg's Limit binding."""
     src = COUNTER
     if inv_x_bound is not None:
         src = src.replace("Bound == x + y <= 2 * Limit",
@@ -75,16 +96,28 @@ def counter_spec(inv_bound=None, inv_x_bound=None):
     elif inv_bound is not None:
         src = src.replace("Bound == x + y <= 2 * Limit",
                           f"Bound == x + y <= {int(inv_bound)}")
-    return SpecModel(parse_module_text(src),
-                     parse_cfg_text(COUNTER_CFG))
+    if nonlinear_guard:
+        src = src.replace("/\\ x < Limit", "/\\ x * x < Limit")
+    if dead_action:
+        src = src.replace("Next == IncX \\/ IncY",
+                          DEAD_ACTION + "Next == IncX \\/ IncY \\/ Jump")
+    cfg = COUNTER_CFG
+    if limit is not None:
+        cfg = cfg.replace("Limit = 3", f"Limit = {int(limit)}")
+    return SpecModel(parse_module_text(src), parse_cfg_text(cfg))
 
 
-def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
+def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None,
+                       dead_action=False):
     """A ``model_factory`` producing a (codec, kernel) pair for the
     counter spec — drives the real device engines with no reference
     kernel registered.  ``inv_bound``/``inv_x_bound`` mirror
     ``counter_spec``'s tightened invariants (the kernel and the
-    interpreter must agree on what violates)."""
+    interpreter must agree on what violates).  ``dead_action`` adds
+    the Jump lane matching ``counter_spec(dead_action=True)`` — its
+    guard is always false, so a bounds-on engine prunes it and a
+    bounds-off engine carries the dead lane (bit-identical results;
+    the ISSUE 13 pruning fixture)."""
     import jax
     import jax.numpy as jnp
 
@@ -120,15 +153,22 @@ def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
             return batch
 
     class StubKern:
-        action_names = ["IncX", "IncY"]
-        n_lanes = 2
+        action_names = (["IncX", "IncY", "Jump"] if dead_action
+                        else ["IncX", "IncY"])
+        n_lanes = 3 if dead_action else 2
 
         def _lane_count(self, name):
             return 1
 
         def _guard_fns(self):
-            return [lambda st, ln: st["x"] < limit,
-                    lambda st, ln: st["y"] < limit]
+            fns = [lambda st, ln: st["x"] < limit,
+                   lambda st, ln: st["y"] < limit]
+            if dead_action:
+                # the Jump guard constant-folds to FALSE in the spec
+                # (Limit > 5 under Limit = 3); the kernel mirrors it
+                fns.append(lambda st, ln: (st["x"] < limit)
+                           & jnp.asarray(False))
+            return fns
 
         def _action_fns(self):
             def incx(st, ln):
@@ -140,10 +180,18 @@ def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
                 succ = {"status": st["status"], "x": st["x"],
                         "y": st["y"] + 1, "err": jnp.int32(0)}
                 return succ, st["y"] < limit
-            return [incx, incy]
 
-        lane_action = np.array([0, 1], np.int32)
-        lane_param = np.array([0, 0], np.int32)
+            def jump(st, ln):
+                succ = {"status": st["status"], "x": st["x"] + 2,
+                        "y": st["y"], "err": jnp.int32(0)}
+                return succ, (st["x"] < limit) & jnp.asarray(False)
+            return ([incx, incy, jump] if dead_action
+                    else [incx, incy])
+
+        lane_action = (np.array([0, 1, 2], np.int32) if dead_action
+                       else np.array([0, 1], np.int32))
+        lane_param = (np.array([0, 0, 0], np.int32) if dead_action
+                      else np.array([0, 0], np.int32))
 
         def step_all(self, st):
             succs, ens = [], []
@@ -181,15 +229,20 @@ def stub_model_factory(limit=3, inv_bound=None, inv_x_bound=None):
     return lambda spec, max_msgs=None: (StubCodec(), StubKern())
 
 
-def stub_device_engine(cls=None, spec=None, inv_bound=None, **kw):
+def stub_device_engine(cls=None, spec=None, inv_bound=None,
+                       dead_action=False, **kw):
     """A small DeviceBFS (or `cls`) instance over the counter spec and
     the stub kernel — the standard harness for engine-loop tests.
     Extra keywords (``pipeline=...``, ``chunk_tiles=...``) reach the
-    engine constructor."""
+    engine constructor; ``dead_action`` builds the ISSUE 13
+    dead-action fixture (spec + kernel both carry the never-enabled
+    Jump)."""
     from .engine.device_bfs import DeviceBFS
     cls = cls or DeviceBFS
-    return cls(spec or counter_spec(inv_bound),
-               model_factory=stub_model_factory(inv_bound=inv_bound),
+    return cls(spec or counter_spec(inv_bound,
+                                    dead_action=dead_action),
+               model_factory=stub_model_factory(
+                   inv_bound=inv_bound, dead_action=dead_action),
                hash_mode="full", tile_size=kw.pop("tile_size", 4),
                fpset_capacity=kw.pop("fpset_capacity", 1 << 8),
                next_capacity=kw.pop("next_capacity", 1 << 6), **kw)
