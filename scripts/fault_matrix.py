@@ -774,6 +774,89 @@ def scenario_service_oom_degrade(tmp):
     }
 
 
+#: the kill-one-of-N dead worker: claims ONE job off the spool and
+#: SIGKILLs itself (no rescue, no atexit — a genuinely dead process)
+#: at the depth-2 tick, after the level-1 checkpoint has landed (the
+#: unique-witness violation itself lands at depth 3 — the kill must
+#: precede it)
+_DOOMED_WORKER = """\
+import os, signal, sys
+from tpuvsr.service.queue import JobQueue
+from tpuvsr.service.worker import Worker
+
+def on_level(worker, job, depth):
+    if depth >= 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+Worker(JobQueue(sys.argv[1]), devices=1, owner="wA",
+       on_level=on_level, light_threads=0).drain(max_jobs=1)
+"""
+
+
+def scenario_kill_one_of_n_workers(tmp):
+    """ISSUE 14: N workers share one spool; one is SIGKILLed mid-job
+    (dead pid, claim file left, per-level checkpoints on disk).  The
+    SURVIVOR's ordinary drain loop recovers the stale claim — the
+    worker-id/host-aware liveness judgment — requeues the job WITH
+    the rescue snapshot, resumes it, and reports the violation with a
+    trace BIT-IDENTICAL to an uninterrupted oracle.  The survivor
+    also drains the dead worker's unclaimed backlog."""
+    import subprocess
+    from tpuvsr.engine.device_bfs import DeviceBFS
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker, result_summary
+    from tpuvsr.testing import (counter_spec, stub_model_factory,
+                                subprocess_env)
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool)
+    doomed = q.submit("<stub:doomed>", engine="device",
+                      flags={"stub": True, "inv_x_bound": 2})
+    other = q.submit("<stub:other>", engine="device",
+                     flags={"stub": True})
+    p = subprocess.run(
+        [sys.executable, "-c", _DOOMED_WORKER, spool],
+        env=subprocess_env(), capture_output=True, text=True,
+        timeout=300)
+    killed = p.returncode in (-9, 137)
+    claim_left = os.path.exists(
+        os.path.join(q.claims_dir, f"{doomed.job_id}.claim"))
+    # the survivor: recover_stale runs inside its ordinary drain loop
+    Worker(q, devices=1, owner="wB", light_threads=0).drain()
+    jd, jo = q.get(doomed.job_id), q.get(other.job_id)
+    evs = read_journal(q.journal_path(doomed.job_id))
+    req = [e for e in evs if e["event"] == "job_requeued"]
+    workers = [e["worker"] for e in evs
+               if e["event"] == "sched_decision"]
+    oracle = result_summary(
+        DeviceBFS(counter_spec(inv_x_bound=2),
+                  model_factory=stub_model_factory(inv_x_bound=2),
+                  hash_mode="full", tile_size=4,
+                  fpset_capacity=1 << 8, next_capacity=1 << 6).run())
+    ok = (killed and claim_left
+          and jd.state == "violated" and jd.attempts == 2
+          and len(req) == 1 and "worker-died" in req[0]["reason"]
+          and (req[0].get("rescue") or {}).get("depth", 0) >= 1
+          and jd.result["violated"] == oracle["violated"] == "Bound"
+          and jd.result["trace"] == oracle["trace"]
+          and jd.result["distinct"] == oracle["distinct"]
+          and jo.state == "done"
+          and jo.result["distinct"] == _oracle()["distinct"]
+          and workers == ["wA", "wB"])
+    return {
+        "ok": ok, "killed_rc": p.returncode,
+        "claim_left_behind": claim_left,
+        "doomed": {"state": jd.state, "attempts": jd.attempts,
+                   "requeue_reason": req[0]["reason"] if req else None,
+                   "rescue_depth": (req[0].get("rescue") or {}).get(
+                       "depth") if req else None,
+                   "trace_identical": (jd.result or {}).get("trace")
+                   == oracle["trace"]},
+        "survivor_finished_backlog": jo.state,
+        "workers_seen": workers,
+    }
+
+
 def scenario_sim_oom_shrink(tmp):
     """Injected OOM inside a fleet chunk (ISSUE 7): the fleet's own
     degrade ladder halves the walker count, journals
@@ -914,6 +997,7 @@ SCENARIOS = [
     ("pipeline-faults", scenario_pipeline_faults),
     ("service-preempt-requeue", scenario_service_preempt_requeue),
     ("service-oom-degrade", scenario_service_oom_degrade),
+    ("kill-one-of-n-workers", scenario_kill_one_of_n_workers),
     ("sim-oom-shrink", scenario_sim_oom_shrink),
     ("kill-hunt-resume", scenario_kill_hunt_resume),
     ("kill-validate-resume", scenario_kill_validate_resume),

@@ -642,8 +642,8 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 20 scenarios since ISSUE 13 (kill-bounds-resume)
-    assert out["ok"] and len(out["scenarios"]) == 20
+    # 21 scenarios since ISSUE 14 (kill-one-of-n-workers)
+    assert out["ok"] and len(out["scenarios"]) == 21
 
 
 # ---------------------------------------------------------------------

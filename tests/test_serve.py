@@ -1,0 +1,543 @@
+"""Serving-tier tests (ISSUE 14): fair-share scheduling (DRR +
+priority aging + tenant quotas), the multi-runner light-job lane,
+worker-id/heartbeat claim hardening, the worker pool, and the HTTP
+front (submit -> streamed status -> cancel over the wire vs the CLI
+verbs).
+
+Everything here is tier-1; all but the HTTP streaming test avoid jax
+entirely (shell jobs, the interpreter validator, and pure-python
+policy units), so this file stays cheap.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tpuvsr.exitcodes import (EX_OK, EX_SOFTWARE, EX_USAGE,
+                              EX_VIOLATION, STATE_EXIT, state_exit)
+from tpuvsr.obs import read_journal
+from tpuvsr.serve import (FairSharePolicy, ServiceHTTP, TenantLedger,
+                          WorkerPool, is_light)
+from tpuvsr.service import CLAIMABLE, Job, JobQueue, Scheduler, Worker
+from tpuvsr.service.queue import HOSTNAME
+
+from tpuvsr.testing import true_argv
+
+TRUE_ARGV = true_argv()
+
+
+def _shell(q, name, tenant=None, priority=0, argv=None, **flags):
+    return q.submit(name, kind="shell", tenant=tenant,
+                    priority=priority,
+                    flags={"argv": argv or TRUE_ARGV, "timeout": 60,
+                           **flags})
+
+
+# ---------------------------------------------------------------------
+# fair-share policy units (pure python)
+# ---------------------------------------------------------------------
+def _job(spec, tenant=None, priority=0, seq=0, devices=1,
+         submitted=0.0):
+    return Job(job_id=spec, spec=spec, tenant=tenant,
+               priority=priority, seq=seq, devices=devices,
+               state="admitted", submitted_ts=submitted)
+
+
+def test_drr_interleaves_tenants_and_honors_weights():
+    clock = lambda: 100.0                       # noqa: E731
+    p = FairSharePolicy(age_every=0, clock=clock)
+    jobs = [_job(f"{t}{i}", tenant=t, seq=i * 3 + k, submitted=100.0)
+            for i in range(3)
+            for k, t in enumerate(("a", "b", "c"))]
+    order = [j.tenant for j in p.order(jobs)]
+    # equal weights: one pop per tenant per round — perfect interleave
+    assert order == ["a", "b", "c"] * 3
+    # weight 2 doubles tenant b's share per round
+    p2 = FairSharePolicy(weights={"b": 2.0}, age_every=0, clock=clock)
+    order2 = [j.spec for j in p2.order(jobs)]
+    assert order2[:4] == ["a0", "b0", "b1", "c0"]
+    # a fat job costs its devices: it must bank more rounds of credit
+    p3 = FairSharePolicy(age_every=0, clock=clock)
+    fat = _job("fat", tenant="a", seq=0, devices=3, submitted=100.0)
+    thin = [_job(f"t{i}", tenant="b", seq=i + 1, submitted=100.0)
+            for i in range(3)]
+    assert [j.spec for j in p3.order([fat] + thin)] == \
+        ["t0", "t1", "fat", "t2"]
+
+
+def test_priority_aging_bounds_wait():
+    now = {"t": 1000.0}
+    p = FairSharePolicy(age_every=10.0, clock=lambda: now["t"])
+    old_lo = _job("lo", priority=0, seq=0, submitted=1000.0)
+    # aging bound: a priority-0 job outranks FRESH priority-3 jobs
+    # after at most age_every * (3 - 0 + 1) seconds
+    bound = p.max_wait_bound(0, 3)
+    assert bound == 40.0
+    now["t"] = 1000.0 + bound - 11.0
+    hi = _job("hi", priority=3, seq=99, submitted=now["t"])
+    assert [j.spec for j in p.order([old_lo, hi])] == ["hi", "lo"]
+    now["t"] = 1000.0 + bound
+    hi2 = _job("hi2", priority=3, seq=100, submitted=now["t"])
+    assert [j.spec for j in p.order([old_lo, hi2])] == ["lo", "hi2"]
+    # within one tenant the aged priority also orders the backlog
+    assert p.effective_priority(old_lo, now["t"]) == 4
+
+
+def test_fairshare_no_starvation_under_flood():
+    """The ROADMAP item 2 failure mode: tenant A floods high-priority
+    jobs forever; tenant B's single priority-0 job must still pop
+    within B's fair share — FIRST round, not after the flood."""
+    clock = lambda: 0.0                          # noqa: E731
+    p = FairSharePolicy(age_every=0, clock=clock)
+    flood = [_job(f"a{i}", tenant="a", priority=9, seq=i)
+             for i in range(50)]
+    lone = _job("b0", tenant="b", priority=0, seq=50)
+    order = [j.spec for j in p.order(flood + [lone])]
+    assert order.index("b0") <= 1
+
+
+def test_tenant_ledger_fold():
+    jobs = [_job("a0", tenant="a"), _job("a1", tenant="a")]
+    jobs[0].state = "done"
+    jobs[0].result = {"elapsed_s": 2.5}
+    jobs += [_job("anon")]
+    led = TenantLedger.fold(jobs)
+    assert led["a"]["done"] == 1 and led["a"]["queued"] == 1
+    assert led["a"]["service_s"] == 2.5
+    assert led["-"]["jobs"] == 1
+
+
+def test_scheduler_uses_aged_priorities():
+    from tpuvsr.service import DevicePool
+    now = {"t": 0.0}
+    p = FairSharePolicy(age_every=1.0, clock=lambda: now["t"])
+    pool = DevicePool(4)
+    s = Scheduler(pool, policy=p)
+    running = _job("run", priority=5, seq=0, submitted=0.0)
+    running.state = "running"
+    pool.alloc("run", 4)
+    waiting = _job("wait", priority=0, seq=1, devices=4, submitted=0.0)
+    assert s.rebalance(running, [running, waiting]) is None
+    # after enough waiting the priority-0 job outranks the running 5
+    now["t"] = 100.0
+    running.submitted_ts = 99.0                 # running stays fresh
+    dec = s.rebalance(running, [running, waiting])
+    assert dec is not None and dec.action == "yield"
+
+
+# ---------------------------------------------------------------------
+# queue hardening: worker-id + heartbeat claims (satellite)
+# ---------------------------------------------------------------------
+def test_claim_file_records_worker_and_host(tmp_path):
+    q = JobQueue(str(tmp_path / "spool"))
+    j = _shell(q, "sh")
+    q.transition(j.job_id, "admitted")
+    assert q.claim(j.job_id, owner="w7") is not None
+    with open(os.path.join(q.claims_dir, f"{j.job_id}.claim")) as f:
+        info = json.load(f)
+    assert info["owner"] == "w7" and info["host"] == HOSTNAME
+    assert info["pid"] == os.getpid()
+
+
+def test_heartbeat_touches_claim_mtime(tmp_path):
+    q = JobQueue(str(tmp_path / "spool"))
+    j = _shell(q, "sh")
+    q.transition(j.job_id, "admitted")
+    q.claim(j.job_id)
+    path = os.path.join(q.claims_dir, f"{j.job_id}.claim")
+    os.utime(path, times=(1.0, 1.0))
+    assert q.heartbeat(j.job_id)
+    assert os.path.getmtime(path) > 1.0
+    q.release(j.job_id)
+    assert not q.heartbeat(j.job_id)            # claim gone: False
+
+
+def test_recover_stale_cross_host_claims(tmp_path):
+    """The single-host-pid bug (ISSUE 14 satellite): a claim from
+    ANOTHER host must be judged by its heartbeat mtime, never by a
+    pid check that is meaningless here.  Fresh heartbeat = live (even
+    though the pid is dead locally); stale heartbeat = recoverable."""
+    q = JobQueue(str(tmp_path / "spool"), heartbeat_timeout=60.0)
+    for name in ("fresh", "stale", "local-dead"):
+        j = q.submit(name)
+        q.transition(j.job_id, "admitted")
+        q.transition(j.job_id, "running", attempts=1)
+    fresh, stale, local = q.jobs()
+    dead_pid = 2 ** 22 + 12345                  # no such pid locally
+
+    def put_claim(job, host, mtime=None):
+        path = os.path.join(q.claims_dir, f"{job.job_id}.claim")
+        with open(path, "w") as f:
+            json.dump({"pid": dead_pid, "owner": "w-far",
+                       "host": host, "ts": time.time()}, f)
+        if mtime is not None:
+            os.utime(path, times=(mtime, mtime))
+
+    put_claim(fresh, "other-host")                      # fresh mtime
+    put_claim(stale, "other-host", mtime=time.time() - 3600)
+    put_claim(local, HOSTNAME)                  # dead pid, THIS host
+    recovered = q.recover_stale()
+    # the live cross-host worker keeps its job; the stale one and the
+    # locally-dead one are requeued (local pid death needs NO wait)
+    assert set(recovered) == {stale.job_id, local.job_id}
+    assert q.get(fresh.job_id).state == "running"
+    assert q.get(stale.job_id).state == "preempted-requeued"
+    assert q.get(local.job_id).state == "preempted-requeued"
+
+
+# ---------------------------------------------------------------------
+# multi-runner: light jobs beside the mesh (tentpole a)
+# ---------------------------------------------------------------------
+def test_is_light_classification():
+    assert is_light(Job(job_id="s", spec="s", kind="shell"))
+    assert is_light(Job(job_id="v", spec="v", kind="validate",
+                        flags={"interp": True, "traces": "t"}))
+    assert not is_light(Job(job_id="v2", spec="v", kind="validate",
+                            flags={"traces": "t"}))
+    assert is_light(Job(job_id="c", spec="c", kind="check",
+                        flags={"lint_only": True}))
+    assert not is_light(Job(job_id="c2", spec="c", kind="check"))
+    assert not is_light(Job(job_id="m", spec="m", kind="sim"))
+
+
+def test_multirunner_drains_light_jobs_with_zero_devices(tmp_path):
+    """Shell + lint-only + interp-validate jobs drain through the
+    thread-pool lane: all complete, every ``job_started`` records a
+    zero-device allocation, and the deterministic divergence of the
+    mutated trace survives the lane (host-validator verdict)."""
+    from tpuvsr.testing import stub_trace_records
+    from tpuvsr.validate import save_traces
+    q = JobQueue(str(tmp_path / "spool"))
+    shells = [_shell(q, f"sh{i}", tenant=f"t{i % 2}")
+              for i in range(4)]
+    lint = q.submit("<stub:lint>", tenant="t0",
+                    flags={"stub": True, "lint_only": True})
+    tp = str(tmp_path / "TRACE.jsonl")
+    save_traces(tp, stub_trace_records(n=4, depth=5, mutate=(1, 2)))
+    val = q.submit("<stub:val>", kind="validate", tenant="t1",
+                   flags={"stub": True, "traces": tp, "interp": True})
+    w = Worker(q, devices=1, light_threads=3)
+    w.drain()
+    for j in q.jobs():
+        if j.job_id == val.job_id:
+            assert j.state == "violated"
+        else:
+            assert j.state == "done", (j.spec, j.state, j.reason)
+    # divergence localized at the exact mutated step, through the lane
+    res = q.get(val.job_id).result
+    assert res["divergences"][0]["trace"] == "t-0001"
+    assert res["divergences"][0]["step"] == 2
+    assert q.get(lint.job_id).result["errors"] == 0
+    for j in (shells[0], lint, val):
+        started = [e for e in read_journal(q.journal_path(j.job_id))
+                   if e["event"] == "job_started"]
+        assert [e["devices"] for e in started] == [0]
+
+
+def test_sched_decision_journaled_per_pop(tmp_path):
+    q = JobQueue(str(tmp_path / "spool"))
+    a = _shell(q, "a", tenant="acme")
+    b = _shell(q, "b", tenant="blue")
+    Worker(q, devices=1, light_threads=0).drain()
+    for j, tenant in ((a, "acme"), (b, "blue")):
+        evs = read_journal(q.journal_path(j.job_id))  # schema-valid
+        decs = [e for e in evs if e["event"] == "sched_decision"]
+        assert len(decs) == 1
+        d = decs[0]
+        assert d["tenant"] == tenant and d["policy"] == "drr"
+        assert "aged_priority" in d and "deficit" in d \
+            and "waited_s" in d
+        # the decision lands before the run starts
+        kinds = [e["event"] for e in evs]
+        assert kinds.index("sched_decision") < \
+            kinds.index("job_started")
+
+
+def test_worker_policy_none_keeps_legacy_order(tmp_path):
+    q = JobQueue(str(tmp_path / "spool"))
+    lo = _shell(q, "lo", priority=0)
+    hi = _shell(q, "hi", priority=9)
+    w = Worker(q, devices=1, policy=None, light_threads=0)
+    w.drain()
+    assert [x[0] for x in w.processed] == [hi.job_id, lo.job_id]
+    assert "sched_decision" not in [
+        e["event"] for e in read_journal(q.journal_path(hi.job_id))]
+
+
+def test_heartbeat_thread_covers_held_claims(tmp_path):
+    """A claim this worker HOLDS must heartbeat even while the job
+    does nothing tick-shaped (a mesh job mid-compile, a long light
+    run) — otherwise a cross-host recover_stale would steal it.  The
+    worker's background thread touches every held claim on a cadence;
+    ``_hold``/``_release_hold`` bracket the claim lifetime."""
+    q = JobQueue(str(tmp_path / "spool"), heartbeat_timeout=5.0)
+    j = _shell(q, "held")
+    q.transition(j.job_id, "admitted")
+    assert q.claim(j.job_id, owner="w-hb") is not None
+    w = Worker(q, devices=1)
+    path = os.path.join(q.claims_dir, f"{j.job_id}.claim")
+    old = os.path.getmtime(path) - 100
+    os.utime(path, times=(old, old))
+    w._hold(j.job_id)              # hb interval = timeout/10 = 0.5s
+    try:
+        deadline = time.time() + 5
+        while os.path.getmtime(path) <= old and time.time() < deadline:
+            time.sleep(0.05)
+        assert os.path.getmtime(path) > old     # thread re-touched it
+        # released claims stop heartbeating
+        w._release_hold(j.job_id)
+        os.utime(path, times=(old, old))
+        time.sleep(1.2)
+        assert os.path.getmtime(path) == old
+    finally:
+        w._hb_stop.set()
+        if w._hb_thread is not None:
+            w._hb_thread.join(5)
+
+
+def test_light_claims_backpressure_when_lane_full(tmp_path):
+    """With the lane saturated, the drain loop must NOT keep claiming
+    light jobs (they would queue un-started behind our threads,
+    invisible to pool siblings) — order filters them out until a
+    thread frees up, and a sibling can take them meanwhile."""
+    q = JobQueue(str(tmp_path / "spool"))
+    _shell(q, "hog", argv=[sys.executable, "-c",
+                           "import time; time.sleep(1.5)"])
+    late = [_shell(q, f"late{i}", argv=TRUE_ARGV) for i in range(4)]
+    w = Worker(q, devices=1, light_threads=1)
+    t = threading.Thread(target=w.drain)
+    t.start()
+    try:
+        # while the hog occupies the single thread, the late jobs must
+        # remain CLAIMABLE (admitted), not parked in w's backlog
+        time.sleep(0.7)
+        q.refresh()
+        states = {q.get(j.job_id).state for j in late}
+        sibling_view = JobQueue(str(tmp_path / "spool"))
+        assert states == {"admitted"}, states
+        # a sibling worker can claim one right now
+        got = sibling_view.claim_next(owner="sibling")
+        assert got is not None
+        sibling_view.finish(got.job_id, "done")
+    finally:
+        t.join(60)
+    assert not t.is_alive()
+    q.refresh()
+    assert all(q.get(j.job_id).state == "done" for j in late)
+
+
+# ---------------------------------------------------------------------
+# worker pool: N processes over one spool (tentpole a)
+# ---------------------------------------------------------------------
+def test_worker_pool_two_processes_drain_shell_queue(tmp_path):
+    from tpuvsr.testing import subprocess_env
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    # each job sleeps a little so the queue outlives worker-0's head
+    # start and BOTH workers demonstrably claim
+    jobs = [_shell(q, f"sh{i}", tenant=f"t{i % 3}",
+                   argv=[sys.executable, "-c",
+                         "import time; time.sleep(0.05)"])
+            for i in range(30)]
+    pool = WorkerPool(spool, 2, devices=2, drain=True,
+                      env=subprocess_env()).start()
+    rcs = pool.wait(timeout=120)
+    assert rcs == [0, 0]
+    q2 = JobQueue(spool)
+    assert all(j.state == "done" for j in q2.jobs())
+    # both workers actually participated and no job ran twice
+    owners = set()
+    for j in jobs:
+        starts = [e for e in read_journal(q2.journal_path(j.job_id))
+                  if e["event"] == "job_started"]
+        assert len(starts) == 1
+        decs = [e for e in read_journal(q2.journal_path(j.job_id))
+                if e["event"] == "sched_decision"]
+        owners.add(decs[0]["worker"])
+    assert len(owners) == 2
+
+
+# ---------------------------------------------------------------------
+# HTTP front (tentpole c): wire round-trip vs the CLI verbs
+# ---------------------------------------------------------------------
+def _http(port, method, path, body=None, timeout=60):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request(method, path,
+              body=(json.dumps(body) if body is not None else None),
+              headers=({"Content-Type": "application/json"}
+                       if body is not None else {}))
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, json.loads(data)
+
+
+def test_http_round_trip_matches_cli(tmp_path, capsys):
+    """ISSUE 14 acceptance: submit -> streamed status -> cancel over
+    the wire matches the CLI verbs' outputs and exit codes.  The
+    status documents are literally the same object (``job_doc``); the
+    stream replays the job's journal byte-for-line; terminal states
+    map to the unified exit codes on both surfaces."""
+    from tpuvsr.service.api import main as api_main
+    spool = str(tmp_path / "spool")
+    srv = ServiceHTTP(spool).start()
+    try:
+        port = srv.port
+        # -- submit over the wire vs over the CLI ----------------------
+        st, wire_job = _http(port, "POST", "/v1/jobs", {
+            "spec": "<stub:wire>", "engine": "device", "kind": "check",
+            "tenant": "acme",
+            "flags": {"stub": True, "inv_x_bound": 2}})
+        assert st == 200 and wire_job["state"] == "queued"
+        assert api_main(["submit", "--stub", "--tenant", "acme",
+                         "--flag", "inv_x_bound=2", "--engine",
+                         "device", "--spool", spool, "--json"]) == 0
+        cli_job = json.loads(capsys.readouterr().out.strip())
+        # same record shape either way (ids/seq/timestamps differ)
+        volatile = {"job_id", "seq", "submitted_ts", "updated_ts",
+                    "spec", "journal", "metrics"}
+        wire_view = {k: v for k, v in wire_job.items()
+                     if k not in volatile and k in cli_job}
+        cli_view = {k: v for k, v in cli_job.items()
+                    if k not in volatile and k in wire_job}
+        assert wire_view == cli_view
+
+        # -- streamed status while a worker drains ---------------------
+        streamed = []
+
+        def stream():
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=300)
+            c.request("GET",
+                      f"/v1/jobs/{wire_job['job_id']}/events?follow=1")
+            r = c.getresponse()
+            body = r.read().decode()
+            streamed.extend(json.loads(ln)
+                            for ln in body.splitlines() if ln.strip())
+
+        t = threading.Thread(target=stream)
+        t.start()
+        Worker(JobQueue(spool), devices=1).drain()
+        t.join(300)
+        assert not t.is_alive()
+        # the stream IS the journal: same validated event sequence
+        on_disk = read_journal(JobQueue(spool).journal_path(
+            wire_job["job_id"]))
+        assert [e["event"] for e in streamed] == \
+            [e["event"] for e in on_disk]
+        assert streamed == on_disk
+        assert streamed[-1]["event"] == "job_done"
+
+        # -- status over the wire == status over the CLI ---------------
+        st, wire_doc = _http(port, "GET",
+                             f"/v1/jobs/{wire_job['job_id']}?tail=3")
+        assert st == 200
+        assert api_main(["status", wire_job["job_id"], "--spool",
+                         spool, "--json", "--tail", "3"]) == 0
+        cli_doc = json.loads(capsys.readouterr().out.strip())
+        assert wire_doc == cli_doc
+        assert wire_doc["state"] == "violated"
+        assert wire_doc["exit_code"] == EX_VIOLATION == 12
+        assert wire_doc["result"]["violated"] == "Bound"
+
+        # -- cancel over the wire vs over the CLI ----------------------
+        _, c1 = _http(port, "POST", "/v1/jobs", {"spec": "x"})
+        st, c1d = _http(port, "POST",
+                        f"/v1/jobs/{c1['job_id']}/cancel")
+        assert st == 200 and c1d["state"] == "cancelled"
+        assert c1d["exit_code"] == state_exit("cancelled")
+        assert api_main(["submit", "--stub", "--spool", spool,
+                         "--json"]) == 0
+        c2 = json.loads(capsys.readouterr().out.strip())
+        assert api_main(["cancel", c2["job_id"], "--spool", spool,
+                         "--json"]) == 0
+        c2d = json.loads(capsys.readouterr().out.strip())
+        assert c2d["state"] == "cancelled" == c1d["state"]
+        # unknown-job errors: 404 on the wire, usage error on the CLI
+        st404, _body = _http(port, "GET", "/v1/jobs/nope")
+        assert st404 == 404
+        assert api_main(["status", "nope", "--spool", spool]) == \
+            EX_USAGE
+        capsys.readouterr()
+        # double-cancel: HTTP conflict
+        st409, _body = _http(port, "POST",
+                             f"/v1/jobs/{c1['job_id']}/cancel")
+        assert st409 == 409
+    finally:
+        srv.stop()
+
+
+def test_http_submit_validation(tmp_path):
+    srv = ServiceHTTP(str(tmp_path / "spool")).start()
+    try:
+        port = srv.port
+        st, body = _http(port, "POST", "/v1/jobs", {"speeec": "x"})
+        assert st == 400 and "speeec" in body["error"]
+        st, body = _http(port, "POST", "/v1/jobs",
+                         {"spec": "x", "kind": "nope"})
+        assert st == 400 and "kind" in body["error"]
+        st, body = _http(port, "POST", "/v1/jobs", {})
+        assert st == 400
+        st, body = _http(port, "GET", "/healthz")
+        assert st == 200 and body["ok"]
+        st, body = _http(port, "GET", "/v1/jobs")
+        assert st == 200 and body["jobs"] == []
+        st, body = _http(port, "GET", "/nope")
+        assert st == 404
+    finally:
+        srv.stop()
+
+
+def test_http_tenants_endpoint(tmp_path):
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    _shell(q, "a", tenant="acme")
+    _shell(q, "b", tenant="blue")
+    Worker(q, devices=1).drain()
+    srv = ServiceHTTP(spool).start()
+    try:
+        st, body = _http(srv.port, "GET", "/v1/tenants")
+        assert st == 200
+        assert body["tenants"]["acme"]["done"] == 1
+        assert body["tenants"]["blue"]["done"] == 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# exit-code mapping (satellite: the one contract, extended)
+# ---------------------------------------------------------------------
+def test_state_exit_is_inverse_of_job_state():
+    from tpuvsr.exitcodes import JOB_STATE
+    for code, state in JOB_STATE.items():
+        if state != "failed":     # failed has several codes; 70 wins
+            assert state_exit(state) == code
+    assert state_exit("done") == EX_OK
+    assert state_exit("cancelled") == EX_SOFTWARE
+    for nonterminal in ("queued", "admitted", "running"):
+        assert state_exit(nonterminal) is None
+    assert set(STATE_EXIT) == {"done", "violated", "failed",
+                               "cancelled", "preempted-requeued"}
+
+
+def test_cli_serve_parser_accepts_serving_tier_flags():
+    from tpuvsr.service.api import build_parser
+    p = build_parser()
+    args = p.parse_args(["serve", "--workers", "3", "--http", "0",
+                         "--tenant-weight", "acme=2.0",
+                         "--age-every", "5", "--light-threads", "4",
+                         "--heartbeat-timeout", "120"])
+    assert args.workers == 3 and args.http == 0
+    assert args.tenant_weight == ["acme=2.0"]
+    args2 = p.parse_args(["submit", "--stub", "--tenant", "acme"])
+    assert args2.tenant == "acme"
+    with pytest.raises(SystemExit) as e:
+        p.parse_args(["serve", "--workers", "x"])
+    assert e.value.code == 2

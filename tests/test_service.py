@@ -75,6 +75,49 @@ def test_queue_claim_priority_order_and_atomicity(tmp_path):
     assert q.claim_next() is None
 
 
+def test_concurrent_claims_exactly_once(tmp_path):
+    """ISSUE 14 satellite: 3+ worker PROCESSES race ``claim_next``
+    over one spool (the multiprocessing harness in
+    ``tpuvsr/testing.py``); every job must be claimed exactly once —
+    the union of the racers' hauls covers the queue and their hauls
+    are disjoint (the O_CREAT|O_EXCL claim files arbitrate)."""
+    from tpuvsr.testing import claim_race
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    submitted = []
+    for i in range(36):
+        j = q.submit(f"job-{i:03d}.tla", tenant=f"t{i % 4}",
+                     priority=i % 3)
+        q.transition(j.job_id, "admitted")
+        submitted.append(j.job_id)
+    hauls = claim_race(spool, workers=3)
+    assert len(hauls) == 3
+    all_claimed = [jid for got in hauls.values() for jid in got]
+    assert sorted(all_claimed) == sorted(submitted)      # no dupes,
+    assert len(set(all_claimed)) == len(submitted)       # no losses
+    q.refresh()
+    assert all(j.state == "done" for j in q.jobs())
+    # the race was real: no racer swept the whole queue alone
+    assert max(len(got) for got in hauls.values()) < len(submitted)
+
+
+def test_tenant_field_durable_across_fold(tmp_path):
+    """The tenant rides the durable job record: a fresh JobQueue over
+    the same spool folds it back, and legacy records without one load
+    as the anonymous tenant."""
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    j = q.submit("X.tla", tenant="acme")
+    assert JobQueue(spool).get(j.job_id).tenant == "acme"
+    # a legacy submit record (pre-ISSUE 14: no tenant key) still folds
+    legacy = q.get(j.job_id).to_dict()
+    legacy.pop("tenant")
+    legacy.update(job_id="legacy-1", seq=99)
+    with open(q.log_path, "a") as f:
+        f.write(json.dumps({"op": "submit", "job": legacy}) + "\n")
+    assert JobQueue(spool).get("legacy-1").tenant is None
+
+
 def test_queue_cross_process_refresh(tmp_path):
     """A long-running worker's queue view picks up jobs submitted by
     ANOTHER JobQueue instance over the same spool (the live-serve
@@ -554,6 +597,24 @@ def test_cli_verb_dispatch_subprocess(tmp_path):
         capture_output=True, text=True, timeout=120, env=env)
     assert r2.returncode == 0, r2.stderr
     assert json.loads(r2.stdout.strip())["state"] == "queued"
+
+
+def test_serve_demo_smoke(capsys):
+    """The full serving-tier drill under tier-1 (ISSUE 14
+    acceptance): lifecycle, the 3-tenant/4-kind saturation queue over
+    2 worker processes, the >= 1.6x 2-worker scaling gate, and the
+    multi-worker-vs-serial bit-identity oracle."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import serve_demo
+    assert serve_demo.main() == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and all(out["checks"].values())
+    assert out["saturation"]["jobs"] > 150
+    assert out["saturation"]["kinds"] == ["check", "shell", "sim",
+                                          "validate"]
+    assert out["scaling"]["ratio"] >= 1.6
+    assert out["bit_identity"]["diffs"] == {}
 
 
 # ---------------------------------------------------------------------

@@ -223,11 +223,13 @@ SIGTERM/SIGINT and wrote a rescue snapshot — rerun with -recover to
 continue).  The dispatch service maps these to job terminal states
 from the same table.
 
-Service verbs (ISSUE 6; tpuvsr/service — README "Service"):
+Service verbs (ISSUE 6 + the ISSUE 14 serving tier; tpuvsr/service +
+tpuvsr/serve — README "Service"):
 
     python -m tpuvsr submit SPEC.tla [-config F] [--engine E]
-                     [--priority N] [--devices N] [--spool DIR] ...
-    python -m tpuvsr serve  [--spool DIR] [--drain] ...
+                     [--priority N] [--devices N] [--tenant T] ...
+    python -m tpuvsr serve  [--spool DIR] [--drain] [--workers N]
+                     [--http PORT] [--tenant-weight T=W] ...
     python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
     python -m tpuvsr cancel JOB [--spool DIR]
 

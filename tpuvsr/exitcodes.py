@@ -46,10 +46,31 @@ JOB_STATE = {
 }
 
 
+#: job state -> the exit code a client WAITING on that job should
+#: adopt — the inverse direction of ``JOB_STATE``, used by the serving
+#: tier's HTTP front (ISSUE 14) so a ``GET /v1/jobs/<id>`` poller and
+#: a CLI run exit with the same verdict.  ``cancelled`` joins
+#: ``failed`` at EX_SOFTWARE ("no verdict was produced"; the job's
+#: ``reason`` field disambiguates).  Non-terminal states map to None
+#: (still running — no exit yet).
+STATE_EXIT = {
+    "done": EX_OK,
+    "violated": EX_VIOLATION,
+    "failed": EX_SOFTWARE,
+    "cancelled": EX_SOFTWARE,
+    "preempted-requeued": EX_RESUMABLE,
+}
+
+
 def job_state(code) -> str:
     """Service job state for a process exit code; any code outside the
     contract is a plain failure."""
     return JOB_STATE.get(int(code), "failed")
+
+
+def state_exit(state):
+    """Exit code for a service job state (None while non-terminal)."""
+    return STATE_EXIT.get(state)
 
 
 def describe(code) -> str:

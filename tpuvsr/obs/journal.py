@@ -58,6 +58,14 @@ EVENT_REQUIRED = {
     "job_started": ("job_id", "attempt", "devices"),
     "job_requeued": ("job_id", "reason", "elapsed_s"),
     "job_done": ("job_id", "state", "elapsed_s"),
+    # serving tier (ISSUE 14): `sched_decision` records WHY the
+    # fair-share policy popped this job (tenant deficit + aged
+    # priority — the answer to "why did my job wait?");
+    # `worker_heartbeat` is the periodic liveness note of the worker
+    # holding the job (the claim-file mtime is the machine-read
+    # heartbeat; this row is the human-readable trail)
+    "sched_decision": ("job_id", "tenant", "policy"),
+    "worker_heartbeat": ("job_id", "worker"),
     # walker-fleet simulation (ISSUE 7): the chunk boundary is the
     # sim analog of level_done (walks/steps cumulative); `split` is an
     # importance-splitting resample; `hunt_violation` a UNIQUE

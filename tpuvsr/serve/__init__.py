@@ -1,0 +1,49 @@
+"""tpuvsr.serve — the multi-worker fair-share serving tier
+(ISSUE 14 tentpole, ROADMAP item 2).
+
+``tpuvsr/service`` made verification durable; this package makes it
+CONCURRENT and FAIR, after the many-tenants-one-queue posture of
+federated dispatch (arxiv 2606.02019) and streaming trace validation
+(arxiv 2404.16075):
+
+* **pool.py** — N worker processes over one spool (the PR 6 atomic
+  claims finally exercised multi-process), each owning a device
+  group; dead workers' jobs recovered by survivors via the hardened
+  worker-id + heartbeat claim files;
+* **multirunner.py** — a thread-pool side lane inside every worker so
+  light jobs (shell, interp validates, speclint reports) run beside
+  the mesh job with a zero-device allocation;
+* **fairshare.py** — deficit-round-robin pop order over per-tenant
+  weighted quotas plus priority aging (``sched_decision`` journaled
+  per pop; ``TenantLedger`` folds the accounting off the spool);
+* **http.py** — the wire API: ``serve --http PORT`` exposes
+  submit/status/cancel/list plus chunked streaming of per-job
+  journals, stdlib ``http.server`` only.
+
+Imports are lazy (PEP 562) so the jax-free pieces (queue tooling,
+claim racers, shell-only workers) stay milliseconds to import.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "FairSharePolicy": ("fairshare", "FairSharePolicy"),
+    "TenantLedger": ("fairshare", "TenantLedger"),
+    "MIN_WEIGHT": ("fairshare", "MIN_WEIGHT"),
+    "MultiRunner": ("multirunner", "MultiRunner"),
+    "is_light": ("multirunner", "is_light"),
+    "ServiceHTTP": ("http", "ServiceHTTP"),
+    "WorkerPool": ("pool", "WorkerPool"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        mod, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), attr)

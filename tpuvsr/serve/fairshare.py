@@ -1,0 +1,266 @@
+"""Fair-share scheduling for the serving tier (ISSUE 14 tentpole b).
+
+The queue's original pop order — highest priority, then submission
+order — is exactly the policy that starves: one tenant streaming
+high-priority submissions pushes everyone else's jobs to the back of
+the line forever (ROADMAP item 2).  This module replaces it with the
+two classic anti-starvation mechanisms, composed:
+
+* **Priority aging** — a job's *effective* priority grows by one per
+  ``age_every`` seconds waited, so any job eventually outranks any
+  fixed priority class.  ``max_wait_bound`` prices the guarantee: a
+  priority-``p`` job outranks every FRESH priority-``q`` submission
+  after at most ``age_every * (q - p + 1)`` seconds — the bound the
+  saturation drill (``scripts/serve_demo.py``) asserts against.
+* **Deficit round robin over tenants** — every job carries a
+  ``tenant`` and every tenant a weight (default 1.0).  Pop order is
+  computed by DRR: tenants are visited in a fixed round-robin cycle,
+  each visit accrues ``quantum * weight`` of credit, and a tenant
+  emits its best jobs (aged priority, then seq) while its credit
+  covers their cost (requested devices).  A tenant submitting 1000
+  jobs therefore gets the same share of pops as a tenant submitting
+  3 — weighted, not first-come-drain-everything.
+
+The policy object is PER-WORKER in-memory state (deficits persist
+across claims via :meth:`charge`), which makes multi-worker fairness
+approximate by construction — each worker is independently fair, and
+the aging term is global (it reads ``submitted_ts`` off the durable
+job record), so the no-starvation bound holds fleet-wide.  Every pop
+is journaled as a ``sched_decision`` event on the popped job's own
+journal (SCHEMA.md), so "why did MY job wait?" is answerable after
+the fact.
+
+:class:`TenantLedger` is the accounting fold behind ``status``/HTTP
+``/v1/tenants``: per-tenant job counts by state plus consumed
+service-seconds, read straight off the spool fold — no extra state
+to keep durable.
+
+Deliberately jax-free and service-free: the queue hands ``order`` its
+claimable jobs and the worker calls ``charge``/``explain``; nothing
+here imports engines, so ``submit``-side tooling can price the policy
+in milliseconds.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: floor for tenant weights — a zero/negative weight must throttle a
+#: tenant, never freeze it (DRR credit of 0 would starve it outright,
+#: the exact bug this module exists to kill)
+MIN_WEIGHT = 0.01
+
+
+class FairSharePolicy:
+    """Deficit-round-robin pop order with priority aging (see module
+    doc).  One instance per worker; ``order`` is handed to
+    ``JobQueue.claim_next`` and ``charge`` is called on every
+    successful claim so the deficits track real service."""
+
+    def __init__(self, weights=None, *, quantum=1.0, age_every=60.0,
+                 age_cap=1_000_000, deficit_cap=None, clock=time.time):
+        #: tenant -> weight (share of pops per DRR round); unknown
+        #: tenants get 1.0
+        self.weights = dict(weights or {})
+        self.quantum = float(quantum)
+        #: seconds of waiting per +1 effective priority (None/0 = off)
+        self.age_every = age_every
+        self.age_cap = age_cap
+        #: credit an idle-then-bursting tenant may bank (bounds how
+        #: long it can monopolize pops when it returns)
+        self.deficit_cap = (deficit_cap if deficit_cap is not None
+                            else 8.0 * self.quantum)
+        self.clock = clock
+        # the persistent DRR ring state: banked credit per tenant,
+        # the tenant whose visit comes next, and whether that visit is
+        # already in progress (mid-visit = no fresh quantum on resume).
+        # This is what makes claim-by-claim pops fair: without the
+        # advancing pointer every claim would restart the ring at the
+        # first tenant and hand it every pop.
+        self._deficit = {}
+        self._next = None
+        self._carry = False
+
+    # -- the two mechanisms -------------------------------------------
+    def weight(self, tenant):
+        try:
+            w = float(self.weights.get(tenant, 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return max(MIN_WEIGHT, w)
+
+    def effective_priority(self, job, now=None):
+        """Base priority plus the aging boost earned by waiting."""
+        if not self.age_every:
+            return int(job.priority or 0)
+        now = self.clock() if now is None else now
+        waited = max(0.0, now - self._since(job, now))
+        return (int(job.priority or 0)
+                + min(self.age_cap, int(waited // self.age_every)))
+
+    @staticmethod
+    def _since(job, now):
+        # 0.0 is a legal epoch (fixtures, fakes) — only None is "no
+        # timestamp recorded"
+        return job.submitted_ts if job.submitted_ts is not None else now
+
+    def max_wait_bound(self, base_priority, top_priority):
+        """Seconds after which a waiting ``base_priority`` job outranks
+        every FRESH ``top_priority`` submission — the aging policy's
+        starvation bound (infinite when aging is off)."""
+        if not self.age_every:
+            return float("inf")
+        return self.age_every * max(0, int(top_priority)
+                                    - int(base_priority) + 1)
+
+    def cost(self, job):
+        """DRR cost of one pop: the devices the job will occupy."""
+        return max(1, int(job.devices or 1))
+
+    # -- the DRR ring --------------------------------------------------
+    def _backlogs(self, jobs, now=None):
+        """tenant -> that tenant's claimable jobs in aged-priority
+        order (the within-tenant pop order)."""
+        now = self.clock() if now is None else now
+        per = {}
+        for j in jobs:
+            per.setdefault(j.tenant, []).append(j)
+        for backlog in per.values():
+            backlog.sort(
+                key=lambda j: (-self.effective_priority(j, now),
+                               j.seq))
+        return per
+
+    @staticmethod
+    def _ring_key(tenant):
+        return (tenant is not None, str(tenant))
+
+    def _drr(self, backlogs, state):
+        """The one DRR loop, shared by the full-order preview and the
+        real-claim bookkeeping: visit tenants round-robin from
+        ``state['next']`` (stable ring, None first), accrue
+        ``quantum * weight`` per visit (none on a mid-visit resume),
+        pop while the credit covers the head job's cost, bank the
+        remainder (capped) when it does not, and reset an emptied
+        tenant's credit (classic DRR: no hoarding while idle).
+        Mutates `state` in place as it yields — the caller decides
+        whether that state is a scratch copy (``order``) or the
+        persistent one (``charge``)."""
+        ring = sorted(backlogs, key=self._ring_key)
+        if not ring:
+            return
+        start = 0
+        if state.get("next") is not None or None in backlogs:
+            nk = self._ring_key(state.get("next"))
+            for i, t in enumerate(ring):
+                if self._ring_key(t) >= nk:
+                    start = i
+                    break
+        i, first = start, True
+        deficit = state["deficit"]
+        while any(backlogs[t] for t in ring):
+            t = ring[i % len(ring)]
+            if backlogs[t]:
+                if first and state.get("carry") \
+                        and t == state.get("next"):
+                    cred = deficit.get(t, 0.0)   # resume mid-visit
+                else:
+                    cred = (deficit.get(t, 0.0)
+                            + self.quantum * self.weight(t))
+                while backlogs[t] and cred >= self.cost(backlogs[t][0]):
+                    job = backlogs[t].pop(0)
+                    cred -= self.cost(job)
+                    deficit[t] = cred
+                    if backlogs[t]:
+                        state["next"], state["carry"] = t, True
+                    else:
+                        deficit[t] = 0.0
+                        state["next"] = ring[(i + 1) % len(ring)]
+                        state["carry"] = False
+                    yield job
+                if backlogs[t]:
+                    # head too costly for the remaining credit: bank
+                    # it and move on — next visit tops it up.  The cap
+                    # bounds idle hoarding but never sits below the
+                    # head's cost (a fat job must stay reachable)
+                    cap = max(self.deficit_cap,
+                              self.cost(backlogs[t][0]))
+                    deficit[t] = min(cap, cred)
+                    state["next"] = ring[(i + 1) % len(ring)]
+                    state["carry"] = False
+            first = False
+            i += 1
+
+    # -- pop order -----------------------------------------------------
+    def order(self, jobs, now=None):
+        """Claimable jobs -> pop order.  Within a tenant: effective
+        (aged) priority desc, then submission order.  Across tenants:
+        the DRR ring, resumed from the persistent state — so a tenant
+        under-served by past claims is visited first.  Pure preview:
+        the persistent state is NOT advanced (``charge`` does that on
+        the real claim)."""
+        state = {"deficit": dict(self._deficit), "next": self._next,
+                 "carry": self._carry}
+        return list(self._drr(self._backlogs(jobs, now), state))
+
+    # -- bookkeeping on a real claim ----------------------------------
+    def charge(self, job, waiting=()):
+        """Record an actual claim: replay the DRR ring on the
+        PERSISTENT state until it pops `job` (normally the first pop —
+        ``claim_next`` claims the head of ``order``), advancing the
+        pointer/credits exactly as the preview predicted.  `waiting`
+        is the still-claimable job list at claim time; a lost-race
+        mismatch just replays a little further, which only costs
+        fairness approximation, never correctness."""
+        rest = [j for j in waiting if j.job_id != job.job_id]
+        backlogs = self._backlogs([job] + rest)
+        state = {"deficit": self._deficit, "next": self._next,
+                 "carry": self._carry}
+        for n, popped in enumerate(self._drr(backlogs, state)):
+            if popped.job_id == job.job_id or n > len(rest):
+                break
+        self._next, self._carry = state["next"], state["carry"]
+
+    def explain(self, job, now=None):
+        """The ``sched_decision`` journal payload for a claimed job."""
+        now = self.clock() if now is None else now
+        return {
+            "policy": "drr",
+            "tenant": job.tenant,
+            "weight": round(self.weight(job.tenant), 3),
+            "deficit": round(self._deficit.get(job.tenant, 0.0), 3),
+            "priority": int(job.priority or 0),
+            "aged_priority": self.effective_priority(job, now),
+            "waited_s": round(max(0.0, now - self._since(job, now)), 3),
+        }
+
+
+class TenantLedger:
+    """Per-tenant accounting folded from the durable job records —
+    the query surface behind ``status``'s tenant table and the HTTP
+    front's ``/v1/tenants`` (nothing extra is persisted; the spool IS
+    the ledger)."""
+
+    @staticmethod
+    def fold(jobs):
+        out = {}
+        for j in jobs:
+            row = out.setdefault(j.tenant or "-", {
+                "jobs": 0, "queued": 0, "active": 0, "done": 0,
+                "violated": 0, "failed": 0, "cancelled": 0,
+                "service_s": 0.0})
+            row["jobs"] += 1
+            if j.state in ("queued", "admitted", "preempted-requeued"):
+                row["queued"] += 1
+            elif j.state == "running":
+                row["active"] += 1
+            elif j.state in row:
+                row[j.state] += 1
+            elapsed = (j.result or {}).get("elapsed_s")
+            if elapsed:
+                try:
+                    row["service_s"] = round(
+                        row["service_s"] + float(elapsed), 3)
+                except (TypeError, ValueError):
+                    pass
+        return out

@@ -1,0 +1,308 @@
+"""HTTP front for the serving tier (ISSUE 14 tentpole c).
+
+``python -m tpuvsr serve --http PORT`` exposes the dispatch service
+over the wire — stdlib ``http.server`` only, no new dependencies —
+so the CLI verbs become one client among many.  The endpoints mirror
+the verbs exactly (both sides share ``tpuvsr.service.api.job_doc``,
+so a ``status --json`` and a ``GET /v1/jobs/<id>`` are the SAME
+document):
+
+    POST /v1/jobs                submit  (JSON body: spec, cfg,
+                                 engine, kind, flags, priority,
+                                 devices[, _min, _max], tenant)
+    GET  /v1/jobs                list    (status verb's queue view +
+                                 per-tenant ledger)
+    GET  /v1/jobs/<id>           status  (per-job doc, ?tail=N)
+    GET  /v1/jobs/<id>/events    the job's journal as NDJSON;
+                                 ?follow=1 streams it chunked —
+                                 lines appear as the worker appends
+                                 them, and the stream closes when the
+                                 job reaches a terminal state (the
+                                 journal IS the query surface; this
+                                 endpoint just tails it over the wire)
+    POST /v1/jobs/<id>/cancel    cancel
+    GET  /v1/tenants             tenant accounting fold
+    GET  /healthz                queue stats
+
+Exit-code mapping: every job doc carries ``exit_code`` — the unified
+table's code for its state (``tpuvsr/exitcodes.py``: done 0,
+violated 12, failed/cancelled 70, preempted-requeued 75, running
+``null``) — so an HTTP client polling ``status`` and a CLI client
+waiting on ``serve`` exit with the same verdict.  Transport errors
+use HTTP's own vocabulary: unknown job 404 (the CLI's usage error 2),
+illegal transition 409, malformed body 400.
+
+The server is a ``ThreadingHTTPServer`` running beside the worker's
+drain loop; every request folds the spool through one shared
+RLock-guarded :class:`JobQueue`, so the front needs no coordination
+with workers beyond the spool itself — kill the front, jobs keep
+running; kill the workers, submissions keep landing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..service.queue import TERMINAL, JobQueue, QueueError
+
+#: job fields a POST /v1/jobs body may set (everything else is 400 —
+#: a typo'd field must not silently vanish)
+SUBMIT_FIELDS = frozenset((
+    "spec", "cfg", "engine", "kind", "flags", "priority", "devices",
+    "devices_min", "devices_max", "tenant", "job_id"))
+
+KINDS = ("check", "sim", "validate", "shell")
+
+
+class ServiceHTTP:
+    """The HTTP front over one spool.  ``port=0`` binds an ephemeral
+    port (tests); ``start`` serves from a daemon thread and ``stop``
+    shuts the listener down (in-flight streams close on their next
+    poll tick)."""
+
+    def __init__(self, spool, *, host="127.0.0.1", port=0, poll=0.15,
+                 max_stream_s=3600.0, log=None):
+        self.spool = os.path.abspath(spool)
+        self.queue = JobQueue(self.spool)
+        self.poll = poll
+        self.max_stream_s = max_stream_s
+        self.log = log
+        self._thread = None
+        self._closing = False
+        svc = self
+
+        class Handler(_Handler):
+            service = svc
+
+        self.server = ThreadingHTTPServer((host, int(port)), Handler)
+        self.server.daemon_threads = True
+
+    @property
+    def port(self):
+        return self.server.server_address[1]
+
+    @property
+    def address(self):
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="tpuvsr-http",
+            daemon=True)
+        self._thread.start()
+        if self.log:
+            self.log(f"http front listening on {self.address}")
+        return self
+
+    def stop(self):
+        self._closing = True
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread is not None:
+            self._thread.join(10)
+            self._thread = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ServiceHTTP = None
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib hook
+        if self.service.log:
+            self.service.log(f"http: {fmt % args}")
+
+    def _json(self, code, obj):
+        body = (json.dumps(obj, default=str) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _error(self, code, message):
+        self._json(code, {"error": message})
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        if not raw:
+            return {}
+        return json.loads(raw)
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — stdlib hook
+        from ..service.api import job_doc
+        from .fairshare import TenantLedger
+        url = urlparse(self.path)
+        qs = parse_qs(url.query)
+        parts = [p for p in url.path.split("/") if p]
+        q = self.service.queue
+        try:
+            with q.lock():
+                q.refresh()
+                if parts == ["healthz"]:
+                    return self._json(200, {"ok": True,
+                                            "stats": q.stats()})
+                if parts == ["v1", "tenants"]:
+                    return self._json(
+                        200, {"tenants": TenantLedger.fold(q.jobs())})
+                if parts == ["v1", "jobs"]:
+                    # lightweight rows (the CLI list uses to_dict too):
+                    # per-job docs fold whole journals — O(journal
+                    # bytes) per sim/validate job is for the single-job
+                    # route, not a dashboard poll holding the lock
+                    from ..exitcodes import state_exit
+                    rows = [dict(j.to_dict(),
+                                 exit_code=state_exit(j.state))
+                            for j in q.jobs()]
+                    return self._json(200, {
+                        "stats": q.stats(), "jobs": rows,
+                        "tenants": TenantLedger.fold(q.jobs())})
+                if len(parts) == 3 and parts[:2] == ["v1", "jobs"]:
+                    job = q.get(parts[2])
+                    tail = int((qs.get("tail") or ["0"])[0])
+                    return self._json(200, job_doc(q, job, tail=tail))
+            if len(parts) == 4 and parts[:2] == ["v1", "jobs"] \
+                    and parts[3] == "events":
+                follow = (qs.get("follow") or ["0"])[0] not in \
+                    ("0", "", "false")
+                tail = int((qs.get("tail") or ["0"])[0])
+                return self._stream_events(parts[2], follow, tail)
+        except QueueError as e:
+            return self._error(404, str(e))
+        except (ValueError, TypeError) as e:
+            return self._error(400, str(e))
+        return self._error(404, f"no route {url.path!r}")
+
+    def do_POST(self):  # noqa: N802 — stdlib hook
+        from ..service.api import job_doc
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        q = self.service.queue
+        try:
+            body = self._body()
+        except (ValueError, TypeError) as e:
+            return self._error(400, f"bad JSON body: {e}")
+        try:
+            if parts == ["v1", "jobs"]:
+                unknown = set(body) - SUBMIT_FIELDS
+                if unknown:
+                    return self._error(
+                        400, f"unknown submit fields {sorted(unknown)}")
+                if not body.get("spec"):
+                    return self._error(400, "submit needs a spec")
+                if body.get("kind", "check") not in KINDS:
+                    return self._error(
+                        400, f"unknown kind {body.get('kind')!r} "
+                             f"(one of {list(KINDS)})")
+                with q.lock():
+                    job = q.submit(
+                        body["spec"], cfg=body.get("cfg"),
+                        engine=body.get("engine", "auto"),
+                        kind=body.get("kind", "check"),
+                        flags=body.get("flags"),
+                        priority=body.get("priority", 0),
+                        devices=body.get("devices", 1),
+                        devices_min=body.get("devices_min"),
+                        devices_max=body.get("devices_max"),
+                        tenant=body.get("tenant"),
+                        job_id=body.get("job_id"))
+                    return self._json(200, job_doc(q, job))
+            if len(parts) == 4 and parts[:2] == ["v1", "jobs"] \
+                    and parts[3] == "cancel":
+                with q.lock():
+                    q.refresh()
+                    job = q.get(parts[2])       # 404 before 409
+                    try:
+                        job = q.cancel(parts[2])
+                    except QueueError as e:
+                        return self._error(409, str(e))
+                    return self._json(200, job_doc(q, job))
+        except QueueError as e:
+            return self._error(404, str(e))
+        except (ValueError, TypeError) as e:
+            return self._error(400, str(e))
+        return self._error(404, f"no route {url.path!r}")
+
+    # -- streaming status ---------------------------------------------
+    def _chunk(self, data):
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+    def _stream_events(self, job_id, follow, tail):
+        """NDJSON journal tail over chunked transfer: replay the
+        existing journal (last `tail` lines when set), then — with
+        ``follow`` — poll for appended COMPLETE lines until the job is
+        terminal and fully drained (or the stream budget/client
+        disconnect ends it).  Torn tails are held back exactly like
+        the spool fold holds back a torn jobs.jsonl line."""
+        svc = self.service
+        q = svc.queue
+        with q.lock():
+            q.refresh()
+            job = q.get(job_id)                  # QueueError -> 404
+            path = q.journal_path(job.job_id)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        t0 = time.time()
+        pos = 0
+        pending = []
+        grace = False
+        try:
+            while True:
+                emitted = False
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        while True:
+                            line = f.readline()
+                            if not line or not line.endswith(b"\n"):
+                                break            # torn tail: re-read
+                            pos = f.tell()
+                            pending.append(line)
+                except OSError:
+                    pass                          # journal not born yet
+                if tail and pending:
+                    pending = pending[-tail:]
+                    tail = 0
+                for line in pending:
+                    self._chunk(line)
+                    emitted = True
+                if emitted:
+                    grace = False
+                pending = []
+                with q.lock():
+                    q.refresh()
+                    terminal = q.get(job_id).state in TERMINAL
+                if not follow:
+                    break
+                if terminal and not emitted:
+                    # terminal and this pass surfaced nothing new: the
+                    # journal is drained (a torn final line of a dead
+                    # worker never completes — do NOT spin on it).
+                    # One grace poll first: the worker writes the
+                    # spool transition a beat before the job_done line
+                    if grace:
+                        break
+                    grace = True
+                    time.sleep(svc.poll)
+                    continue
+                if svc._closing or \
+                        time.time() - t0 > svc.max_stream_s:
+                    break
+                if not emitted:
+                    time.sleep(svc.poll)
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass

@@ -1,0 +1,86 @@
+"""Thread-safe in-process multi-runner for CPU-advised jobs
+(ISSUE 14 tentpole a).
+
+One worker process owns one device group, and before this module it
+ran ONE job at a time — so a 2-second speclint report or a shell job
+sleeping on a subprocess occupied a whole mesh while device-hungry
+checks waited.  The multi-runner is the side lane: a small thread
+pool inside the worker that runs **light** jobs concurrently with the
+mesh job, where light means provably device-free:
+
+* ``kind="shell"`` — the job IS a subprocess; the worker thread only
+  polls it;
+* ``kind="validate"`` with ``flags.interp`` — the interpreter
+  reference validator (``tpuvsr/validate/host.py``), pure Python over
+  the spec interpreter, no jax;
+* ``kind="check"`` with ``flags.lint_only`` — a speclint report job:
+  the admission gate already ran the analyzer, the "run" just
+  publishes the report (``tpuvsr/analysis``, jax-free).
+
+Light jobs allocate **zero** devices from the pool (the worker's
+``job_started`` journal event says ``devices: 0``), so the scheduler
+never counts them against the mesh, and the drain loop keeps claiming
+device jobs while they run.  Everything they touch — the queue (now
+RLock-guarded), per-job journals (append-per-write), the processed
+list — is safe under concurrent threads.
+
+Dispatch decisions are pure (:func:`is_light` reads only durable job
+fields), so every worker in a fleet classifies a job identically.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+def is_light(job):
+    """True when `job` provably needs no accelerator devices and may
+    run on the worker's thread-pool side lane (see module doc)."""
+    flags = job.flags or {}
+    if job.kind == "shell":
+        return True
+    if job.kind == "validate" and flags.get("interp"):
+        return True
+    if job.kind == "check" and flags.get("lint_only"):
+        return True
+    return False
+
+
+class MultiRunner:
+    """The worker's side lane: ``submit`` schedules one light job on
+    the thread pool and returns immediately; ``drain`` blocks until
+    every in-flight light job settled (the worker calls it before its
+    drain loop exits, so a worker never abandons a running claim)."""
+
+    def __init__(self, worker, threads=2):
+        self.worker = worker
+        self.threads = max(1, int(threads))
+        self._ex = None
+        self._futures = []
+
+    def submit(self, job):
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(
+                max_workers=self.threads,
+                thread_name_prefix="tpuvsr-light")
+        self._futures.append(
+            self._ex.submit(self.worker.run_one_light, job))
+
+    def inflight(self):
+        """Count of light jobs not yet settled (prunes done ones)."""
+        self._futures = [f for f in self._futures if not f.done()]
+        return len(self._futures)
+
+    def drain(self):
+        """Wait for every in-flight light job; surface the first
+        unexpected error (``run_one_light`` maps job errors onto the
+        queue itself, so an exception here is a worker bug)."""
+        futures, self._futures = self._futures, []
+        for f in futures:
+            f.result()
+
+    def close(self):
+        self.drain()
+        if self._ex is not None:
+            self._ex.shutdown(wait=True)
+            self._ex = None

@@ -1,0 +1,153 @@
+"""N-worker process pool over one spool (ISSUE 14 tentpole a).
+
+The queue's atomic-claim machinery was multi-process-safe from PR 6 —
+this module finally USES it: ``serve --workers N`` (or a
+:class:`WorkerPool` in library code) launches N ``serve`` worker
+processes over the same spool, each owning a device group, and the
+spool arbitrates — every claim file has exactly one creator, so every
+job runs exactly once no matter how many workers race
+(``tests/test_service.py`` drills 3+ processes over one spool).
+
+Topology: the parent stays a thin supervisor (recover-stale sweeps +
+the optional HTTP front); the children do all the work.  A child that
+dies mid-job leaves a dead claim whose job any survivor recovers WITH
+its rescue checkpoint (``recover_stale``, hardened in this PR with
+worker-id + heartbeat mtimes so a live worker on another host is
+never mistaken for dead) — the kill-one-of-N drill in
+``scripts/fault_matrix.py`` proves the survivor finishes the dead
+worker's job bit-identically.
+
+Device groups are sized, not pinned: each child gets ``--devices
+total//N`` (its DevicePool budget).  On the CPU stub harness every
+process sees its own virtual devices, so groups never collide; real
+multi-host TPU pinning (per-process device lists) is the documented
+residual on ROADMAP item 2.
+
+Workers that only ever claim light jobs (shell / interp-validate /
+lint-only) never import jax — a shell-only fleet starts in well under
+a second per worker.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def child_env(extra=None):
+    """Environment for tpuvsr child processes: the repo that spawned
+    us leads PYTHONPATH so ``-m tpuvsr`` resolves to the same code
+    even though children run with cwd=spool.  The ONE copy of this
+    logic — ``tpuvsr.testing.subprocess_env`` layers the test-only
+    CPU-backend forcing on top of it."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    pp = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = repo + (os.pathsep + pp if pp else "")
+    env.update(extra or {})
+    return env
+
+
+class WorkerPool:
+    """Spawn and supervise N ``python -m tpuvsr serve`` worker
+    processes over `spool`.  Worker stdout/stderr land in
+    ``<spool>/workers/w<i>.log`` so a dead worker's last words are
+    always on disk."""
+
+    def __init__(self, spool, workers=2, *, devices=None, drain=True,
+                 max_seconds=None, max_jobs=None, extra_args=(),
+                 env=None, python=None, log=None):
+        self.spool = os.path.abspath(spool)
+        self.workers = max(1, int(workers))
+        self.devices = devices
+        self.drain = drain
+        self.max_seconds = max_seconds
+        self.max_jobs = max_jobs
+        self.extra_args = list(extra_args)
+        self.env = env
+        self.python = python or sys.executable
+        self.log = log
+        self.procs = []
+        self.log_dir = os.path.join(self.spool, "workers")
+
+    def _cmd(self, i):
+        cmd = [self.python, "-m", "tpuvsr", "serve",
+               "--spool", self.spool, "--worker-id", f"w{i}"]
+        if self.drain:
+            cmd.append("--drain")
+        if self.devices is not None:
+            per = max(1, int(self.devices) // self.workers)
+            cmd += ["--devices", str(per)]
+        if self.max_seconds is not None:
+            cmd += ["--max-seconds", str(self.max_seconds)]
+        if self.max_jobs is not None:
+            cmd += ["--max-jobs", str(self.max_jobs)]
+        return cmd + self.extra_args
+
+    def _env(self):
+        if self.env is not None:
+            return self.env
+        return child_env()
+
+    def start(self):
+        os.makedirs(self.log_dir, exist_ok=True)
+        env = self._env()
+        for i in range(self.workers):
+            log_path = os.path.join(self.log_dir, f"w{i}.log")
+            fh = open(log_path, "ab")
+            p = subprocess.Popen(
+                self._cmd(i), stdout=fh, stderr=subprocess.STDOUT,
+                env=env, cwd=self.spool)
+            fh.close()                    # the child holds its own fd
+            p._tpuvsr_log = log_path
+            self.procs.append(p)
+            if self.log:
+                self.log(f"pool: worker w{i} pid {p.pid}")
+        return self
+
+    def alive(self):
+        return sum(1 for p in self.procs if p.poll() is None)
+
+    def kill_one(self, i, sig=signal.SIGKILL):
+        """Hard-kill worker `i` (fault drills: the dead-worker half of
+        the kill-one-of-N scenario)."""
+        p = self.procs[i]
+        if p.poll() is None:
+            os.kill(p.pid, sig)
+        p.wait(30)
+        return p.returncode
+
+    def wait(self, timeout=None):
+        """Block until every worker exits; returns their exit codes.
+        On timeout the stragglers are SIGTERMed (rescue + requeue is
+        their normal response) and the codes reflect that."""
+        deadline = None if timeout is None else time.time() + timeout
+        for p in self.procs:
+            left = (None if deadline is None
+                    else max(0.1, deadline - time.time()))
+            try:
+                p.wait(left)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+                try:
+                    p.wait(15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(15)
+        return [p.returncode for p in self.procs]
+
+    def stop(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.poll() is None:
+                os.kill(p.pid, sig)
+
+    def tail(self, i, lines=8):
+        try:
+            with open(os.path.join(self.log_dir, f"w{i}.log")) as f:
+                return "".join(f.readlines()[-lines:])
+        except OSError:
+            return ""

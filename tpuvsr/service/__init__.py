@@ -35,8 +35,8 @@ Tier-1: the whole service runs on the stub harness
 
 from __future__ import annotations
 
-from .queue import (CLAIMABLE, LEGAL, STATES, TERMINAL, Job, JobQueue,
-                    QueueError)
+from .queue import (CLAIMABLE, HEARTBEAT_TIMEOUT, LEGAL, STATES,
+                    TERMINAL, Job, JobQueue, QueueError)
 from .scheduler import (Decision, DevicePool, Scheduler,
                         advise_backend, detect_tpu_devices,
                         pow2_floor, watch_backend)
@@ -45,7 +45,8 @@ from .worker import JobObserver, Worker, result_summary, \
 
 __all__ = [
     "Job", "JobQueue", "QueueError", "STATES", "TERMINAL", "CLAIMABLE",
-    "LEGAL", "DevicePool", "Scheduler", "Decision", "advise_backend",
+    "LEGAL", "HEARTBEAT_TIMEOUT", "DevicePool", "Scheduler",
+    "Decision", "advise_backend",
     "detect_tpu_devices", "pow2_floor", "watch_backend", "Worker",
     "JobObserver",
     "result_summary", "trace_to_jsonable",

@@ -5,8 +5,9 @@ queue spool IS the database and each job's journal + metrics doc ARE
 its API records — these verbs only fold and print them.
 
     python -m tpuvsr submit SPEC.tla [-config F] [--engine E]
-                     [--priority N] [--devices N] [--spool DIR] ...
-    python -m tpuvsr serve  [--spool DIR] [--drain] [--devices N] ...
+                     [--priority N] [--devices N] [--tenant T] ...
+    python -m tpuvsr serve  [--spool DIR] [--drain] [--devices N]
+                     [--workers N] [--http PORT] [--tenant-weight T=W]
     python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
     python -m tpuvsr cancel JOB [--spool DIR]
 
@@ -15,7 +16,13 @@ engines — they are milliseconds against a live spool.  ``serve``
 hosts a :class:`tpuvsr.service.worker.Worker` (one process, many
 jobs); ``--drain`` exits when nothing is claimable (the smoke/demo
 mode), without it the worker polls for new submissions until
-``--max-seconds``.
+``--max-seconds``.  The serving tier (ISSUE 14, ``tpuvsr/serve``)
+rides the same verb: ``--workers N`` spawns N worker processes over
+the shared spool (the parent supervises + sweeps stale claims),
+``--http PORT`` raises the wire API (submit/status/cancel/list +
+chunked journal streaming; ``--workers 0`` = front only), and the
+fair-share knobs (``--tenant-weight``, ``--age-every``) shape the
+deficit-round-robin pop order.
 
 The spool location resolves as ``--spool`` > ``TPUVSR_SPOOL`` >
 ``./.tpuvsr-spool``.
@@ -66,6 +73,10 @@ def build_parser():
     sp.add_argument("--engine", default="auto",
                     choices=["auto", "device", "paged", "sharded"])
     sp.add_argument("--priority", type=int, default=0)
+    sp.add_argument("--tenant", default=None,
+                    help="fair-share tenant this job bills to "
+                         "(ISSUE 14): deficit-round-robin pop order "
+                         "and --tenant-weight quotas group by it")
     sp.add_argument("--devices", type=int, default=1)
     sp.add_argument("--devices-min", type=int, default=None,
                     help="elastic floor (sharded): the scheduler may "
@@ -113,6 +124,15 @@ def build_parser():
                          "device allocation (elastic trace-batch "
                          "placement: batch = N * devices, rescaled "
                          "when the scheduler reshapes the job)")
+    sp.add_argument("--interp", action="store_true",
+                    help="validate jobs: use the interpreter "
+                         "reference validator — a LIGHT job the "
+                         "worker's multi-runner threads handle with "
+                         "zero devices (ISSUE 14)")
+    sp.add_argument("--lint-only", action="store_true",
+                    help="check jobs: speclint report only, no "
+                         "engine run — a LIGHT job (zero devices, "
+                         "multi-runner lane)")
     sp.add_argument("--stub", action="store_true",
                     help="run the inline counter spec on the stub "
                          "kernel (tier-1 smoke path, no reference "
@@ -123,13 +143,49 @@ def build_parser():
     sp.add_argument("--spool", default=None)
     sp.add_argument("--json", action="store_true")
 
-    sv = sub.add_parser("serve", help="run the dispatch worker")
+    sv = sub.add_parser("serve", help="run the dispatch worker(s)")
     sv.add_argument("--spool", default=None)
     sv.add_argument("--drain", action="store_true",
                     help="exit when nothing is claimable")
     sv.add_argument("--devices", type=int, default=None,
                     help="device pool size (default: every visible "
-                         "device)")
+                         "device); with --workers N each worker owns "
+                         "a devices/N group")
+    sv.add_argument("--workers", type=int, default=1,
+                    help="worker processes over the shared spool "
+                         "(ISSUE 14): 1 = drain in-process (the "
+                         "original mode), N>1 = spawn N serve "
+                         "subprocesses and supervise them, 0 = no "
+                         "workers (HTTP front only)")
+    sv.add_argument("--worker-id", default=None,
+                    help="this worker's identity in claim files and "
+                         "journals (default: worker-<pid>)")
+    sv.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="raise the HTTP front on PORT (0 = an "
+                         "ephemeral port, printed on stderr): "
+                         "submit/status/cancel/list + streamed "
+                         "journal tails over the wire "
+                         "(tpuvsr/serve/http.py)")
+    sv.add_argument("--tenant-weight", action="append", default=[],
+                    metavar="TENANT=W",
+                    help="fair-share weight for a tenant "
+                         "(repeatable; default 1.0 each): a weight-2 "
+                         "tenant gets two pops per deficit-round-"
+                         "robin round where a weight-1 tenant gets "
+                         "one")
+    sv.add_argument("--age-every", type=float, default=60.0,
+                    help="priority-aging rate: +1 effective priority "
+                         "per this many seconds waited (0 disables; "
+                         "bounds every job's wait at age_every * "
+                         "(top_priority - its_priority + 1))")
+    sv.add_argument("--light-threads", type=int, default=2,
+                    help="multi-runner threads for light jobs "
+                         "(shell / interp-validate / lint-only; 0 "
+                         "disables the side lane)")
+    sv.add_argument("--heartbeat-timeout", type=float, default=None,
+                    help="seconds after which a cross-host claim "
+                         "with no heartbeat is recoverable "
+                         "(default 300)")
     sv.add_argument("--max-jobs", type=int, default=None)
     sv.add_argument("--max-seconds", type=float, default=None)
     sv.add_argument("--tpu-devices", type=int, default=None,
@@ -189,7 +245,20 @@ def cmd_submit(args):
               "(a trace-validation batch vs a walker-fleet hunt); "
               "pick one", file=sys.stderr)
         return EX_USAGE
+    if args.interp and not args.validate:
+        print("submit: --interp selects the interpreter validator; "
+              "it needs --validate", file=sys.stderr)
+        return EX_USAGE
+    if args.lint_only and (args.sim or args.validate):
+        print("submit: --lint-only is a check-job mode (speclint "
+              "report, no engine run); it conflicts with "
+              "--sim/--validate", file=sys.stderr)
+        return EX_USAGE
+    if args.lint_only:
+        flags["lint_only"] = True
     if args.validate:
+        if args.interp:
+            flags["interp"] = True
         if args.maxstates is not None:
             # mirrors the CLI's -maxstates/-validate exit-2 contract:
             # the worker would silently ignore it otherwise
@@ -220,7 +289,7 @@ def cmd_submit(args):
         return EX_USAGE
     job = q.submit(args.spec or "<stub:ObsCounter>",
                    cfg=args.config, engine=args.engine, kind=kind,
-                   flags=flags,
+                   flags=flags, tenant=args.tenant,
                    priority=args.priority, devices=args.devices,
                    devices_min=args.devices_min,
                    devices_max=args.devices_max)
@@ -228,7 +297,9 @@ def cmd_submit(args):
         print(json.dumps(job.to_dict(), default=str))
     else:
         print(f"submitted {job.job_id} ({job.spec}, engine "
-              f"{job.engine}, priority {job.priority})")
+              f"{job.engine}, priority {job.priority}"
+              + (f", tenant {job.tenant}" if job.tenant else "")
+              + ")")
     return 0
 
 
@@ -304,6 +375,38 @@ def _validate_progress(journal_path):
         lambda o: o["traces"] or o["divergences"])
 
 
+def job_doc(q, job, tail=0):
+    """One job's status document — THE job record both query surfaces
+    serve verbatim: the ``status`` verb prints it and the HTTP front's
+    ``GET /v1/jobs/<id>`` returns it (ISSUE 14: the CLI is one client
+    among many, so the document is built once, here).  ``exit_code``
+    is the unified table's code for the job's state
+    (``tpuvsr/exitcodes.py``; None while non-terminal)."""
+    from ..exitcodes import state_exit
+    doc = job.to_dict()
+    doc["exit_code"] = state_exit(job.state)
+    jp = q.journal_path(job.job_id)
+    mp = q.metrics_path(job.job_id)
+    doc["journal"] = jp if os.path.exists(jp) else None
+    doc["metrics"] = mp if os.path.exists(mp) else None
+    if job.kind == "sim" and os.path.exists(jp):
+        doc["sim"] = _sim_progress(jp)
+    if job.kind == "validate" and os.path.exists(jp):
+        doc["validate"] = _validate_progress(jp)
+    tail = max(0, int(tail or 0))    # a negative tail must not turn
+    #                                  into "everything but the head"
+    if tail and os.path.exists(jp):
+        rows = []
+        with open(jp) as f:
+            for line in f.readlines()[-tail:]:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    pass
+        doc["journal_tail"] = rows
+    return doc
+
+
 def cmd_status(args):
     q = _queue(args)
     if args.job_id:
@@ -312,29 +415,14 @@ def cmd_status(args):
         except QueueError as e:
             print(f"status: {e}", file=sys.stderr)
             return EX_USAGE
-        doc = job.to_dict()
-        jp = q.journal_path(job.job_id)
-        mp = q.metrics_path(job.job_id)
-        doc["journal"] = jp if os.path.exists(jp) else None
-        doc["metrics"] = mp if os.path.exists(mp) else None
-        if job.kind == "sim" and os.path.exists(jp):
-            doc["sim"] = _sim_progress(jp)
-        if job.kind == "validate" and os.path.exists(jp):
-            doc["validate"] = _validate_progress(jp)
-        tail = []
-        if args.tail and os.path.exists(jp):
-            with open(jp) as f:
-                for line in f.readlines()[-args.tail:]:
-                    try:
-                        tail.append(json.loads(line))
-                    except ValueError:
-                        pass
-            doc["journal_tail"] = tail
+        doc = job_doc(q, job, tail=args.tail)
+        tail = doc.get("journal_tail", [])
         if args.json:
             print(json.dumps(doc, default=str))
         else:
-            for k in ("job_id", "state", "kind", "spec", "engine",
-                      "priority", "devices", "attempts", "reason"):
+            for k in ("job_id", "state", "exit_code", "kind", "tenant",
+                      "spec", "engine", "priority", "devices",
+                      "attempts", "reason"):
                 print(f"{k}: {doc.get(k)}")
             if doc.get("rescue"):
                 print(f"rescue: {doc['rescue']}")
@@ -365,9 +453,11 @@ def cmd_status(args):
                                                "run_id")))
         return 0
     jobs = [j.to_dict() for j in q.jobs()]
+    from ..serve.fairshare import TenantLedger
+    tenants = TenantLedger.fold(q.jobs())
     if args.json:
-        print(json.dumps({"stats": q.stats(), "jobs": jobs},
-                         default=str))
+        print(json.dumps({"stats": q.stats(), "jobs": jobs,
+                          "tenants": tenants}, default=str))
     else:
         st = q.stats()
         print("queue: " + ", ".join(f"{k}={v}" for k, v in st.items()
@@ -376,7 +466,14 @@ def cmd_status(args):
         for j in jobs:
             print(f"  {j['job_id']:>18} {j['state']:>20} "
                   f"prio={j['priority']} dev={j['devices']} "
-                  f"attempts={j['attempts']} {j['spec']}")
+                  f"attempts={j['attempts']} "
+                  f"tenant={j.get('tenant') or '-'} {j['spec']}")
+        if len(tenants) > 1 or "-" not in tenants:
+            for t, row in sorted(tenants.items()):
+                print(f"  tenant {t}: {row['jobs']} job(s), "
+                      f"{row['queued']} queued, {row['active']} "
+                      f"active, {row['done']} done, "
+                      f"{row['service_s']}s served")
     return 0
 
 
@@ -397,26 +494,117 @@ def cmd_cancel(args):
     return 0
 
 
+def _policy_from_args(args):
+    from ..serve.fairshare import FairSharePolicy
+    try:
+        weights = _flag_pairs(args.tenant_weight)
+    except ValueError as e:
+        raise ValueError(f"--tenant-weight wants TENANT=WEIGHT: {e}")
+    return FairSharePolicy(weights=weights, age_every=args.age_every)
+
+
+def _serve_pool(args, q, log, t0, http):
+    """``serve --workers N`` (N > 1): spawn N worker subprocesses
+    over the spool and stay a thin supervisor — sweep stale claims on
+    a cadence (a SIGKILLed child's jobs requeue onto the survivors)
+    and host the optional HTTP front."""
+    from ..serve.pool import WorkerPool
+    passthrough = ["--age-every", str(args.age_every),
+                   "--light-threads", str(args.light_threads)]
+    for tw in args.tenant_weight:
+        passthrough += ["--tenant-weight", tw]
+    if args.heartbeat_timeout is not None:
+        passthrough += ["--heartbeat-timeout",
+                        str(args.heartbeat_timeout)]
+    # the placement advisory flags must reach the children too — a
+    # child falling back to auto-detection would contradict an
+    # explicit --tpu-devices/--bench-dir on the parent
+    if args.tpu_devices is not None:
+        passthrough += ["--tpu-devices", str(args.tpu_devices)]
+    if args.bench_dir is not None:
+        passthrough += ["--bench-dir", args.bench_dir]
+    if args.quiet:
+        passthrough.append("--quiet")
+    pool = WorkerPool(
+        q.spool, args.workers, devices=args.devices,
+        drain=args.drain, max_seconds=args.max_seconds,
+        max_jobs=args.max_jobs, extra_args=passthrough, log=log)
+    pool.start()
+    while pool.alive():
+        q.recover_stale(log=log)
+        time.sleep(0.5)
+    codes = pool.wait()
+    q.recover_stale(log=log)
+    q.refresh()
+    print(json.dumps({"workers": args.workers, "worker_rcs": codes,
+                      "stats": q.stats(),
+                      "http": http.address if http else None,
+                      "elapsed_s": round(time.time() - t0, 3)}))
+    return 0 if all(c == 0 for c in codes) else 70
+
+
 def cmd_serve(args):
-    from .worker import Worker
-    q = _queue(args)
+    q = JobQueue(args.spool or default_spool(),
+                 **({"heartbeat_timeout": args.heartbeat_timeout}
+                    if args.heartbeat_timeout is not None else {}))
     log = (None if args.quiet
            else lambda m: print(f"[tpuvsr] {m}", file=sys.stderr))
     t0 = time.time()
-    tpu = args.tpu_devices
-    if tpu is None:
-        from .scheduler import detect_tpu_devices
-        tpu = detect_tpu_devices()
-    w = Worker(q, devices=args.devices, log=log,
-               tpu_devices=tpu, bench_dir=args.bench_dir)
-    runs = w.drain(max_jobs=args.max_jobs,
-                   max_seconds=args.max_seconds,
-                   idle_exit=args.drain)
-    stats = q.stats()
-    print(json.dumps({"runs": runs, "stats": stats,
-                      "processed": w.processed,
-                      "elapsed_s": round(time.time() - t0, 3)}))
-    return 0
+    http = None
+    if args.http is not None:
+        from ..serve.http import ServiceHTTP
+        http = ServiceHTTP(q.spool, port=args.http, log=log).start()
+        print(f"[tpuvsr] http front: {http.address}", file=sys.stderr)
+    try:
+        if args.workers == 0:
+            # front-only mode: no drain loop, submissions land on the
+            # spool for workers elsewhere
+            if http is None:
+                print("serve: --workers 0 without --http serves "
+                      "nothing", file=sys.stderr)
+                return EX_USAGE
+            end = (None if args.max_seconds is None
+                   else t0 + args.max_seconds)
+            try:
+                while end is None or time.time() < end:
+                    time.sleep(0.2)
+            except KeyboardInterrupt:
+                pass
+            q.refresh()     # fold submissions the front's own queue
+            #                 instance appended while we slept
+            print(json.dumps({"workers": 0, "http": http.address,
+                              "stats": q.stats(),
+                              "elapsed_s": round(time.time() - t0,
+                                                 3)}))
+            return 0
+        try:
+            policy = _policy_from_args(args)
+        except ValueError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return EX_USAGE
+        if args.workers > 1:
+            return _serve_pool(args, q, log, t0, http)
+        from .worker import Worker
+        tpu = args.tpu_devices
+        if tpu is None:
+            from .scheduler import detect_tpu_devices
+            tpu = detect_tpu_devices()
+        w = Worker(q, devices=args.devices, log=log,
+                   tpu_devices=tpu, bench_dir=args.bench_dir,
+                   owner=args.worker_id, policy=policy,
+                   light_threads=args.light_threads)
+        runs = w.drain(max_jobs=args.max_jobs,
+                       max_seconds=args.max_seconds,
+                       idle_exit=args.drain)
+        stats = q.stats()
+        print(json.dumps({"runs": runs, "stats": stats,
+                          "processed": w.processed,
+                          "http": http.address if http else None,
+                          "elapsed_s": round(time.time() - t0, 3)}))
+        return 0
+    finally:
+        if http is not None:
+            http.stop()
 
 
 def main(argv=None):

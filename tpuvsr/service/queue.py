@@ -12,11 +12,17 @@ so that EVERY mutation is crash-safe without a database:
 * ``claims/<job_id>.claim`` — atomic claim files.  A worker takes a
   job by creating its claim file with ``O_CREAT|O_EXCL`` (the POSIX
   mutual-exclusion primitive: exactly one creator wins), records its
-  pid inside, and deletes it when the job leaves ``running``.  A claim
-  whose pid is dead is a tombstone of a killed worker;
-  ``recover_stale`` turns those back into claimable jobs — with the
-  job's latest snapshot attached as a rescue, so the next attempt
-  RESUMES instead of restarting (``checkpoint.snapshot_info``).
+  pid, worker-id and host inside, and deletes it when the job leaves
+  ``running``.  The file's **mtime is the worker's heartbeat**
+  (``heartbeat``, touched at every level-boundary tick): liveness is
+  judged pid-first on the claimer's own host and heartbeat-first
+  across hosts — a live worker on another host (fresh mtime, invisible
+  pid) is never mistaken for dead (ISSUE 14 hardening; the old
+  dead-pid check was single-host only).  A dead claim is the tombstone
+  of a killed worker; ``recover_stale`` turns those back into
+  claimable jobs — with the job's latest snapshot attached as a
+  rescue, so the next attempt RESUMES instead of restarting
+  (``checkpoint.snapshot_info``).
 
 Job lifecycle (ISSUE 6; the legal-transition table below is enforced,
 an illegal transition is a bug, not a log line):
@@ -44,9 +50,22 @@ from __future__ import annotations
 
 import json
 import os
+import socket
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
+
+#: this process's host identity, recorded in claim files so stale-claim
+#: recovery can tell "my host, dead pid" from "another host entirely"
+HOSTNAME = socket.gethostname()
+
+#: a cross-host claim whose heartbeat mtime is older than this is dead
+#: (generous: a worker runs a background heartbeat thread touching
+#: EVERY claim it holds every few seconds — Worker._hb_loop — on top
+#: of the level-boundary ticks, so even a multi-minute compile or a
+#: light job queued behind the multi-runner stays visibly alive)
+HEARTBEAT_TIMEOUT = 300.0
 
 #: every state a job can be in
 STATES = ("queued", "admitted", "running", "done", "violated",
@@ -82,6 +101,10 @@ class Job:
     engine: str = "auto"
     kind: str = "check"   # "check" (BFS) | "sim" (fleet hunt)
     #                     # | "validate" (trace batch) | "shell"
+    #: who submitted — the fair-share scheduling unit (ISSUE 14):
+    #: deficit-round-robin pop order and weighted quotas group by this;
+    #: None is the anonymous tenant (single-user CLI traffic)
+    tenant: str = None
     flags: dict = field(default_factory=dict)
     priority: int = 0
     devices: int = 1
@@ -112,10 +135,10 @@ class Job:
 
     def to_dict(self):
         return {k: getattr(self, k) for k in (
-            "job_id", "spec", "cfg", "engine", "kind", "flags",
-            "priority", "devices", "devices_min", "devices_max",
-            "state", "seq", "attempts", "rescue", "result", "reason",
-            "submitted_ts", "updated_ts")}
+            "job_id", "spec", "cfg", "engine", "kind", "tenant",
+            "flags", "priority", "devices", "devices_min",
+            "devices_max", "state", "seq", "attempts", "rescue",
+            "result", "reason", "submitted_ts", "updated_ts")}
 
 
 class QueueError(RuntimeError):
@@ -158,9 +181,23 @@ def _fsync_append(path, rec):
 def _pid_alive(pid):
     try:
         os.kill(int(pid), 0)
-    except (OSError, ValueError):
+    except (OSError, ValueError, TypeError):
         return False
     return True
+
+
+def _locked(fn):
+    """Serialize a JobQueue method on the instance RLock — the HTTP
+    front and the multi-runner's light-job threads share one queue
+    object with the drain loop (ISSUE 14), and the in-memory fold must
+    not interleave.  Cross-PROCESS safety is unchanged: the spool's
+    O_APPEND writes and O_EXCL claim files arbitrate that."""
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
 
 
 class JobQueue:
@@ -171,7 +208,7 @@ class JobQueue:
     replays the log).  Claim files are the only non-log state, and
     they are self-healing via ``recover_stale``."""
 
-    def __init__(self, spool):
+    def __init__(self, spool, *, heartbeat_timeout=HEARTBEAT_TIMEOUT):
         self.spool = os.path.abspath(spool)
         self.log_path = os.path.join(self.spool, "jobs.jsonl")
         self.claims_dir = os.path.join(self.spool, "claims")
@@ -181,12 +218,21 @@ class JobQueue:
         for d in (self.spool, self.claims_dir, self.journals_dir,
                   self.metrics_dir, self.ckpt_dir):
             os.makedirs(d, exist_ok=True)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self._lock = threading.RLock()
         self._jobs = {}
         self._seq = 0
         self._log_pos = 0
         self.refresh()
 
+    def lock(self):
+        """The instance RLock (a context manager) for callers that
+        need several queue calls to be one atomic step against
+        sibling threads (the HTTP front's read-modify responses)."""
+        return self._lock
+
     # -- log fold ------------------------------------------------------
+    @_locked
     def refresh(self):
         """Fold any spool lines appended since the last read — how a
         long-running worker sees jobs submitted by OTHER processes
@@ -253,16 +299,20 @@ class JobQueue:
     def _cancel_marker(self, job_id):
         return os.path.join(self.claims_dir, f"{job_id}.cancel")
 
-    # -- reads ---------------------------------------------------------
+    # -- reads (locked too: the drain loop iterates these while the
+    # multi-runner's light threads fold new spool lines into _jobs) --
+    @_locked
     def jobs(self):
         return sorted(self._jobs.values(), key=lambda j: j.seq)
 
+    @_locked
     def get(self, job_id):
         job = self._jobs.get(job_id)
         if job is None:
             raise QueueError(f"unknown job {job_id!r}")
         return job
 
+    @_locked
     def stats(self):
         """Queue-level gauges: job count per state (the service's
         ``status`` verb surfaces these)."""
@@ -276,9 +326,10 @@ class JobQueue:
         return os.path.exists(self._cancel_marker(job_id))
 
     # -- mutators ------------------------------------------------------
+    @_locked
     def submit(self, spec, *, cfg=None, engine="auto", kind="check",
                flags=None, priority=0, devices=1, devices_min=None,
-               devices_max=None, job_id=None):
+               devices_max=None, tenant=None, job_id=None):
         self.refresh()
         if job_id is None:
             job_id = f"j{self._seq + 1:04d}-{uuid.uuid4().hex[:6]}"
@@ -291,7 +342,7 @@ class JobQueue:
         # decisions compare against what was asked for)
         flags.setdefault("devices_requested", int(devices))
         job = Job(job_id=job_id, spec=str(spec), cfg=cfg, engine=engine,
-                  kind=kind, flags=flags,
+                  kind=kind, tenant=tenant, flags=flags,
                   priority=int(priority), devices=int(devices),
                   devices_min=devices_min, devices_max=devices_max,
                   seq=self._seq, submitted_ts=round(time.time(), 3),
@@ -308,11 +359,12 @@ class JobQueue:
         try:
             j.write("job_submitted", job_id=job.job_id, spec=job.spec,
                     engine=job.engine, priority=job.priority,
-                    devices=job.devices)
+                    devices=job.devices, tenant=job.tenant)
         finally:
             j.close()
         return job
 
+    @_locked
     def transition(self, job_id, state, **fields):
         """Move a job to `state`, recording extra fields (attempts /
         devices / rescue / result / reason).  Raises QueueError on an
@@ -333,6 +385,7 @@ class JobQueue:
         return job
 
     # -- claims --------------------------------------------------------
+    @_locked
     def claim(self, job_id, owner="worker"):
         """Atomically claim a CLAIMABLE job: O_CREAT|O_EXCL on the
         claim file decides races; the winner transitions the job to
@@ -340,7 +393,9 @@ class JobQueue:
         ANY lost race — another holder's claim file, or the job left
         the claimable states between our look and our claim (a
         concurrent worker or a ``cancel``).  A lost race is normal
-        multi-worker traffic, never an error."""
+        multi-worker traffic, never an error.  The claim records
+        pid + worker-id (`owner`) + host, and its mtime is the
+        heartbeat ``recover_stale`` judges cross-host liveness by."""
         self.refresh()
         job = self.get(job_id)
         if job.state not in CLAIMABLE:
@@ -348,10 +403,16 @@ class JobQueue:
         path = self._claim_path(job_id)
         # write-then-LINK: the claim file appears fully written or not
         # at all, so a concurrent recover_stale can never read a
-        # half-written (pid-less) claim and mistake it for an orphan
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # half-written (pid-less) claim and mistake it for an orphan.
+        # The tmp name carries pid AND thread id: two Workers hosted
+        # by one process (threads over separate JobQueue instances —
+        # their RLocks don't protect each other) must not share a
+        # staging file, or the loser's os.link sees it already
+        # unlinked (FileNotFoundError, not the race-deciding EEXIST)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "w") as f:
             json.dump({"pid": os.getpid(), "owner": owner,
+                       "host": HOSTNAME,
                        "ts": round(time.time(), 3)}, f)
             f.flush()
             os.fsync(f.fileno())
@@ -379,18 +440,39 @@ class JobQueue:
             return None
         return job
 
-    def claim_next(self, owner="worker"):
-        """Claim the best claimable job: highest priority first, then
-        submission order (the greedy head of the bin-pack)."""
+    @_locked
+    def claim_next(self, owner="worker", order=None):
+        """Claim the best claimable job.  ``order`` is the pop-order
+        policy hook (claimable jobs -> ordered list) — the serving
+        tier passes ``FairSharePolicy.order`` (deficit round robin
+        over tenants + priority aging, ISSUE 14); without one the
+        original greedy order applies (highest priority, then
+        submission order)."""
         self.refresh()
-        order = sorted(
-            (j for j in self._jobs.values() if j.state in CLAIMABLE),
-            key=lambda j: (-j.priority, j.seq))
-        for job in order:
+        claimable = [j for j in self._jobs.values()
+                     if j.state in CLAIMABLE]
+        if order is not None:
+            ordered = order(claimable)
+        else:
+            ordered = sorted(claimable,
+                             key=lambda j: (-j.priority, j.seq))
+        for job in ordered:
             got = self.claim(job.job_id, owner=owner)
             if got is not None:
                 return got
         return None
+
+    def heartbeat(self, job_id):
+        """Touch the claim file's mtime — the liveness signal a worker
+        sends while it holds a job (every level-boundary tick and
+        every shell poll slice).  Returns False when the claim is gone
+        (job finished/requeued under us); cheap enough to call
+        unconditionally."""
+        try:
+            os.utime(self._claim_path(job_id))
+        except OSError:
+            return False
+        return True
 
     def release(self, job_id):
         for p in (self._claim_path(job_id), self._cancel_marker(job_id)):
@@ -400,6 +482,7 @@ class JobQueue:
                 pass
 
     # -- endings -------------------------------------------------------
+    @_locked
     def finish(self, job_id, state, *, result=None, reason=None):
         if state not in TERMINAL:
             raise QueueError(f"finish wants a terminal state, "
@@ -409,6 +492,7 @@ class JobQueue:
         self.release(job_id)
         return job
 
+    @_locked
     def requeue(self, job_id, *, reason, rescue=None, devices=None,
                 uncount=False):
         """running -> preempted-requeued: the job goes back on the
@@ -429,6 +513,7 @@ class JobQueue:
         self.release(job_id)
         return job
 
+    @_locked
     def cancel(self, job_id):
         """Cancel a job.  Non-running jobs cancel immediately; a
         RUNNING job gets a cancel marker the worker polls at level
@@ -452,25 +537,49 @@ class JobQueue:
         return self.finish(job_id, "cancelled", reason="cancelled")
 
     # -- crash recovery ------------------------------------------------
+    def _claim_alive(self, path):
+        """Liveness of one claim file: ``(alive, info)``.
+
+        Same-host claims are judged by their pid (authoritative and
+        instant — a dead pid is recovered without waiting out any
+        heartbeat window, exactly the old behavior).  A claim from
+        ANOTHER host has no visible pid, so its heartbeat mtime
+        decides: fresh (< ``heartbeat_timeout``) means a live worker
+        elsewhere holds the job — never steal it; stale means its host
+        died (or lost the shared filesystem) and the job is
+        recoverable.  Before ISSUE 14 the pid check ran
+        unconditionally, so a cross-host worker whose pid happened to
+        be dead *here* was wrongly declared dead."""
+        try:
+            with open(path) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            return False, {}
+        host = info.get("host")
+        if host is None or host == HOSTNAME:
+            return _pid_alive(info.get("pid")), info
+        try:
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            return False, info
+        return age < self.heartbeat_timeout, info
+
+    @_locked
     def recover_stale(self, log=None):
         """Requeue running jobs whose claiming worker died (claim file
-        missing, or its pid is gone).  The job's latest snapshot — a
-        periodic checkpoint or the rescue the dying worker managed to
-        write — is attached as the rescue handoff, so the next attempt
-        resumes bit-identically instead of restarting (the PR 4/5
-        equivalence contract)."""
+        missing, or judged dead by ``_claim_alive`` — dead pid on this
+        host, stale heartbeat from another).  The job's latest
+        snapshot — a periodic checkpoint or the rescue the dying
+        worker managed to write — is attached as the rescue handoff,
+        so the next attempt resumes bit-identically instead of
+        restarting (the PR 4/5 equivalence contract)."""
         from ..engine.checkpoint import snapshot_info
         self.refresh()
         recovered = []
         for job in list(self._jobs.values()):
             path = self._claim_path(job.job_id)
-            alive = False
-            if os.path.exists(path):
-                try:
-                    with open(path) as f:
-                        alive = _pid_alive(json.load(f).get("pid"))
-                except (OSError, ValueError):
-                    alive = False
+            alive, info = (self._claim_alive(path)
+                           if os.path.exists(path) else (False, {}))
             if job.state in CLAIMABLE and os.path.exists(path) \
                     and not alive:
                 # a worker died in the window between creating the
@@ -495,10 +604,27 @@ class JobQueue:
                 # another recovering worker got there first — a lost
                 # race, same as a lost claim
                 continue
+            # the recovery is part of the job's story: journal the
+            # requeue (the worker's own requeue path does the same),
+            # naming the dead claim's worker/host
+            from ..obs import Journal
+            jr = Journal(self.journal_path(job.job_id),
+                         run_id="svc-recover")
+            try:
+                jr.write("job_requeued", job_id=job.job_id,
+                         reason="worker-died", rescue=rescue,
+                         elapsed_s=round(
+                             time.time() - job.submitted_ts, 3),
+                         dead_worker=info.get("owner"),
+                         dead_host=info.get("host"))
+            finally:
+                jr.close()
             recovered.append(job.job_id)
             if log:
-                log(f"queue: job {job.job_id} had a dead claim; "
-                    f"requeued"
+                who = info.get("owner") or "worker"
+                where = info.get("host") or HOSTNAME
+                log(f"queue: job {job.job_id} had a dead claim "
+                    f"({who}@{where}); requeued"
                     + (f" with rescue at depth {rescue['depth']}"
                        if rescue else " (no snapshot — restart)"))
         return recovered

@@ -100,9 +100,19 @@ class Decision:
 
 
 class Scheduler:
-    def __init__(self, pool, elastic_grow=True):
+    def __init__(self, pool, elastic_grow=True, policy=None):
         self.pool = pool
         self.elastic_grow = elastic_grow
+        #: fair-share policy (tpuvsr/serve/fairshare.py) — when set,
+        #: every priority comparison below uses AGED priorities, so a
+        #: long-waiting low-priority job eventually wins preemption
+        #: decisions too, not just pop order (ISSUE 14)
+        self.policy = policy
+
+    def _prio(self, job):
+        if self.policy is not None:
+            return self.policy.effective_priority(job)
+        return job.priority
 
     # -- claim-time placement -----------------------------------------
     def alloc_for(self, job):
@@ -142,9 +152,9 @@ class Scheduler:
         waiting = sorted(
             (j for j in jobs
              if j.state in CLAIMABLE and j.job_id != running.job_id),
-            key=lambda j: (-j.priority, j.seq))
+            key=lambda j: (-self._prio(j), j.seq))
         for j in waiting:
-            if j.priority <= running.priority:
+            if self._prio(j) <= self._prio(running):
                 break
             new = cur
             if j.devices > self.pool.total - cur and running.elastic:
@@ -159,10 +169,10 @@ class Scheduler:
             if new < cur:
                 return Decision("shrink", new,
                                 f"make room for {j.job_id} "
-                                f"(priority {j.priority})")
+                                f"(priority {self._prio(j)})")
             return Decision("yield", cur,
                             f"yield to {j.job_id} "
-                            f"(priority {j.priority})")
+                            f"(priority {self._prio(j)})")
         if self.elastic_grow and running.elastic:
             lo, hi = self._bounds(running)
             requested = int(running.flags.get("devices_requested")
@@ -170,7 +180,7 @@ class Scheduler:
             # reserve capacity for everything still waiting at >= our
             # priority before taking the rest of the pool
             reserved = sum(j.devices for j in waiting
-                           if j.priority >= running.priority)
+                           if self._prio(j) >= self._prio(running))
             room = max(1, self.pool.total - reserved)
             if running.engine == "sharded":
                 room = pow2_floor(room)
@@ -187,7 +197,7 @@ class Scheduler:
         free = self.pool.free
         placed, waiting = [], []
         for j in sorted((j for j in jobs if j.state in CLAIMABLE),
-                        key=lambda j: (-j.priority, j.seq)):
+                        key=lambda j: (-self._prio(j), j.seq)):
             need = self.alloc_for(j)
             if need <= free:
                 placed.append((j.job_id, need))

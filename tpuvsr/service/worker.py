@@ -42,11 +42,23 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
+import threading
 import time
 
 from ..exitcodes import EX_RESUMABLE, job_state
 from ..obs import Journal, RunObserver
 from .scheduler import DevicePool, Scheduler, advise_backend
+
+# NOTE: the serving-tier pieces (fair-share policy, multi-runner) live
+# in the HIGHER tpuvsr/serve layer and are imported lazily inside the
+# Worker — the default policy/lane wiring lives here for one-stop
+# construction, but `import tpuvsr.service` must not eagerly drag the
+# serving tier in (the dependency arrow stays serve -> service)
+
+
+def _is_light(job):
+    from ..serve.multirunner import is_light
+    return is_light(job)
 
 
 # the ONE trace serializer (engine/trace.py), re-exported under the
@@ -111,14 +123,42 @@ class Worker:
 
     def __init__(self, queue, *, devices=None, scheduler=None,
                  log=None, on_level=None, owner=None, poll=0.25,
-                 bench_dir=None, tpu_devices=0, shell_retry_gate=None):
+                 bench_dir=None, tpu_devices=0, shell_retry_gate=None,
+                 policy="auto", light_threads=2,
+                 hb_journal_every=30.0):
         self.queue = queue
         if devices is None:
             import jax
             devices = len(jax.devices())
+        # fair-share pop order (ISSUE 14): "auto" builds the default
+        # deficit-round-robin + aging policy; None reverts to the
+        # original priority-then-seq order
+        if policy == "auto":
+            from ..serve.fairshare import FairSharePolicy
+            policy = FairSharePolicy()
+        self.policy = policy
         self.pool = (scheduler.pool if scheduler
                      else DevicePool(devices))
-        self.scheduler = scheduler or Scheduler(self.pool)
+        self.scheduler = scheduler or Scheduler(self.pool,
+                                                policy=self.policy)
+        # the light-job side lane (ISSUE 14): shell / interp-validate /
+        # lint-only jobs run on threads with a zero-device allocation
+        # while this worker's mesh job keeps running; 0 disables
+        if light_threads:
+            from ..serve.multirunner import MultiRunner
+            self.multirunner = MultiRunner(self, threads=light_threads)
+        else:
+            self.multirunner = None
+        self.hb_journal_every = hb_journal_every
+        self._last_hb = 0.0
+        # every claim this worker currently holds, heartbeated by a
+        # background thread — the level-boundary tick alone cannot
+        # cover a multi-minute first compile or a light job waiting in
+        # the multi-runner's backlog, and a silent claim looks DEAD to
+        # a cross-host recover_stale after heartbeat_timeout
+        self._held = set()
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
         self.on_level = on_level
         self.owner = owner or f"worker-{os.getpid()}"
         self.poll = poll
@@ -141,6 +181,30 @@ class Worker:
     def log(self, msg):
         if self._log:
             self._log(f"service: {msg}")
+
+    # -- claim heartbeats ----------------------------------------------
+    def _hb_loop(self, interval):
+        while not self._hb_stop.wait(interval):
+            for jid in list(self._held):
+                self.queue.heartbeat(jid)
+
+    def _hold(self, job_id):
+        """Track a held claim and make sure the heartbeat thread is
+        alive — from here until ``_release_hold`` the claim mtime
+        stays fresh no matter what the job is doing (compiling,
+        queued behind the light lane, mid-subprocess)."""
+        self._held.add(job_id)
+        if self._hb_thread is None or not self._hb_thread.is_alive():
+            timeout = getattr(self.queue, "heartbeat_timeout", 300.0)
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop,
+                args=(max(1.0, min(15.0, timeout / 10.0)),),
+                name="tpuvsr-heartbeat", daemon=True)
+            self._hb_thread.start()
+
+    def _release_hold(self, job_id):
+        self._held.discard(job_id)
 
     def _journal(self, job, event, **fields):
         """Append one job_* event to the JOB'S OWN journal (the same
@@ -265,6 +329,15 @@ class Worker:
 
     # -- the level-boundary tick ---------------------------------------
     def _tick(self, job, depth):
+        # heartbeat FIRST, even when a preemption is already pending:
+        # the claim-file mtime is what keeps a cross-host
+        # recover_stale from declaring this worker dead (ISSUE 14)
+        self.queue.heartbeat(job.job_id)
+        if self.hb_journal_every and \
+                time.time() - self._last_hb >= self.hb_journal_every:
+            self._last_hb = time.time()
+            self._journal(job, "worker_heartbeat", worker=self.owner,
+                          depth=int(depth))
         if self._preempt_sent:
             return
         from ..resilience.supervisor import request_preemption
@@ -305,12 +378,106 @@ class Worker:
             if job.kind == "sim":
                 return self._run_sim(job)
             if job.kind == "validate":
+                if _is_light(job):
+                    return self._run_validate_interp(job)
                 return self._run_validate(job)
+            if _is_light(job):
+                return self._run_lint_only(job)
             return self._run_check(job)
         finally:
+            self._release_hold(job.job_id)
             self.pool.release(job.job_id)
             self._current = None
             self._specs.pop(job.job_id, None)
+
+    def run_one_light(self, job):
+        """Run one LIGHT job (shell / interp validate / lint-only) —
+        the multi-runner's thread entry.  Touches none of the per-job
+        preemption fields ``run_one`` owns, so it is safe beside a
+        concurrently running mesh job; any unexpected error fails the
+        JOB, never the thread pool."""
+        from .queue import QueueError
+        try:
+            if job.kind == "shell":
+                self._run_shell(job)
+            elif job.kind == "validate":
+                self._run_validate_interp(job)
+            elif job.kind == "check" and job.flags.get("lint_only"):
+                self._run_lint_only(job)
+            else:
+                self._finish(job, "failed",
+                             reason="not-a-light-job (multi-runner "
+                                    "dispatch bug)")
+        except QueueError:
+            pass                  # lost race against a sibling worker
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            try:
+                self._finish(job, "failed",
+                             reason=f"light-runner: "
+                                    f"{type(e).__name__}: {e}")
+            except QueueError:
+                pass
+        finally:
+            self._release_hold(job.job_id)
+            self.pool.release(job.job_id)
+            self._specs.pop(job.job_id, None)
+
+    # -- light jobs (the multi-runner lane, ISSUE 14) ------------------
+    def _run_validate_interp(self, job):
+        """``kind="validate"`` + ``flags.interp``: the interpreter
+        reference validator (``tpuvsr/validate/host.py``) — pure
+        Python, zero devices, safe on the multi-runner threads.  The
+        full nondeterminism handling is identical to the batch
+        engine's (the batch engine cross-checks against THIS path), so
+        verdicts match the device run bit-for-bit."""
+        from ..validate import host_validate_batch, load_traces
+        from ..validate.batch import validate_result_summary
+        spec = self._specs.get(job.job_id) or self._load_spec(job)
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=0, backend="cpu",
+                      placement="light: interpreter validator "
+                                "(multi-runner)")
+        try:
+            traces_path = job.flags.get("traces")
+            if not traces_path:
+                raise ValueError("validate jobs need flags.traces "
+                                 "(the TRACE.jsonl path)")
+            traces = load_traces(traces_path, spec)
+            res = host_validate_batch(spec, traces, log=self._log)
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self._finish(job, "failed",
+                         reason=f"job-setup: {type(e).__name__}: {e}")
+            return
+        state = ("failed" if res.error
+                 else "violated" if res.divergences else "done")
+        self._finish(job, state, result=validate_result_summary(res),
+                     reason=res.error)
+
+    def _run_lint_only(self, job):
+        """``kind="check"`` + ``flags.lint_only``: a speclint report
+        job — the analyzer already gated admission, so by the time
+        this runs the spec is clean; the "run" publishes the full
+        report as the job result.  Zero devices, zero jax."""
+        from ..analysis import run_lint
+        spec = self._specs.get(job.job_id) or self._load_spec(job)
+        self._journal(job, "job_started", attempt=job.attempts,
+                      devices=0, backend="cpu",
+                      placement="light: speclint report "
+                                "(multi-runner)")
+        try:
+            report = run_lint(spec)
+        except Exception as e:  # noqa: BLE001 — a job, not the worker
+            self._finish(job, "failed",
+                         reason=f"job-setup: {type(e).__name__}: {e}")
+            return
+        findings = [f"{f.passname}: {f.message}"
+                    for f in (report.errors + report.warnings)]
+        state = "failed" if report.exit_code else "done"
+        self._finish(job, state,
+                     result={"speclint": findings,
+                             "errors": len(report.errors),
+                             "warnings": len(report.warnings)},
+                     reason="speclint" if report.exit_code else None)
 
     def _finish(self, job, state, **kw):
         self.queue.finish(job.job_id, state, **kw)
@@ -596,9 +763,11 @@ class Worker:
         env = dict(os.environ)
         env.update(flags.get("env") or {})
         cwd = flags.get("cwd")
-        self.pool.alloc(job.job_id, self.scheduler.alloc_for(job))
+        # shell jobs are LIGHT (ISSUE 14): they spend their life in a
+        # subprocess wait, so they hold a zero-device allocation and
+        # never count against the mesh
         self._journal(job, "job_started", attempt=job.attempts,
-                      devices=job.devices)
+                      devices=0)
         t0 = time.time()
         cancelled = False
         try:
@@ -624,6 +793,9 @@ class Worker:
                         out, _ = p.communicate()
                         rc = -9
                         break
+                    # the poll slice doubles as the heartbeat (shell
+                    # jobs have no level boundaries to tick at)
+                    self.queue.heartbeat(job.job_id)
                     self.queue.refresh()
                     if not cancelled and \
                             self.queue.cancel_requested(job.job_id):
@@ -679,33 +851,85 @@ class Worker:
     def drain(self, *, max_jobs=None, max_seconds=None,
               idle_exit=True):
         """Process jobs until the queue has nothing claimable (or the
-        bounds hit).  Returns the number of job runs executed."""
+        bounds hit).  Returns the number of job runs executed.
+
+        With the multi-runner enabled, LIGHT jobs (shell /
+        interp-validate / lint-only) are handed to the thread-pool
+        side lane and the loop immediately claims again, so one worker
+        keeps its mesh busy while light jobs drain beside it; the loop
+        never exits while a light job is still in flight (its claim
+        must settle)."""
+        from .queue import CLAIMABLE
         t0 = time.time()
         runs = 0
-        while True:
-            if max_jobs is not None and runs >= max_jobs:
-                break
-            if max_seconds is not None \
-                    and time.time() - t0 >= max_seconds:
-                break
-            self.queue.recover_stale(log=self._log)
-            self.admit_pending()
-            # evict cached specs of jobs this worker will never run
-            # (cancelled before claim, drained by another worker) —
-            # the cache must not grow with the spool's history
-            for jid in list(self._specs):
-                j = self.queue._jobs.get(jid)
-                if j is None or j.state not in (
-                        "admitted", "preempted-requeued", "running"):
-                    self._specs.pop(jid, None)
-            job = self.queue.claim_next(owner=self.owner)
-            if job is None:
-                if idle_exit:
+        try:
+            while True:
+                if max_jobs is not None and runs >= max_jobs:
                     break
-                time.sleep(self.poll)
-                continue
-            runs += 1
-            self.run_one(job)
-            if self._shutdown:
-                break
+                if max_seconds is not None \
+                        and time.time() - t0 >= max_seconds:
+                    break
+                self.queue.recover_stale(log=self._log)
+                self.admit_pending()
+                # evict cached specs of jobs this worker will never
+                # run (cancelled before claim, drained by another
+                # worker) — the cache must not grow with the spool's
+                # history
+                for jid in list(self._specs):
+                    j = self.queue._jobs.get(jid)
+                    if j is None or j.state not in (
+                            "admitted", "preempted-requeued",
+                            "running"):
+                        self._specs.pop(jid, None)
+                base_order = (self.policy.order if self.policy
+                              else (lambda jobs: sorted(
+                                  jobs,
+                                  key=lambda j: (-j.priority, j.seq))))
+                order = base_order
+                if self.multirunner is not None and \
+                        self.multirunner.inflight() >= \
+                        self.multirunner.threads:
+                    # light lane saturated: skip light jobs so they
+                    # stay claimable for pool siblings instead of
+                    # queueing (un-started but claimed) behind OUR
+                    # two threads
+                    def order(jobs, _base=base_order):
+                        return [j for j in _base(jobs)
+                                if not _is_light(j)]
+                job = self.queue.claim_next(owner=self.owner,
+                                            order=order)
+                if job is None:
+                    if self.multirunner is not None \
+                            and self.multirunner.inflight():
+                        time.sleep(self.poll)
+                        continue
+                    if idle_exit:
+                        break
+                    time.sleep(self.poll)
+                    continue
+                self._hold(job.job_id)
+                if self.policy is not None:
+                    # charge the fair-share ledger for the REAL claim
+                    # and journal why this job won the pop (the
+                    # sched_decision audit trail, SCHEMA.md)
+                    waiting = [j for j in self.queue.jobs()
+                               if j.state in CLAIMABLE]
+                    self.policy.charge(job, waiting)
+                    self._journal(job, "sched_decision",
+                                  worker=self.owner,
+                                  **self.policy.explain(job))
+                runs += 1
+                if self.multirunner is not None and _is_light(job):
+                    self.multirunner.submit(job)
+                    continue
+                self.run_one(job)
+                if self._shutdown:
+                    break
+        finally:
+            if self.multirunner is not None:
+                self.multirunner.close()
+            self._hb_stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(5)
+                self._hb_thread = None
         return runs

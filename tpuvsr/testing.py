@@ -582,6 +582,87 @@ def bad_counter_spec():
                      parse_cfg_text(COUNTER_CFG))
 
 
+def subprocess_env(extra=None):
+    """The hermetic environment for tpuvsr child processes in tests
+    and drills: ``serve.pool.child_env``'s PYTHONPATH setup plus the
+    test-only CPU forcing — CPU backend (the image's sitecustomize
+    registers a tunneled-TPU plugin whose backend init hangs when the
+    tunnel is down) and 8 virtual devices.  Shared by the
+    multiprocessing claim-race harness, ``scripts/serve_demo.py`` and
+    ``scripts/fault_matrix.py``."""
+    from .serve.pool import child_env
+    env = child_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    env.update(extra or {})
+    return env
+
+
+def true_argv():
+    """The cheapest possible shell-job argv on this machine — shared
+    by the serve tests and drills (one copy to patch for platforms
+    without /bin/true)."""
+    import os
+    import sys as _sys
+    if os.path.exists("/bin/true"):
+        return ["/bin/true"]
+    return [_sys.executable, "-c", "pass"]
+
+
+#: the claim-racer child: loops ``claim_next`` over one spool until
+#: nothing is claimable, finishing every claim as done — deliberately
+#: importing ONLY the jax-free queue module, so racers start in
+#: milliseconds and the race is tight.  The small sleep per claim
+#: keeps a racer with an interpreter-startup head start from sweeping
+#: the whole queue before its siblings issue their first claim (the
+#: drill asserts the race actually overlapped).
+_CLAIM_RACER = """\
+import json, sys, time
+from tpuvsr.service.queue import JobQueue
+q = JobQueue(sys.argv[1])
+owner = sys.argv[2]
+got = []
+while True:
+    job = q.claim_next(owner=owner)
+    if job is None:
+        break
+    q.finish(job.job_id, "done")
+    got.append(job.job_id)
+    time.sleep(0.02)
+print(json.dumps(got))
+"""
+
+
+def claim_race(spool, workers=3, timeout=120):
+    """The multi-process claim drill (ISSUE 14 satellite): spawn
+    `workers` concurrent subprocesses racing ``claim_next`` over one
+    spool; returns ``{owner: [job_id, ...]}`` of what each actually
+    claimed.  The caller asserts exactly-once: the union covers every
+    job, the owners' lists are disjoint."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    env = subprocess_env()
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, "-c", _CLAIM_RACER, spool, f"racer-{i}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for i in range(workers)]
+    out = {}
+    for i, p in enumerate(procs):
+        stdout, stderr = p.communicate(timeout=timeout)
+        if p.returncode != 0:
+            raise RuntimeError(f"claim racer {i} died rc="
+                               f"{p.returncode}: {stderr[-500:]}")
+        out[f"racer-{i}"] = _json.loads(stdout)
+    return out
+
+
 def stub_service_factory(spec, inv_bound=None, inv_x_bound=None,
                          **engine_kw):
     """The dispatch-service engine factory over the stub kernel: one
