@@ -587,6 +587,19 @@ def main():
         RESULT["validate_batch"] = vd.get("batch")
         RESULT["validate_traces"] = vd.get("traces")
         RESULT["validate_ok"] = bool(vd.get("ok"))
+    # streamed liveness headline (ISSUE 15): edge count, emission
+    # rate and graph-construction overhead of the liveness_speedup
+    # A/B's largest pin lifted to the round-doc top level, so
+    # scripts/compare_bench.py's gate_liveness diffs rounds directly
+    # (streamed-vs-two-pass mode mismatches are advisory)
+    ls = RESULT.get("liveness_speedup")
+    if isinstance(ls, dict) and ls.get("edges_per_s") is not None:
+        RESULT["edges"] = ls.get("edges")
+        RESULT["edges_per_s"] = ls.get("edges_per_s")
+        RESULT["graph_overhead_ratio"] = ls.get(
+            "graph_overhead_ratio")
+        RESULT["liveness_check_s"] = ls.get("check_s")
+        RESULT["liveness_mode"] = ls.get("mode")
     hr = RESULT.get("defect_hunt")
     if isinstance(hr, dict) and hr.get("split_enabled") is not None:
         RESULT["hunt_split_enabled"] = bool(hr.get("split_enabled"))

@@ -274,6 +274,75 @@ def gate_symmetry(base_doc, cand_doc, max_regression):
     return rc
 
 
+def liveness_stats(doc):
+    """Liveness-path health of a document (ISSUE 15):
+    ``(edges_per_s, check_s, mode, overhead)`` or all-None.  Reads
+    the round doc's ``liveness_speedup`` attachment / lifted
+    top-level keys, a raw ``liveness_speedup.json``, or a liveness
+    metrics doc's gauges."""
+    if not isinstance(doc, dict):
+        return None, None, None, None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    ls = doc.get("liveness_speedup") \
+        if isinstance(doc.get("liveness_speedup"), dict) else None
+    if ls is None and "edges_per_s" in doc:
+        ls = doc
+    if ls is not None and ls.get("edges_per_s") is not None:
+        # bench.py lifts the headline under liveness_-prefixed names
+        # (check_s/mode are too generic at the round-doc top level)
+        return (float(ls["edges_per_s"]),
+                ls.get("check_s", ls.get("liveness_check_s")),
+                ls.get("mode", ls.get("liveness_mode")),
+                ls.get("graph_overhead_ratio"))
+    m = find_metrics(doc)
+    if m is not None and "edges_per_s" in m.get("gauges", {}):
+        g = m["gauges"]
+        return (float(g["edges_per_s"]), g.get("check_s"),
+                g.get("graph_mode"), g.get("graph_overhead_ratio"))
+    return None, None, None, None
+
+
+def gate_liveness(base_doc, cand_doc, max_regression):
+    """The liveness regression gate (ISSUE 15): 0 ok/advisory/absent,
+    1 when — at matching graph-construction modes — the candidate's
+    ``edges_per_s`` DROPPED or its ``check_s`` GREW beyond tolerance
+    (check_s is a cost: the gate direction inverts, like bytes/state).
+    A mode mismatch (streamed vs two-pass docs) measures different
+    construction paths — advisory, like pipeline depth."""
+    be, bc, bm_, _bo = liveness_stats(base_doc)
+    ce, cc, cm_, _co = liveness_stats(cand_doc)
+    if be is None or ce is None:
+        return 0
+    print(f"edges_per_s: baseline {be:.1f} -> candidate {ce:.1f}"
+          f"  [{fmt_delta(be, ce)}]")
+    advisory = False
+    if bm_ is not None and cm_ is not None and bm_ != cm_:
+        advisory = True
+        print(f"  liveness mode: {bm_} -> {cm_} (different graph-"
+              f"construction paths — comparison is advisory)")
+    rc = 0
+    if be > 0 and ce < be * (1.0 - max_regression / 100.0):
+        if advisory:
+            print(f"compare_bench: edges/s drop beyond "
+                  f"{max_regression:.1f}% tolerance, but the modes "
+                  f"differ — advisory, not a regression",
+                  file=sys.stderr)
+        else:
+            print(f"compare_bench: edges/s REGRESSION beyond "
+                  f"{max_regression:.1f}% tolerance", file=sys.stderr)
+            rc = 1
+    if bc is not None and cc is not None:
+        print(f"liveness check_s: baseline {bc} -> candidate {cc}"
+              f"  [{fmt_delta(bc, cc)}]")
+        if bc > 0 and cc > bc * (1.0 + max_regression / 100.0) \
+                and not advisory:
+            print(f"compare_bench: liveness check_s GREW beyond "
+                  f"{max_regression:.1f}% tolerance", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("baseline")
@@ -403,7 +472,11 @@ def main(argv=None):
     # distinct-state growth fail at matching symmetry modes;
     # symmetry-mode mismatches are advisory
     sym_rc = gate_symmetry(base_doc, cand_doc, args.max_regression)
-    sim_rc = (sim_rc or val_rc or pack_rc or sym_rc
+    # the liveness path likewise (ISSUE 15): edges/s drops and
+    # check_s growth fail at matching graph-construction modes;
+    # streamed-vs-two-pass mismatches are advisory
+    liv_rc = gate_liveness(base_doc, cand_doc, args.max_regression)
+    sim_rc = (sim_rc or val_rc or pack_rc or sym_rc or liv_rc
               or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):

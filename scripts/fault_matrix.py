@@ -76,6 +76,12 @@ reference mount, no TPU, seconds on the CPU backend:
                      the queue, and the resumed attempt's divergence
                      report is bit-identical to an undisturbed oracle
                      job's
+  kill-liveness-resume  SIGTERM mid-graph-build on a STREAMED temporal
+                     run (ISSUE 15: edges flowing out of the fused
+                     commit) -> rescue snapshot carrying gid column +
+                     edge rows + retained levels; the resumed run's
+                     CSR, verdict and lasso trace are bit-identical
+                     to an uninterrupted oracle's
 
 Prints one JSON object; exit 0 iff every scenario passed.  Run by
 tests/test_resilience.py under tier-1 and standalone:
@@ -979,6 +985,68 @@ def scenario_kill_validate_resume(tmp):
     }
 
 
+def scenario_kill_liveness_resume(tmp):
+    """ISSUE 15 satellite: SIGTERM-kill mid-graph-build on a STREAMED
+    temporal run (the behavior graph flowing out of the fused commit)
+    -> rescue snapshot carrying the gid column, the drained edge rows
+    and the retained level blocks; the resumed run completes with a
+    CSR, verdict and lasso trace bit-identical to an uninterrupted
+    oracle's."""
+    from tpuvsr.engine.device_liveness import DeviceGraph
+    from tpuvsr.engine.liveness import liveness_check
+    from tpuvsr.obs import RunObserver
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import (canon_csr, stub_ticker_factory,
+                                ticker_spec)
+    spec = ticker_spec(modulus=8)        # 16 states, 9 levels
+    kw = dict(tile_size=2, chunk_tiles=1, next_capacity=16,
+              fpset_capacity=1 << 8, hash_mode="full",
+              model_factory=stub_ticker_factory(modulus=8))
+    canon = canon_csr
+    oracle = DeviceGraph(spec, mode="stream", **kw)
+    r_o = liveness_check(spec, graph=oracle)
+
+    ck = os.path.join(tmp, "liveness-ck")
+    jp = os.path.join(tmp, "liveness.jsonl")
+    faults.install("kill@level=4")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                DeviceGraph(spec, mode="stream", checkpoint_path=ck,
+                            obs=RunObserver(journal_path=jp), **kw)
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    if preempted is None:
+        return {"ok": False, "why": "no Preempted raised"}
+    g2 = DeviceGraph(spec, mode="stream", resume_from=ck, **kw)
+    r2 = liveness_check(spec, graph=g2)
+    ev = _events(jp)
+
+    def trace(r):
+        return [(e.action_name, e.state) for e in r.trace]
+    return {
+        "ok": (preempted.depth == 4
+               and g2.n == oracle.n
+               and canon(g2) == canon(oracle)
+               and all(g2.states[s] == oracle.states[s]
+                       for s in range(g2.n))
+               and (r2.ok, r2.property_name) == (r_o.ok,
+                                                 r_o.property_name)
+               and trace(r2) == trace(r_o)
+               and r2.cycle_start == r_o.cycle_start
+               and "rescue_checkpoint" in ev and "fault" in ev),
+        "rescue_depth": preempted.depth,
+        "states": g2.n,
+        "edges": int(g2.csr[1].shape[0]),
+        "verdict_ok": r2.ok,
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -1001,6 +1069,7 @@ SCENARIOS = [
     ("sim-oom-shrink", scenario_sim_oom_shrink),
     ("kill-hunt-resume", scenario_kill_hunt_resume),
     ("kill-validate-resume", scenario_kill_validate_resume),
+    ("kill-liveness-resume", scenario_kill_liveness_resume),
 ]
 
 

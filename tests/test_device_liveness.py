@@ -1,21 +1,265 @@
-"""Device-built behavior graph tests (VERDICT r3 item 3): the graph
-constructed by the device engines (paged BFS enumeration + jitted edge
-pass) must be isomorphic to the interpreter-built graph, and liveness
-verdicts through it must match the corpus oracle.
+"""Device-built behavior graph tests.
+
+Two construction paths exist since ISSUE 15 — the STREAMED single
+pass (edges flow out of the fused commit's stage 3 into a gid-valued
+FPSet + device append buffer + incremental host CSR builder) and the
+historical TWO-PASS retained-levels + re-expansion body, kept as the
+bit-identity oracle.  The tier-1 battery (stub Ticker harness, no
+reference mount) holds the two device paths and the interpreter
+reference to: identical CSR modulo edge order within a source's
+segment, identical gid order (per-gid states equal), identical
+verdicts and cycle traces — across tile sizes, growth pauses
+mid-level, duplicate-heavy graphs, both commit modes, and the
+rescue/resume seam.
 """
+
 
 import pytest
 
-from tests.conftest import REFERENCE, requires_reference, vsr_spec
+from tests.conftest import REFERENCE, requires_reference
+from tpuvsr.core.values import TLAError
 from tpuvsr.engine.device_liveness import DeviceGraph
 from tpuvsr.engine.liveness import build_graph, liveness_check
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_file
 from tpuvsr.frontend.parser import parse_module_file
+from tpuvsr.testing import canon_csr, stub_ticker_factory, ticker_spec
 
-pytestmark = requires_reference
+MOD = 6          # 12 reachable states, dup-heavy wrap edges
 
 
+def _graph_kw(**over):
+    kw = dict(tile_size=4, chunk_tiles=2, next_capacity=32,
+              fpset_capacity=1 << 8, hash_mode="full",
+              model_factory=stub_ticker_factory(modulus=MOD))
+    kw.update(over)
+    return kw
+
+
+def lasso(res):
+    return ([(e.action_name, e.state) for e in res.trace],
+            res.cycle_start)
+
+
+@pytest.fixture(scope="module")
+def tick_spec():
+    return ticker_spec(modulus=MOD)
+
+
+@pytest.fixture(scope="module")
+def g_stream(tick_spec):
+    return DeviceGraph(tick_spec, mode="stream", **_graph_kw())
+
+
+@pytest.fixture(scope="module")
+def g_two_pass(tick_spec):
+    return DeviceGraph(tick_spec, mode="two-pass", **_graph_kw())
+
+
+@pytest.fixture(scope="module")
+def interp_graph(tick_spec):
+    return build_graph(tick_spec)
+
+
+# ---------------------------------------------------------------------
+# streamed == two-pass == interpreter (tier-1, stub harness)
+# ---------------------------------------------------------------------
+def test_streamed_csr_matches_two_pass(g_stream, g_two_pass):
+    assert g_stream.mode == "stream"
+    assert g_two_pass.mode == "two-pass"
+    assert g_stream.n == g_two_pass.n == 2 * MOD
+    assert g_stream.inits == g_two_pass.inits == [0]
+    # gid order identical (both are BFS commit order) — every gid
+    # names the SAME state in both graphs
+    for sid in range(g_stream.n):
+        assert g_stream.states[sid] == g_two_pass.states[sid]
+    assert canon_csr(g_stream) == canon_csr(g_two_pass)
+
+
+def test_streamed_isomorphic_to_interpreter(tick_spec, g_stream,
+                                            interp_graph):
+    istates, iedges, iinits = interp_graph
+    assert len(istates) == g_stream.n
+    ikey = {s: tick_spec.view_value(st)
+            for s, st in enumerate(istates)}
+    dkey = {s: tick_spec.view_value(g_stream.states[s])
+            for s in range(g_stream.n)}
+    d_of = {k: s for s, k in dkey.items()}
+    assert {ikey[s] for s in iinits} == \
+        {dkey[s] for s in g_stream.inits}
+    names = g_stream.kern.action_names
+    indptr, aid, tid = g_stream.csr
+    for sid, elist in enumerate(iedges):
+        want = sorted((a, d_of[ikey[t]]) for a, t in elist)
+        u = d_of[ikey[sid]]
+        got = sorted((names[int(aid[j])], int(tid[j]))
+                     for j in range(indptr[u], indptr[u + 1]))
+        assert want == got, f"edges differ at interp sid {sid}"
+
+
+def test_verdicts_and_lassos_identical(tick_spec, g_stream,
+                                       g_two_pass):
+    rs = liveness_check(tick_spec, graph=g_stream)
+    rt = liveness_check(tick_spec, graph=g_two_pass)
+    ri = liveness_check(tick_spec)
+    # the stoppable ticker violates []<>AtZero by a fair stuttering
+    # lasso (Tick disabled at stopped states) on every path
+    assert rs.ok is rt.ok is ri.ok is False
+    assert rs.property_name == rt.property_name == ri.property_name \
+        == "AlwaysEventuallyZero"
+    assert lasso(rs) == lasso(rt)
+    assert lasso(rs) == lasso(ri)
+
+
+def test_stop_free_property_holds():
+    spec = ticker_spec(modulus=3, stop=False)
+    g = DeviceGraph(
+        spec, mode="stream",
+        **_graph_kw(model_factory=stub_ticker_factory(modulus=3,
+                                                      stop=False)))
+    res = liveness_check(spec, graph=g)
+    assert res.ok
+    assert liveness_check(spec).ok
+
+
+@pytest.mark.parametrize("over", [
+    # tile-size sweep: tiles straddle level boundaries differently
+    dict(tile_size=2, chunk_tiles=1),
+    # growth pauses mid-level: tiny edge buffer (R_EDGE_FLUSH), tiny
+    # FPSet (R_FPSET_GROW mid-run), tiny next buffer (spills)
+    dict(edge_capacity=16, fpset_capacity=1 << 4,
+         next_capacity=1 << 4),
+    # the per-action commit body emits through the same seam
+    dict(commit="per-action", edge_capacity=16),
+], ids=["tile2", "tiny-buffers", "per-action"])
+def test_streamed_equivalence_battery(tick_spec, g_stream, over):
+    eng_kw = _graph_kw(**over)
+    g = DeviceGraph(tick_spec, mode="stream", **eng_kw)
+    assert g.n == g_stream.n
+    assert canon_csr(g) == canon_csr(g_stream)
+    for sid in range(g.n):
+        assert g.states[sid] == g_stream.states[sid]
+
+
+# ---------------------------------------------------------------------
+# rescue seam (ISSUE 15): kill mid-graph-build, resume bit-identical
+# ---------------------------------------------------------------------
+def test_edge_stream_rescue_seam(tmp_path):
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import Preempted, PreemptionGuard
+    spec = ticker_spec(modulus=8)       # 16 states, 9 levels
+    kw = _graph_kw(tile_size=2, chunk_tiles=1, next_capacity=16,
+                   model_factory=stub_ticker_factory(modulus=8))
+    oracle = DeviceGraph(spec, mode="stream", **kw)
+    r_o = liveness_check(spec, graph=oracle)
+
+    ck = str(tmp_path / "ck")
+    faults.install("kill@level=4")
+    preempted = None
+    try:
+        with PreemptionGuard():
+            try:
+                DeviceGraph(spec, mode="stream", checkpoint_path=ck,
+                            **kw)
+            except Preempted as p:
+                preempted = p
+    finally:
+        faults.clear()
+    assert preempted is not None and preempted.depth == 4
+
+    g2 = DeviceGraph(spec, mode="stream", resume_from=ck, **kw)
+    assert g2.n == oracle.n
+    assert canon_csr(g2) == canon_csr(oracle)
+    for sid in range(g2.n):
+        assert g2.states[sid] == oracle.states[sid]
+    r2 = liveness_check(spec, graph=g2)
+    assert (r2.ok, r2.property_name) == (r_o.ok, r_o.property_name)
+    assert lasso(r2) == lasso(r_o)
+
+
+def test_resume_plain_snapshot_with_edges_refused(tmp_path):
+    """A snapshot written WITHOUT the edge stream has no gid column —
+    resuming it with edges on must be a loud policy error (mirrors
+    the pack/canon/bounds rules), never a silent gid-less graph."""
+    from tpuvsr.testing import stub_graph_engine
+    ck = str(tmp_path / "ck")
+    eng = stub_graph_engine(modulus=8, edges=False, tile_size=2,
+                            chunk_tiles=1)
+    eng.run(max_depth=4, checkpoint_path=ck)
+    eng2 = stub_graph_engine(modulus=8, edges=True, tile_size=2,
+                             chunk_tiles=1)
+    with pytest.raises(TLAError, match="without the edge stream"):
+        eng2.run(resume_from=ck)
+
+
+# ---------------------------------------------------------------------
+# seams and policy
+# ---------------------------------------------------------------------
+def test_edges_require_symmetry_off():
+    from tpuvsr.engine.paged_bfs import PagedBFS
+    from tpuvsr.testing import stub_sym_factory, sym_pair_spec
+    with pytest.raises(TLAError, match="symmetry off"):
+        PagedBFS(sym_pair_spec(), model_factory=stub_sym_factory(),
+                 hash_mode="full", tile_size=4, retain_levels=True,
+                 edges=True)
+
+
+def test_edge_flush_journal_and_gauges(tmp_path):
+    """The obs surface (ISSUE 15 satellite): edge_flush events are
+    schema-valid, run_start carries edges=true, and the
+    edges_per_s / edge_bytes / edge_buf_high_water gauges land in the
+    metrics doc."""
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.testing import stub_graph_engine
+    jp = str(tmp_path / "j.jsonl")
+    eng = stub_graph_engine(modulus=8, edge_capacity=16, tile_size=2,
+                            chunk_tiles=1)
+    res = eng.run(obs=RunObserver(journal_path=jp))
+    assert res.ok
+    ev = read_journal(jp)          # validates every line
+    kinds = [e["event"] for e in ev]
+    assert "edge_flush" in kinds
+    fl = [e for e in ev if e["event"] == "edge_flush"]
+    assert all(e["bytes"] == 12 * e["rows"] for e in fl)
+    assert sum(e["rows"] for e in fl) == eng.edge_sink.rows
+    start = next(e for e in ev if e["event"] == "run_start")
+    assert start["edges"] is True
+    g = res.metrics["gauges"]
+    assert g["edge_bytes"] == 12 * eng.edge_sink.rows
+    assert 0 < g["edge_buf_high_water"] <= eng.edge_cap
+    assert g["edges_per_s"] > 0
+    assert res.metrics["counters"]["edge_rows"] == eng.edge_sink.rows
+
+
+def test_graph_overhead_ratio_acceptance_proxy(g_stream, g_two_pass):
+    """The ISSUE 15 acceptance, on the tier-1 stub proxy: graph
+    construction beyond the safety BFS itself is <= 25% of the BFS
+    wall-clock on the streamed path (the two-pass path's re-expansion
+    is the ~100%+ cost the tentpole deletes; asserting it as a lower
+    bound here would be timing-flaky, so only the streamed ceiling is
+    gated)."""
+    assert g_stream.graph_overhead_ratio <= 0.25, \
+        g_stream.graph_overhead_ratio
+    assert g_stream.edges_per_s > 0
+
+
+def test_engine_reuse_hands_over_streamed_csr(tick_spec):
+    """The CLI seam: a finished edges-on engine run is reused without
+    re-running anything — the DeviceGraph adopts its sink."""
+    from tpuvsr.testing import stub_graph_engine
+    eng = stub_graph_engine(spec=tick_spec,
+                            modulus=MOD)
+    # stub_graph_engine builds its own spec by default; pass ours
+    res = eng.run()
+    g = DeviceGraph(tick_spec, engine=eng, result=res)
+    assert g.mode == "stream"
+    assert g.n == res.distinct_states
+    assert int(g.csr[1].shape[0]) == 3 * MOD
+
+
+# ---------------------------------------------------------------------
+# reference-gated legs (the original corpus oracles)
+# ---------------------------------------------------------------------
 def _assert_isomorphic(spec, dgraph, istates, iedges, iinits):
     """Map both graphs' node ids through canonical VIEW values and
     compare edge multisets exactly."""
@@ -25,7 +269,6 @@ def _assert_isomorphic(spec, dgraph, istates, iedges, iinits):
     assert len(istates) == dgraph.n
     assert set(ikey.values()) == set(dkey.values())
     d_of_key = {k: sid for sid, k in dkey.items()}
-    # init sets agree
     assert ({ikey[s] for s in iinits}
             == {dkey[s] for s in dgraph.inits})
     for sid, elist in enumerate(iedges):
@@ -34,15 +277,24 @@ def _assert_isomorphic(spec, dgraph, istates, iedges, iinits):
         assert want == got, f"edges differ at interp sid {sid}"
 
 
-def test_device_graph_isomorphic_to_interpreter():
-    spec = vsr_spec(values=("v1",), timer=0)
+def _vsr_spec():
+    from tests.conftest import vsr_spec
+    return vsr_spec(values=("v1",), timer=0)
+
+
+@requires_reference
+@pytest.mark.parametrize("mode", ["stream", "two-pass"])
+def test_device_graph_isomorphic_to_interpreter(mode):
+    spec = _vsr_spec()
     istates, iedges, iinits = build_graph(spec)
-    g = DeviceGraph(spec, tile_size=8, chunk_tiles=2, next_capacity=1)
+    g = DeviceGraph(spec, tile_size=8, chunk_tiles=2, next_capacity=1,
+                    mode=mode)
     _assert_isomorphic(spec, g, istates, iedges, iinits)
 
 
+@requires_reference
 def test_device_graph_batch_predicate_matches_interpreter():
-    spec = vsr_spec(values=("v1",), timer=0)
+    spec = _vsr_spec()
     g = DeviceGraph(spec, tile_size=8, chunk_tiles=2, next_capacity=1)
     vals = g.batch_predicate("AllReplicasMoveToSameView")
     assert vals is not None and len(vals) == g.n
@@ -53,6 +305,7 @@ def test_device_graph_batch_predicate_matches_interpreter():
     assert g.batch_predicate("NoSuchPredicate") is None
 
 
+@requires_reference
 @pytest.mark.slow
 def test_a01_liveness_verdicts_through_device_graph():
     """The corpus oracle (test_liveness.py::test_a01_liveness_corpus_

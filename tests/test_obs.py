@@ -249,6 +249,56 @@ def test_compare_bench_pipeline_depth_mismatch_is_advisory(tmp_path):
     assert compare_bench.main([str(base), str(legacy)]) == 1
 
 
+def _liveness_doc(distinct_per_s, edges_per_s, check_s, mode):
+    d = _metrics_doc(distinct_per_s)
+    d["liveness_speedup"] = {"edges_per_s": edges_per_s,
+                             "check_s": check_s, "mode": mode,
+                             "edges": 1000,
+                             "graph_overhead_ratio": 0.1}
+    return {"parsed": d, "metrics": d}
+
+
+def test_compare_bench_gate_liveness(tmp_path):
+    """ISSUE 15 satellite: edges/s drops and check_s growth fail at
+    matching graph-construction modes; a streamed-vs-two-pass mode
+    mismatch is advisory, like pipeline depth."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import compare_bench
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(
+        _liveness_doc(1000.0, 5000.0, 10.0, "stream")))
+
+    def rc(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return compare_bench.main([str(base), str(p)])
+    # within tolerance
+    assert rc("good.json",
+              _liveness_doc(1000.0, 4800.0, 10.5, "stream")) == 0
+    # edges/s regression at matching mode: fail
+    assert rc("slow_edges.json",
+              _liveness_doc(1000.0, 2000.0, 10.0, "stream")) == 1
+    # check_s GROWTH at matching mode: fail (cost metric, inverted)
+    assert rc("slow_check.json",
+              _liveness_doc(1000.0, 5000.0, 30.0, "stream")) == 1
+    # mode mismatch: advisory even with both off tolerance
+    assert rc("mode_mismatch.json",
+              _liveness_doc(1000.0, 2000.0, 30.0, "two-pass")) == 0
+    # bench.py's LIFTED round-doc form (liveness_check_s /
+    # liveness_mode at the top level, attachment stripped) feeds the
+    # same gate: check_s growth still bites
+    lifted = {"parsed": dict(_metrics_doc(1000.0),
+                             edges_per_s=5000.0,
+                             liveness_check_s=30.0,
+                             liveness_mode="stream"),
+              "metrics": _metrics_doc(1000.0)}
+    assert rc("lifted_slow.json", lifted) == 1
+    # liveness section absent from one side: gate stands down
+    assert rc("no_liveness.json",
+              {"metrics": _metrics_doc(1000.0)}) == 0
+
+
 # ---------------------------------------------------------------------
 # CLI flags (interp engine; no reference needed)
 # ---------------------------------------------------------------------

@@ -642,8 +642,8 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 21 scenarios since ISSUE 14 (kill-one-of-n-workers)
-    assert out["ok"] and len(out["scenarios"]) == 21
+    # 22 scenarios since ISSUE 15 (kill-liveness-resume)
+    assert out["ok"] and len(out["scenarios"]) == 22
 
 
 # ---------------------------------------------------------------------

@@ -364,6 +364,77 @@ def test_worker_pool_two_processes_drain_shell_queue(tmp_path):
     assert len(owners) == 2
 
 
+def test_worker_pool_respawns_dead_worker(tmp_path):
+    """ISSUE 15 satellite (the ROADMAP item 2 respawn residual): a
+    SIGKILLed worker slot is relaunched by ``respawn_dead`` (bounded,
+    backoff, journaled as ``worker_respawn`` in <spool>/pool.jsonl)
+    and the respawned worker finishes the queue; clean exits are
+    never respawned, and the per-slot budget is honored."""
+    from tpuvsr.testing import subprocess_env
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    jobs = [_shell(q, f"sh{i}",
+                   argv=[sys.executable, "-c",
+                         "import time; time.sleep(0.05)"])
+            for i in range(12)]
+    pool = WorkerPool(spool, 1, drain=True, env=subprocess_env(),
+                      max_restarts=2, restart_backoff=0.0).start()
+    # let the worker claim something, then SIGKILL it
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        q.refresh()
+        if any(j.state in ("running", "done") for j in q.jobs()):
+            break
+        time.sleep(0.05)
+    rc = pool.kill_one(0)
+    assert rc != 0
+    respawned = pool.respawn_dead()
+    assert respawned == [0]
+    assert pool.respawned == 1
+    # the dead worker's claims are swept onto the respawned one
+    deadline = time.time() + 120
+    while pool.alive() and time.time() < deadline:
+        q.recover_stale()
+        pool.respawn_dead()
+        time.sleep(0.1)
+    pool.wait(timeout=60)
+    q2 = JobQueue(spool)
+    assert all(j.state == "done" for j in q2.jobs()), \
+        {j.job_id: j.state for j in q2.jobs()}
+    ev = read_journal(os.path.join(spool, "pool.jsonl"))
+    resp = [e for e in ev if e["event"] == "worker_respawn"]
+    assert resp and resp[0]["worker"] == "w0" \
+        and resp[0]["attempt"] == 1 and resp[0]["rc"] != 0
+    # clean exits are NOT respawned: the drained worker exited 0 and
+    # the final sweep must leave it down
+    assert pool.respawn_dead() == []
+    del jobs
+
+
+def test_worker_pool_respawn_budget_is_bounded(tmp_path):
+    """A slot that keeps dying stays down once max_restarts is spent
+    (no restart storm)."""
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    pool = WorkerPool(spool, 1, max_restarts=2, restart_backoff=0.0,
+                      # a child that dies instantly with rc 3
+                      python=sys.executable)
+    pool._cmd = lambda i: [sys.executable, "-c",
+                           "import sys; sys.exit(3)"]
+    pool.start()
+    pool.procs[0].wait(30)
+    assert pool.respawn_dead() == [0]
+    pool.procs[0].wait(30)
+    assert pool.respawn_dead() == [0]
+    pool.procs[0].wait(30)
+    # budget spent: no third respawn
+    assert pool.respawn_dead() == []
+    assert pool.respawned == 2
+    ev = read_journal(os.path.join(spool, "pool.jsonl"))
+    assert [e["attempt"] for e in ev
+            if e["event"] == "worker_respawn"] == [1, 2]
+
+
 # ---------------------------------------------------------------------
 # HTTP front (tentpole c): wire round-trip vs the CLI verbs
 # ---------------------------------------------------------------------

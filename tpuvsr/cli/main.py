@@ -130,6 +130,23 @@ TLC CLI that the reference's README drives (workers/simulation/depth):
                    device/interp/sharded, -fpset host/hbm,
                    -simulate/-validate/-supervise and temporal
                    properties (retain_levels needs resident levels)
+  -edges MODE      on | off (default: on for PROPERTY cfgs, meaning-
+                   less otherwise): behavior-graph edge stream
+                   (ISSUE 15).  With on, the level kernel's fused
+                   commit resolves every enabled lane's successor
+                   fingerprint to a graph node id on device and
+                   appends (src, action, dst) edges to a device
+                   buffer drained into an incremental host CSR
+                   builder — liveness graph construction becomes a
+                   near-free rider on the safety BFS instead of a
+                   second full re-expansion pass (the two-pass path,
+                   kept under -edges off as the bit-identity oracle).
+                   Snapshots carry the stream (gid column + edge rows
+                   + retained levels), so a preempted temporal run
+                   resumes to a bit-identical CSR and verdict.
+                   Conflicts: -simulate/-validate/-symmetry on/
+                   -engine interp/-fpset host; -edges on needs a
+                   PROPERTY cfg (checked after the cfg loads)
   -pack MODE       on | off (default on): packed bit-planed frontier
                    encoding (engine/pack.py) — the at-rest frontier,
                    host spill pages and the sharded exchange move
@@ -345,6 +362,20 @@ def build_parser():
                         "frontier pages — pages beyond the RAM "
                         "budget flush to append-only level files "
                         "under DIR (implies -fpset paged)")
+    p.add_argument("-edges", choices=["on", "off"], default=None,
+                   metavar="MODE",
+                   help="behavior-graph edge stream for temporal "
+                        "properties (default: on for PROPERTY cfgs): "
+                        "the level kernel emits (src, action, dst) "
+                        "edges during the safety BFS itself — "
+                        "liveness graph construction becomes a "
+                        "near-free rider on the run instead of a "
+                        "second full re-expansion pass.  -edges off "
+                        "falls back to the two-pass path (the "
+                        "bit-identity oracle).  -edges on requires a "
+                        "PROPERTY cfg and conflicts with -simulate/"
+                        "-validate/-symmetry on/-engine interp/"
+                        "-fpset host")
     p.add_argument("-pack", choices=["on", "off"], default=None,
                    metavar="MODE",
                    help="packed bit-planed frontier encoding "
@@ -517,6 +548,22 @@ def validate_args(parser, args):
                          "(the supervisor's degrade ladder manages "
                          "its own hbm -> paged fallback; run -fpset "
                          "paged -spill directly)")
+    if args.edges == "on":
+        if args.simulate or args.validate is not None:
+            parser.error("-edges on streams the BFS behavior graph; "
+                         "it cannot be combined with -simulate/"
+                         "-validate (neither builds one)")
+        if args.symmetry == "on":
+            parser.error("-edges on cannot be combined with "
+                         "-symmetry on: the behavior graph's nodes "
+                         "are concrete states (liveness keeps its "
+                         "SYMMETRY-off requirement)")
+        if args.engine == "interp" or args.fpset == "host":
+            parser.error("-edges on needs the paged device engine "
+                         "(the edge stream rides the level kernel); "
+                         "it cannot be combined with -engine interp/"
+                         "-fpset host — the interpreter builds its "
+                         "own graph")
     if args.pack == "on" and (args.engine == "interp"
                               or args.fpset == "host"):
         parser.error("-pack on needs a device engine (the packed "
@@ -780,6 +827,10 @@ def main(argv=None):
         parser.error("-spill cannot be combined with temporal "
                      "properties (the liveness graph enumeration "
                      "needs whole levels resident)")
+    if args.edges == "on" and not spec.temporal_props:
+        parser.error("-edges on: the cfg declares no PROPERTY — "
+                     "there is no temporal check to consume the "
+                     "behavior-graph stream")
 
     engine = _pick_engine(args.engine, args.fpset, spec)
     if args.spill is not None:
@@ -994,7 +1045,12 @@ def main(argv=None):
                 want_graph = bool(spec.temporal_props) and \
                     not spec.symmetry_perms
                 if want_graph:
+                    # edge stream on by default (ISSUE 15): the
+                    # behavior graph flows out of the safety BFS;
+                    # -edges off keeps the two-pass re-expansion
+                    # (DeviceGraph mode="two-pass") as the oracle
                     eng = PagedBFS(spec, retain_levels=True,
+                                   edges=args.edges != "off",
                                    pipeline=args.pipeline,
                                    pack=pack_kw, commit=commit_kw,
                                    symmetry=symmetry_kw,
@@ -1087,21 +1143,27 @@ def main(argv=None):
             graph = None
             if engine in ("device", "paged", "sharded") and \
                     not spec.symmetry_perms:
-                # device-built behavior graph (round-3 fix: the CLI
-                # used the interpreter graph even for device runs,
-                # which cannot terminate beyond toy constants), reusing
-                # the safety BFS's retained level blocks.  A resumed
-                # run's blocks only cover post-resume levels, so the
-                # graph re-enumerates from scratch in that case.
+                # device-built behavior graph, streamed out of the
+                # safety BFS itself (ISSUE 15; -edges off keeps the
+                # historical two-pass re-expansion as the oracle).
+                # A resumed edge-stream run restores its retained
+                # blocks + edge rows from the snapshot, so reuse
+                # works across -recover too; runs without retained
+                # blocks (supervised/sharded, or a snapshot written
+                # without the stream) re-enumerate from scratch.
+                from ..core.values import TLAError
                 from ..engine.device_liveness import DeviceGraph
-                if args.recover or args.supervise \
-                        or engine == "sharded":
-                    # resumed/supervised/sharded runs don't retain
-                    # level blocks; re-enumerate for the graph
-                    graph = DeviceGraph(spec, log=log)
+                gmode = "two-pass" if args.edges == "off" else "stream"
+                if args.supervise or engine == "sharded":
+                    graph = DeviceGraph(spec, log=log, mode=gmode)
                 else:
-                    graph = DeviceGraph(spec, engine=eng, result=res,
-                                        log=log)
+                    try:
+                        graph = DeviceGraph(spec, engine=eng,
+                                            result=res, log=log)
+                    except (TLAError, ValueError) as e:
+                        log(f"retained enumeration unusable ({e}); "
+                            f"re-enumerating for the liveness graph")
+                        graph = DeviceGraph(spec, log=log, mode=gmode)
             # the liveness pass gets its own observer segment in the
             # same journal (second run_start/run_end pair, engine
             # "liveness"); the -metrics file stays the BFS engine's

@@ -140,6 +140,7 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
                     fp_count, states_generated, max_msgs, expand_mults,
                     elapsed, digest=None, extra=None, pack=None,
                     canon=None, bounds=None, frontier_blocks=None,
+                    gids=None, edge_blocks=None, graph_blocks=None,
                     obs=None):
     """Write a complete engine snapshot to `path` (atomic + durable).
 
@@ -159,14 +160,35 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
     the staged frontier.npz and released, so a disk-spilled frontier
     (engine/spill.py) checkpoints at page-sized peak residency instead
     of materializing `n_front` dense rows.  The chunked payload is
-    read back transparently by ``load_checkpoint``."""
+    read back transparently by ``load_checkpoint``.
+
+    Streamed edge emission (ISSUE 15) adds three OPTIONAL payload
+    pieces: `gids` — the FPSet's parallel gid column (fingerprint ->
+    graph node id), stored alongside ``slots`` in fpset.npz;
+    `edge_blocks` — an iterator of ``{src, aid, dst}`` array blocks
+    (the CSR builder's drained rows up to this committed level),
+    streamed into edges.npz; `graph_blocks` — an iterator of the
+    retained dense level blocks (temporal runs), streamed into
+    graph.npz.  All three are restored by ``load_checkpoint``, so a
+    SIGTERM'd temporal run resumes to a bit-identical CSR."""
     from ..resilience.faults import fault_point
     tmp = path + ".ckpt-tmp"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    np.savez_compressed(os.path.join(tmp, "fpset.npz"),
-                        slots=np.asarray(slots))
+    fp_arrs = {"slots": np.asarray(slots)}
+    if gids is not None:
+        fp_arrs["gids"] = np.asarray(gids)
+    np.savez_compressed(os.path.join(tmp, "fpset.npz"), **fp_arrs)
+    extra_payloads = []
+    if edge_blocks is not None:
+        _write_frontier_chunks(os.path.join(tmp, "edges.npz"),
+                               edge_blocks)
+        extra_payloads.append("edges.npz")
+    if graph_blocks is not None:
+        _write_frontier_chunks(os.path.join(tmp, "graph.npz"),
+                               graph_blocks)
+        extra_payloads.append("graph.npz")
     if frontier_blocks is not None:
         rows = _write_frontier_chunks(
             os.path.join(tmp, "frontier.npz"), frontier_blocks)
@@ -188,7 +210,7 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
     # corrupt-ckpt fault hook below mangles anything — a fault-injected
     # torn write is therefore CRC-detectable, like a real one
     crcs = {name: _crc32_file(os.path.join(tmp, name))
-            for name in PAYLOADS}
+            for name in list(PAYLOADS) + extra_payloads}
     manifest = {
         "format": FORMAT_VERSION,
         "n_front": int(n_front),
@@ -244,7 +266,7 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
                 f.write(bytes(b ^ 0xFF for b in chunk))
             else:
                 f.truncate(max(1, size // 2))
-    for name in PAYLOADS:
+    for name in list(PAYLOADS) + extra_payloads:
         _fsync_path(os.path.join(tmp, name))
     _fsync_path(tmp)
     old = path + ".old"
@@ -315,7 +337,11 @@ def _read_snapshot(path, expect_digest):
             f"{expect_digest}); refusing to resume")
     crcs = manifest.get("payload_crc32") or {}
     arrs = {}
-    for name in PAYLOADS:
+    # optional payloads (edges.npz / graph.npz, the ISSUE 15 edge
+    # stream) are verified iff the manifest recorded a CRC for them —
+    # a listed-but-missing optional payload is corruption, not absence
+    names = list(PAYLOADS) + sorted(set(crcs) - set(PAYLOADS))
+    for name in names:
         p = os.path.join(path, name)
         try:
             want = crcs.get(name)
@@ -369,8 +395,21 @@ def load_checkpoint(path, expect_digest=None, log=None):
     n_init = manifest["n_init"]
     init_dense = [{k: ini[k][i] for k in ini}
                   for i in range(n_init)]
+
+    def _opt_chunked(name):
+        d = arrs.get(name)
+        if d is None:
+            return None
+        d = _assemble_frontier(d)
+        return d or None        # zero-block payload == absent
     return {
         "slots": fp["slots"],
+        # streamed edge emission (ISSUE 15): the gid column and the
+        # drained edge / retained graph rows, when the writer ran
+        # with edges on (None otherwise)
+        "gids": fp.get("gids"),
+        "edges": _opt_chunked("edges.npz"),
+        "graph": _opt_chunked("graph.npz"),
         "frontier": dict(fr),
         "n_front": manifest["n_front"],
         "h_parent": tr["parent"],

@@ -89,7 +89,7 @@ from ..resilience.faults import fault_point
 from ..resilience.supervisor import Preempted, preempt_signal
 from .bfs import CheckResult
 from .fpset import (dedup_batch, empty_table, grow, insert_batch,
-                    insert_core)
+                    insert_core, lookup_gids, store_gids)
 from .spec import SpecModel
 from .trace import TraceEntry
 
@@ -104,6 +104,12 @@ R_NEXT_GROW = 5      # next-frontier buffer out of capacity
 R_SLOT_ERR = 6       # dense-layout slot collision (config limitation)
 R_DEADLOCK = 7       # a frontier state has no enabled successor
 R_EXPAND_GROW = 8    # per-action enabled-lane compaction buffer too small
+# 9 is reserved (the sharded step's rank-agreed R_EXPAND_GROW vote)
+R_EDGE_FLUSH = 10    # edge append buffer out of headroom (ISSUE 15):
+#                      the host drains the committed (src, action, dst)
+#                      triples into the CSR builder and re-enters —
+#                      the paused tile committed nothing, exactly like
+#                      the paged engine's R_NEXT_GROW spill
 
 # Back-compat alias: the perm-table builder lives in the registry now.
 _value_perm_table = registry.value_perm_table
@@ -131,10 +137,18 @@ class DeviceBFS:
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
                  expand_mults=None, model_factory=None, pipeline=2,
                  pack="auto", commit="fused", symmetry="auto",
-                 bounds="auto"):
+                 bounds="auto", edges=False):
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
                            f"(got {commit!r})")
+        if edges and not getattr(self, "_edges_on", False):
+            # the tile bodies support emission on any engine, but the
+            # drain seam (R_EDGE_FLUSH -> host CSR builder) lives in
+            # the host-paged run loop
+            raise TLAError(
+                "edge emission needs the host-paged drain loop; "
+                "construct PagedBFS(edges=True) (or run the CLI "
+                "temporal path, which does)")
         if (tile_size > MAX_VALIDATED_TPU_TILE
                 and os.environ.get("TPUVSR_UNSAFE_TILE") != "1"
                 and jax.default_backend() != "cpu"):
@@ -145,6 +159,12 @@ class DeviceBFS:
                 f"axon — scripts/tile_sweep.json).  Set "
                 f"TPUVSR_UNSAFE_TILE=1 to override for diagnosis runs.")
         self.spec = spec
+        # streamed edge emission (ISSUE 15): set by PagedBFS before
+        # this constructor runs (the host-paged engine owns the drain
+        # seam); when on, the tile bodies resolve every enabled lane's
+        # successor fingerprint to a gid on device and append
+        # (src gid, action, dst gid) triples to the edge buffer
+        self._edges_on = getattr(self, "_edges_on", False)
         self.tile = tile_size
         self.fpset_capacity = fpset_capacity
         self.hash_mode = hash_mode
@@ -299,6 +319,13 @@ class DeviceBFS:
         else:
             self._canon = build_canon_spec(spec, self.codec, self.kern,
                                            self._symmetry_req)
+        if self._edges_on and (self._canon is not None
+                               or self._sym_fold > 1):
+            raise TLAError(
+                "edge emission requires symmetry off: the behavior "
+                "graph's nodes are concrete states, so orbit-folded "
+                "fingerprints would merge distinct graph nodes "
+                "(liveness keeps its SYMMETRY-off requirement)")
         # packed-frontier spec for THIS codec binding (rebuilt with the
         # codec on bag growth: MAX_MSGS changes the lane count).
         # Bounds tightening (ISSUE 13): reachable intervals intersect
@@ -319,7 +346,7 @@ class DeviceBFS:
                 self.codec, spec=spec,
                 force=self._pack_req is True) if tighten else self._pk)
         self._level = jax.jit(self._make_level(),
-                              donate_argnums=(0, 4, 5, 6, 7))
+                              donate_argnums=(0, 4, 5, 6, 7, 10))
         self._ml = None         # fused pass, built lazily (run_fused)
         self._wl = None         # chained window pass (run_chained)
         # obs accounting: the first dispatch after a (re)jit is charged
@@ -396,8 +423,12 @@ class DeviceBFS:
         # carries the overflowing action so only it grows)
         caps = self._expand_caps()
         total_E = sum(caps)
+        edges_on = self._edges_on
+        aid_q_pa = jnp.asarray(np.repeat(
+            np.arange(len(caps), dtype=np.int32), caps))
 
-        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None):
+        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None,
+                      edge_bases=None):
             F_cap = (frontier.shape[0] if pk is not None
                      else frontier["status"].shape[0])
 
@@ -431,11 +462,22 @@ class DeviceBFS:
                 # scatter can overrun the buffer, so an insert is never
                 # committed without its successors landing — which keeps
                 # the pause/resume protocol idempotent with no membership
-                # query pass
-                commit = (N_cap - nn) >= total_E
-                cap_ok = commit
-                reason = jnp.where((reason == RUNNING) & ~cap_ok,
+                # query pass.  Edge emission adds the parallel gate on
+                # the edge append buffer (full = drain to host, not
+                # grow in HBM)
+                room_next = (N_cap - nn) >= total_E
+                if edges_on:
+                    E_cap_e = c["eb_src"].shape[0]
+                    room_edge = (E_cap_e - c["edge_n"]) >= total_E
+                    gids_v = c["gids"]
+                    fp_segs, en_segs_e, pidx_segs_e = [], [], []
+                else:
+                    room_edge = jnp.asarray(True)
+                commit = room_next & room_edge
+                reason = jnp.where((reason == RUNNING) & ~room_next,
                                    R_NEXT_GROW, reason)
+                reason = jnp.where((reason == RUNNING) & ~room_edge,
+                                   R_EDGE_FLUSH, reason)
                 viol_any = jnp.asarray(False)
                 bag_err = jnp.asarray(False)
                 slot_err = jnp.asarray(False)
@@ -534,6 +576,18 @@ class DeviceBFS:
                     dist = dist + nfi
                     ovf_i = ovf_i | a_ovf_i
                     commit = commit_a & ~a_ovf_i
+                    if edges_on:
+                        # fresh gids stored UNGATED (mirrors insert
+                        # persistence across a pause); triples are
+                        # staged and appended once at tile end, gated
+                        # on the whole tile committing — the same
+                        # exactly-once discipline as `gen`
+                        gids_v = store_gids(
+                            slots, gids_v, fp,
+                            (edge_bases[1] + dest).astype(I32), fresh)
+                        fp_segs.append(fp)
+                        en_segs_e.append(en_s)
+                        pidx_segs_e.append(pidx)
 
                 # failure cause priority: violation > slot error > bag
                 # growth > expand-capacity > fpset growth (next-capacity
@@ -557,7 +611,7 @@ class DeviceBFS:
                 # on-device accumulator (ISSUE 4 satellite) — same
                 # commit gating as `gen`, so sum(act) == gen always
                 act_vec = jnp.stack(act_local).astype(jnp.uint32)
-                return {
+                ret = {
                     "t": jnp.where(commit & (reason == RUNNING),
                                    t + 1, t),
                     "reason": reason, "viol": viol, "dead": dead_i,
@@ -572,6 +626,29 @@ class DeviceBFS:
                     "act": c["act"] + jnp.where(commit, act_vec,
                                                 jnp.uint32(0)),
                 }
+                if edges_on:
+                    # one staged emission at tile end (action-major
+                    # queue order = the fused body's), gated on the
+                    # final commit flag — a tile that paused or failed
+                    # emits nothing and re-emits whole on re-entry
+                    fp_q = jnp.concatenate(fp_segs)
+                    emit = jnp.concatenate(en_segs_e) & commit
+                    pidx_q = jnp.concatenate(pidx_segs_e)
+                    dst_g = lookup_gids({"slots": slots}, gids_v,
+                                        fp_q, emit)
+                    edst = jnp.where(
+                        emit, c["edge_n"] + jnp.cumsum(emit) - 1,
+                        E_cap_e)
+                    ret["gids"] = gids_v
+                    ret["eb_src"] = c["eb_src"].at[edst].set(
+                        (edge_bases[0] + base + pidx_q).astype(I32),
+                        mode="drop")
+                    ret["eb_aid"] = c["eb_aid"].at[edst].set(
+                        aid_q_pa, mode="drop")
+                    ret["eb_dst"] = c["eb_dst"].at[edst].set(
+                        dst_g, mode="drop")
+                    ret["edge_n"] = c["edge_n"] + emit.sum()
+                return ret
 
             return body
 
@@ -620,8 +697,10 @@ class DeviceBFS:
         aid_q = jnp.asarray(np.repeat(np.arange(n_act, dtype=np.int32),
                                       caps))
         guard_mat = self._guard_matrix(kern)
+        edges_on = self._edges_on
 
-        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None):
+        def make_body(frontier, n_front, want_deadlock, chunk_ctx=None,
+                      edge_bases=None):
             F_cap = (frontier.shape[0] if pk is not None
                      else frontier["status"].shape[0])
 
@@ -666,10 +745,21 @@ class DeviceBFS:
                 reason, viol = c["reason"], c["viol"]
                 # same headroom gate as the per-action body: with
                 # N_cap - nn >= total_E no scatter can overrun, so an
-                # insert is never committed without its successors
-                commit0 = (N_cap - nn) >= total_E
-                reason = jnp.where((reason == RUNNING) & ~commit0,
+                # insert is never committed without its successors.
+                # Edge emission adds the parallel gate on the edge
+                # append buffer (a full one means "drain to the host
+                # CSR builder", not "grow in HBM")
+                room_next = (N_cap - nn) >= total_E
+                if edges_on:
+                    E_cap_e = c["eb_src"].shape[0]
+                    room_edge = (E_cap_e - c["edge_n"]) >= total_E
+                else:
+                    room_edge = jnp.asarray(True)
+                commit0 = room_next & room_edge
+                reason = jnp.where((reason == RUNNING) & ~room_next,
                                    R_NEXT_GROW, reason)
+                reason = jnp.where((reason == RUNNING) & ~room_edge,
+                                   R_EDGE_FLUSH, reason)
 
                 # -- stage 2: work-queue compaction + expansion --------
                 if incremental:
@@ -802,7 +892,7 @@ class DeviceBFS:
                 reason = jnp.where(dl & (reason == RUNNING),
                                    R_DEADLOCK, reason)
                 dead_i = jnp.where(dl, base + jnp.argmax(dead), c["dead"])
-                return {
+                ret = {
                     "t": jnp.where(commit & (reason == RUNNING),
                                    t + 1, t),
                     "reason": reason, "viol": viol, "dead": dead_i,
@@ -814,6 +904,38 @@ class DeviceBFS:
                     "act": c["act"] + jnp.where(
                         commit, cnts.astype(jnp.uint32), jnp.uint32(0)),
                 }
+                if edges_on:
+                    # edge emission (ISSUE 15): stage 3 already holds
+                    # (source row, action, successor fp) for every
+                    # enabled lane, fresh and duplicate — the two
+                    # things the two-pass re-expansion used to
+                    # recompute.  Fresh states' gids (gid_base + next-
+                    # buffer row) are stored next to their slots
+                    # UNGATED, mirroring insert persistence across a
+                    # pause; triples append only when the tile COMMITS
+                    # (the `gen` discipline), so a paused tile's
+                    # re-entry emits exactly once, with its already-
+                    # committed lanes resolving as duplicates
+                    src_base, gid_base = edge_bases
+                    gids_v = store_gids(
+                        slots, c["gids"], fp_q,
+                        (gid_base + dest).astype(I32), fresh)
+                    emit = en_q & commit
+                    dst_g = lookup_gids({"slots": slots}, gids_v,
+                                        fp_q, emit)
+                    edst = jnp.where(
+                        emit, c["edge_n"] + jnp.cumsum(emit) - 1,
+                        E_cap_e)
+                    ret["gids"] = gids_v
+                    ret["eb_src"] = c["eb_src"].at[edst].set(
+                        (src_base + base + pidx_q).astype(I32),
+                        mode="drop")
+                    ret["eb_aid"] = c["eb_aid"].at[edst].set(
+                        aid_q, mode="drop")
+                    ret["eb_dst"] = c["eb_dst"].at[edst].set(
+                        dst_g, mode="drop")
+                    ret["edge_n"] = c["edge_n"] + emit.sum()
+                return ret
 
             return body
 
@@ -828,8 +950,17 @@ class DeviceBFS:
         kern = self.kern
         guard_mat = self._guard_matrix(kern) if fused else None
 
-        def level(slots, frontier, n_front, start_t,
-                  nb, nbp, nba, nbprm, n_next0, want_deadlock):
+        def level(table, frontier, n_front, start_t,
+                  nb, nbp, nba, nbprm, n_next0, want_deadlock,
+                  eb, edge_meta):
+            # `table` bundles the FPSet slots (+ the parallel gid
+            # column in edge-emission mode); `eb` is None or the
+            # (src, aid, dst) edge append buffers — DONATED, they are
+            # rewritten every dispatch — while `edge_meta` carries the
+            # chained fill scalar `n` plus the src_base/gid_base
+            # offsets and is NOT donated (the pipelined collect reads
+            # the fill level back after newer dispatches consumed the
+            # buffers) — ISSUE 15
             n_tiles = (n_front + T - 1) // T
             chunk_ctx = None
             need0 = jnp.zeros((len(_caps),), jnp.uint32)
@@ -860,8 +991,12 @@ class DeviceBFS:
                 return ((c["t"] < n_tiles) & (c["t"] < start_t + K)
                         & (c["reason"] == RUNNING))
 
+            edge_bases = (None if eb is None
+                          else (edge_meta["src_base"],
+                                edge_meta["gid_base"]))
             body = make_body(frontier, n_front, want_deadlock,
-                             chunk_ctx=chunk_ctx)
+                             chunk_ctx=chunk_ctx,
+                             edge_bases=edge_bases)
             init = {
                 "t": jnp.asarray(start_t, I32),
                 "reason": jnp.asarray(RUNNING, I32),
@@ -869,13 +1004,17 @@ class DeviceBFS:
                 "dead": jnp.asarray(-1, I32),
                 "grow_aid": jnp.asarray(-1, I32),
                 "need": need0,
-                "slots": slots,
+                "slots": table["slots"],
                 "nb": nb, "nbp": nbp, "nba": nba, "nbprm": nbprm,
                 "nn": jnp.asarray(n_next0, I32),
                 "dist": jnp.asarray(0, I32),
                 "gen": jnp.asarray(0, I32),
                 "act": jnp.zeros((len(_caps),), jnp.uint32),
             }
+            if eb is not None:
+                init["gids"] = table["gids"]
+                init["eb_src"], init["eb_aid"], init["eb_dst"] = eb
+                init["edge_n"] = edge_meta["n"]
             return jax.lax.while_loop(cond, body, init)
 
         return level
@@ -890,6 +1029,12 @@ class DeviceBFS:
         space).  Pause protocol is unchanged: growth events exit the
         outer loop with (start_t, nn, gen_level) preserved so the host
         grows the structure and re-enters mid-level."""
+        if self._edges_on:
+            raise TLAError(
+                "edge emission needs the host in the loop to drain "
+                "the append buffer into the CSR builder; the fused/"
+                "chained multilevel passes cannot stream edges — run "
+                "the chunked paged engine")
         T = self.tile
         _caps, _tot, make_body = self._tile_body_factory()
 
@@ -1131,7 +1276,7 @@ class DeviceBFS:
             emit(f"expand buffer for {kern.action_names[aid]} grown "
                  f"to tile x {self.expand_mults[aid]} (recompiling)")
         self._level = jax.jit(self._make_level(),
-                              donate_argnums=(0, 4, 5, 6, 7))
+                              donate_argnums=(0, 4, 5, 6, 7, 10))
         self._ml = None
         self._wl = None
         self._fresh_jit = True
@@ -1156,7 +1301,7 @@ class DeviceBFS:
             return False
         self.expand_caps = tgt
         self._level = jax.jit(self._make_level(),
-                              donate_argnums=(0, 4, 5, 6, 7))
+                              donate_argnums=(0, 4, 5, 6, 7, 10))
         self._ml = None
         self._wl = None
         self._fresh_jit = True
@@ -1348,6 +1493,15 @@ class DeviceBFS:
         n0 = len(keep)
         table, _, _ = insert_batch(
             table, jnp.asarray(fps[keep]), jnp.ones((n0,), bool))
+        if self._edges_on:
+            # gid column (ISSUE 15): graph node ids ARE commit order,
+            # so the deduped init states take gids 0..n0-1
+            table["gids"] = store_gids(
+                table["slots"],
+                jnp.full((self.fpset_capacity,), -1, jnp.int32),
+                jnp.asarray(fps[keep]),
+                jnp.arange(n0, dtype=jnp.int32),
+                jnp.ones((n0,), bool))
         # host trace store: gid -> (parent gid, action, param)
         self._h_parent = [np.full(n0, -1, np.int64)]
         self._h_action = [np.full(n0, -1, np.int32)]
@@ -1376,6 +1530,7 @@ class DeviceBFS:
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
+        obs.edges = self._edges_on
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
@@ -1527,10 +1682,10 @@ class DeviceBFS:
                 while pipe.has_room():
                     nb, nbp, nba, nbprm = bufs
                     out = pipe.launch(
-                        self._level, table["slots"], front,
+                        self._level, table, front,
                         jnp.asarray(n_front, I32), pend_t,
                         nb, nbp, nba, nbprm, pend_nn,
-                        jnp.asarray(bool(check_deadlock)),
+                        jnp.asarray(bool(check_deadlock)), None, None,
                         fresh=self._fresh_jit,
                         label=f"level {depth} dispatch")
                     self._fresh_jit = False
@@ -1780,6 +1935,7 @@ class DeviceBFS:
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
+        obs.edges = self._edges_on
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
@@ -2109,6 +2265,7 @@ class DeviceBFS:
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
+        obs.edges = self._edges_on
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),

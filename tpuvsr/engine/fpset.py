@@ -220,17 +220,16 @@ def query_core(table, fps, mask):
     return fresh, unresolved.any()
 
 
-def insert_gids(table, vals, fps, gids, mask):
-    """insert_core that also records a 32-bit value (a graph node id)
-    per fingerprint in the parallel ``vals[CAP]`` array — the device
-    side of the liveness graph's fingerprint->gid index
-    (engine/device_liveness.py).  Batches must not contain duplicate
-    fingerprints (graph nodes are distinct by construction).  Returns
-    (table, vals, overflow, fresh_count)."""
-    table, fresh, ovf = insert_core(table, fps, mask)
-    # each fresh lane re-probes its own chain to find the slot it won
-    # and writes its gid there
-    slots = table["slots"]
+def store_gids(slots, vals, fps, gids, mask):
+    """Write ``gids[mask]`` into the parallel ``vals[CAP]`` array at
+    each masked lane's resolved probe slot.  Every masked fingerprint
+    must already be PRESENT in ``slots`` (insert first, then store) —
+    the lane re-probes its chain to find the slot it resolved to.
+    Plain traceable function; the streamed edge-emission commit
+    (ISSUE 15) composes it with ``insert_core`` inside the level
+    kernel so every fresh state's graph node id lands next to its
+    fingerprint, and ``lookup_gids`` then resolves successor
+    fingerprints — fresh AND duplicate — to gids on device."""
     cap = slots.shape[0]
     capm = jnp.uint32(cap - 1)
     keyed, h0 = _keyed(fps)
@@ -250,7 +249,21 @@ def insert_gids(table, vals, fps, gids, mask):
         return t + 1, unresolved, vals
 
     _, _, vals = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), mask & fresh, vals))
+        cond, body, (jnp.int32(0), mask, vals))
+    return vals
+
+
+def insert_gids(table, vals, fps, gids, mask):
+    """insert_core that also records a 32-bit value (a graph node id)
+    per fingerprint in the parallel ``vals[CAP]`` array — the device
+    side of the liveness graph's fingerprint->gid index
+    (engine/device_liveness.py).  Batches must not contain duplicate
+    fingerprints (graph nodes are distinct by construction).  Returns
+    (table, vals, overflow, fresh_count)."""
+    table, fresh, ovf = insert_core(table, fps, mask)
+    # each fresh lane re-probes its own chain to find the slot it won
+    # and writes its gid there
+    vals = store_gids(table["slots"], vals, fps, gids, mask & fresh)
     return table, vals, ovf, fresh.sum(dtype=jnp.int32)
 
 
@@ -284,19 +297,37 @@ def lookup_gids(table, vals, fps, mask):
 
 def grow(table, factor=4):
     """Host-side rebuild into a larger table (on probe overflow or high
-    load).  Rare; chunked re-insertion of all occupied slots."""
+    load).  Rare; chunked re-insertion of all occupied slots.  A table
+    carrying a ``gids`` value column (the streamed edge-emission mode,
+    ISSUE 15) is rebuilt WITH it: each occupied slot's stored gid
+    follows its fingerprint to the new probe chain."""
     slots = np.asarray(table["slots"])
     occ = slots[:, 0] != 0
     fps = slots[occ, :4]
     cap = int(slots.shape[0])
+    old_gids = (np.asarray(table["gids"])[occ]
+                if "gids" in table else None)
     new = empty_table(cap * factor)
+    new_gids = (jnp.full((cap * factor,), -1, jnp.int32)
+                if old_gids is not None else None)
     chunk = 1 << 16
+    ins_g = jax.jit(insert_gids, donate_argnums=(0, 1)) \
+        if old_gids is not None else None
     for off in range(0, fps.shape[0], chunk):
         part = fps[off:off + chunk]
         pad = np.zeros((chunk - part.shape[0], 4), np.uint32)
         batch = jnp.asarray(np.concatenate([part, pad]))
         m = jnp.asarray(np.arange(chunk) < part.shape[0])
-        new, _, ovf = insert_batch(new, batch, m)
+        if old_gids is not None:
+            gpart = old_gids[off:off + chunk].astype(np.int32)
+            gpad = np.zeros((chunk - gpart.shape[0],), np.int32)
+            new, new_gids, ovf, _ = ins_g(
+                new, new_gids, batch,
+                jnp.asarray(np.concatenate([gpart, gpad])), m)
+        else:
+            new, _, ovf = insert_batch(new, batch, m)
         if bool(ovf):
             return grow(table, factor * 2)
+    if new_gids is not None:
+        new["gids"] = new_gids
     return new

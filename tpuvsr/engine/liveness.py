@@ -334,6 +334,18 @@ def liveness_check(spec: SpecModel, max_states=None,
 
     obs.gauge("graph_states", n)
     obs.gauge("graph_edges", int(n_edges))
+    if dev_graph is not None:
+        # streamed-graph health (ISSUE 15): how the device graph was
+        # built, what the construction cost beyond the safety BFS
+        # was, and the edge emission rate — the liveness acceptance
+        # gauges the bench round and compare_bench's gate read
+        if getattr(dev_graph, "mode", None):
+            obs.gauge("graph_mode", dev_graph.mode)
+        if getattr(dev_graph, "graph_overhead_ratio", None) is not None:
+            obs.gauge("graph_overhead_ratio",
+                      dev_graph.graph_overhead_ratio)
+        if getattr(dev_graph, "edges_per_s", None) is not None:
+            obs.gauge("edges_per_s", dev_graph.edges_per_s)
     for prop_name in spec.temporal_props:
         for kind, p_expr, q_expr, env in _collect_props(spec, prop_name):
             if kind == "gf":

@@ -50,9 +50,10 @@ from ..resilience.faults import fault_point
 from ..resilience.supervisor import Preempted, preempt_signal
 from .bfs import CheckResult
 from .device_bfs import (DeviceBFS, I32, R_BAG_GROW, R_DEADLOCK,
-                         R_EXPAND_GROW, R_FPSET_GROW, R_NEXT_GROW,
-                         R_SLOT_ERR, R_VIOLATION, RUNNING)
+                         R_EDGE_FLUSH, R_EXPAND_GROW, R_FPSET_GROW,
+                         R_NEXT_GROW, R_SLOT_ERR, R_VIOLATION, RUNNING)
 from .fpset import grow
+from .spill import EdgeCSR
 
 
 class PagedBFS(DeviceBFS):
@@ -64,9 +65,28 @@ class PagedBFS(DeviceBFS):
     (engine/device_liveness.py)."""
 
     def __init__(self, *args, retain_levels=False, spill_dir=None,
-                 spill_ram_rows=None, **kwargs):
+                 spill_ram_rows=None, edges=False, edge_capacity=None,
+                 edge_spill_dir=None, edge_ram_rows=None, **kwargs):
         self.retain_levels = retain_levels
         self.level_blocks = []
+        # streamed edge emission (ISSUE 15): the fused commit's stage 3
+        # resolves every enabled lane's successor fingerprint to a gid
+        # on device (gid-valued FPSet) and appends (src gid, action,
+        # dst gid) triples to a device append buffer, drained into the
+        # incremental host CSR builder (engine/spill.EdgeCSR) at chunk
+        # boundaries — the behavior graph streams OUT of the safety
+        # BFS instead of being re-derived by a second expansion pass.
+        # `edge_spill_dir` tiers the drained triples to disk for
+        # graphs past the RAM budget.  Must be set BEFORE the parent
+        # constructor runs (the tile bodies close over it)
+        self._edges_on = bool(edges)
+        self._edge_capacity = edge_capacity
+        self._edge_spill_dir = edge_spill_dir
+        self._edge_ram_rows = edge_ram_rows
+        self.edge_sink = None
+        self._edge_rows_total = 0
+        self._edge_hw = 0
+        self._run_t0 = None
         # disk spill tier (ISSUE 11, CAPACITY.md mitigation 2): with a
         # spill directory, each level's host pages live in a SpillTier
         # — at most `spill_ram_rows` rows resident, the rest in
@@ -205,6 +225,7 @@ class PagedBFS(DeviceBFS):
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
+        obs.edges = self._edges_on
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
@@ -213,6 +234,7 @@ class PagedBFS(DeviceBFS):
         self._lanes_disp = 0
         res = CheckResult()
         t0 = time.time()
+        self._run_t0 = t0
         obs.start(t0, backend=jax.default_backend(),
                   resumed=resume_from is not None)
         emit = obs.log
@@ -220,6 +242,14 @@ class PagedBFS(DeviceBFS):
         self.spill_count = 0     # drains triggered by a full buffer
         self.spill_rows = 0      # total rows paged out to host
         self.level_blocks = []   # fresh per run (retain_levels)
+        if self._edges_on:
+            # incremental host CSR builder the edge drains feed
+            # (ISSUE 15); fresh per run like the level blocks
+            self.edge_sink = EdgeCSR(spill_dir=self._edge_spill_dir,
+                                     ram_rows=self._edge_ram_rows,
+                                     obs=obs)
+            self._edge_rows_total = 0
+            self._edge_hw = 0
 
         if resume_from is not None:
             from .checkpoint import load_checkpoint, spec_digest
@@ -243,6 +273,38 @@ class PagedBFS(DeviceBFS):
             self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
+            if self._edges_on:
+                # edge-stream resume seam (ISSUE 15): the snapshot
+                # must carry the gid column and the drained edge rows
+                # up to its committed level — resuming a plain-BFS
+                # snapshot with edges on would leave every pre-resume
+                # state gid-less, so it is a policy error
+                if ck.get("gids") is None:
+                    raise TLAError(
+                        f"checkpoint {resume_from} was written "
+                        f"without the edge stream (no gid column); "
+                        f"resume with edges off, or restart the "
+                        f"temporal run from scratch")
+                table["gids"] = jnp.asarray(ck["gids"])
+                if ck.get("edges") is not None:
+                    self.edge_sink.seed(ck["edges"])
+                    self._edge_rows_total = self.edge_sink.rows
+                if self.retain_levels:
+                    g = ck.get("graph")
+                    sizes = [int(x) for x in ck["level_sizes"][:-1]]
+                    have = (0 if g is None
+                            else int(next(iter(g.values())).shape[0]))
+                    if have != sum(sizes):
+                        raise TLAError(
+                            f"checkpoint {resume_from} retains "
+                            f"{have} graph rows, the committed "
+                            f"levels hold {sum(sizes)} — snapshot "
+                            f"not written by a retain_levels run")
+                    off = 0
+                    for s in sizes:
+                        self.level_blocks.append(
+                            {k: v[off:off + s] for k, v in g.items()})
+                        off += s
             self._init_dense = ck["init_dense"]
             self._init_states = [self.codec.decode(d)
                                  for d in ck["init_dense"]]
@@ -300,6 +362,18 @@ class PagedBFS(DeviceBFS):
         # the drain loop (commit never true with an empty buffer).
         self.next_cap = max(self.next_cap, self._total_E() + self.tile)
         bufs = self._alloc_bufs(self.next_cap)
+        # edge append buffer (ISSUE 15): same total_E + one-tile floor
+        # as the next buffer (the kernel refuses to commit a tile
+        # without total_E triples of headroom); default sized 4x the
+        # next buffer so R_EDGE_FLUSH drains stay block-sized
+        ebufs = None
+        n_edge = 0
+        if self._edges_on:
+            self.edge_cap = max(int(self._edge_capacity
+                                    or 4 * self.next_cap),
+                                self._total_E() + self.tile)
+            ebufs = tuple(jnp.zeros((self.edge_cap,), I32)
+                          for _ in range(3))
         stop = None
 
         # pipelined dispatch window (ISSUE 4): chained on device-side
@@ -312,9 +386,11 @@ class PagedBFS(DeviceBFS):
                                 ready=lambda o: o["reason"])
 
         def pull(o):
-            return jax.device_get([o["reason"], o["t"], o["nn"],
-                                   o["gen"], o["dist"], o["act"],
-                                   o["need"]])
+            keys = [o["reason"], o["t"], o["nn"], o["gen"],
+                    o["dist"], o["act"], o["need"]]
+            if self._edges_on:
+                keys.append(o["edge_n"])
+            return jax.device_get(keys)
 
         while n_front > 0 and stop is None:
             if max_depth is not None and depth >= max_depth:
@@ -367,6 +443,40 @@ class PagedBFS(DeviceBFS):
                           n_next * self._state_row_bytes())
                 n_next = 0
 
+            def refloor_edges():
+                """Kernel rebuilt with (possibly) wider caps: drain
+                the plain-int triples, re-floor the append buffer
+                against the new total_E headroom requirement, and
+                re-zero it (a stale floor live-locks the commit gate,
+                exactly like the next_cap floor above)."""
+                nonlocal ebufs, pend_en
+                drain_edges()
+                self.edge_cap = max(self.edge_cap,
+                                    self._total_E() + self.tile)
+                ebufs = tuple(jnp.zeros((self.edge_cap,), I32)
+                              for _ in range(3))
+                pend_en = jnp.asarray(0, I32)
+
+            def drain_edges():
+                """Drain the committed edge triples off the device
+                append buffer into the CSR builder (ISSUE 15).  Reads
+                the chain-tip edge buffers — identical to the
+                collected ticket's, since replays commit nothing."""
+                nonlocal n_edge
+                if not self._edges_on or n_edge == 0:
+                    return
+                es, ea, ed = ebufs
+                with obs.timer("host_sync"):
+                    s, a, d = jax.device_get(
+                        (es[:n_edge], ea[:n_edge], ed[:n_edge]))
+                self.edge_sink.append(np.asarray(s), np.asarray(a),
+                                      np.asarray(d))
+                self._edge_rows_total += n_edge
+                self._edge_hw = max(self._edge_hw, n_edge)
+                obs.edge_flush(depth, n_edge,
+                               n_edge * EdgeCSR.ROW_BYTES)
+                n_edge = 0
+
             def put_chunk():
                 nonlocal dev_chunk
                 cc = self._chunk_cap()
@@ -393,14 +503,32 @@ class PagedBFS(DeviceBFS):
                 start_t = 0
                 pend_t = jnp.asarray(0, I32)
                 pend_nn = jnp.asarray(n_next, I32)
+                pend_en = jnp.asarray(n_edge, I32)
                 while True:
                     while pipe.has_room():
                         nb, nbp, nba, nbprm = bufs
+                        eb_arg, emeta_arg = None, None
+                        if self._edges_on:
+                            # gid_base maps a next-buffer row to its
+                            # global gid (spilled rows precede the
+                            # buffer); src_base lifts a chunk row to
+                            # its frontier gid.  Both are constant
+                            # within a pipelined burst: spills only
+                            # happen behind a drained pause
+                            eb_arg = ebufs
+                            emeta_arg = {
+                                "n": pend_en,
+                                "src_base": jnp.asarray(
+                                    level_base + chunk_start, I32),
+                                "gid_base": jnp.asarray(
+                                    level_base + n_front
+                                    + n_next_total, I32)}
                         out = pipe.launch(
-                            self._level, table["slots"], dev_chunk,
+                            self._level, table, dev_chunk,
                             jnp.asarray(n_c, I32), pend_t,
                             nb, nbp, nba, nbprm, pend_nn,
                             jnp.asarray(bool(check_deadlock)),
+                            eb_arg, emeta_arg,
                             fresh=self._fresh_jit,
                             label=f"level {depth} dispatch")
                         self._fresh_jit = False
@@ -408,6 +536,11 @@ class PagedBFS(DeviceBFS):
                         bufs = (out["nb"], out["nbp"], out["nba"],
                                 out["nbprm"])
                         pend_t, pend_nn = out["t"], out["nn"]
+                        if self._edges_on:
+                            table["gids"] = out["gids"]
+                            ebufs = (out["eb_src"], out["eb_aid"],
+                                     out["eb_dst"])
+                            pend_en = out["edge_n"]
                     out, sc = pipe.collect(pull)
                     reason, start_t, n_next, gen_add, dist_add = (
                         int(x) for x in sc[:5])
@@ -415,6 +548,8 @@ class PagedBFS(DeviceBFS):
                     fp_count += dist_add
                     self._act_counts += np.asarray(sc[5], np.int64)
                     self._fold_need(sc[6])
+                    if self._edges_on:
+                        n_edge = int(sc[7])
 
                     if reason == RUNNING:
                         obs.progress(depth=depth, distinct=fp_count,
@@ -464,6 +599,12 @@ class PagedBFS(DeviceBFS):
                         self.spill_count += 1
                         spill()
                         pend_nn = jnp.asarray(0, I32)
+                    elif reason == R_EDGE_FLUSH:
+                        # edge append buffer full (ISSUE 15): drain the
+                        # committed triples into the CSR builder and
+                        # re-enter — the edge analog of the spill above
+                        drain_edges()
+                        pend_en = jnp.asarray(0, I32)
                     elif reason == R_BAG_GROW:
                         old = self.codec.shape.MAX_MSGS
                         spill()
@@ -496,6 +637,8 @@ class PagedBFS(DeviceBFS):
                         self.next_cap = max(
                             self.next_cap, self._total_E() + self.tile)
                         bufs = self._alloc_bufs(self.next_cap)
+                        if self._edges_on:
+                            refloor_edges()
                         put_chunk()     # same chunk, re-enter at start_t
                         pend_t = jnp.asarray(start_t, I32)
                         pend_nn = jnp.asarray(0, I32)
@@ -516,6 +659,9 @@ class PagedBFS(DeviceBFS):
                             self.next_cap = self._total_E() + self.tile
                             bufs = self._alloc_bufs(self.next_cap)
                             pend_nn = jnp.asarray(0, I32)
+                        if self._edges_on and self.edge_cap < \
+                                self._total_E() + self.tile:
+                            refloor_edges()
                     elif reason == R_SLOT_ERR:
                         raise TLAError(
                             "dense-layout slot collision (a second DVC "
@@ -542,9 +688,13 @@ class PagedBFS(DeviceBFS):
                     if max_seconds and time.time() - t0 > max_seconds:
                         stop = f"time budget {max_seconds}s reached"
                         break
-                # chunk done (or stopped): spill whatever accumulated
+                # chunk done (or stopped): spill whatever accumulated,
+                # and drain the chunk's committed edge triples (so the
+                # CSR builder sees whole chunks in commit order and a
+                # level boundary always finds the buffer empty)
                 self._account_tiles(min(start_t, n_tiles_c))
                 spill()
+                drain_edges()
                 chunk_start += n_c
 
             # ---- level complete: assemble next frontier on host ------
@@ -599,6 +749,17 @@ class PagedBFS(DeviceBFS):
                     if isinstance(host_front, SpillTier) else
                     {"frontier": self._front_dense(host_front,
                                                    n_front)})
+                if self._edges_on:
+                    # edge-stream seam (ISSUE 15): the gid column,
+                    # the drained edge rows up to this committed
+                    # level, and — on a retain_levels (temporal) run
+                    # — the retained level blocks, so a SIGTERM'd
+                    # temporal run resumes to a bit-identical CSR
+                    fr_kw["gids"] = np.asarray(table["gids"])
+                    fr_kw["edge_blocks"] = self.edge_sink.blocks()
+                    if self.retain_levels:
+                        fr_kw["graph_blocks"] = iter(
+                            self.level_blocks)
                 with obs.timer("checkpoint"):
                     save_checkpoint(
                         checkpoint_path,
@@ -656,6 +817,18 @@ class PagedBFS(DeviceBFS):
             for t in self._tiers:
                 t.drop()
             self._tiers = []
+        if self._edges_on:
+            # edge-stream gauges (ISSUE 15): cumulative drained bytes,
+            # the append buffer's observed high water, and the
+            # headline emission rate over the run's wall clock
+            from .spill import EdgeCSR as _E
+            obs.gauge("edge_bytes",
+                      int(self._edge_rows_total) * _E.ROW_BYTES)
+            obs.gauge("edge_buf_high_water", int(self._edge_hw))
+            el = max(time.time() - (self._run_t0 or time.time()),
+                     1e-9)
+            obs.gauge("edges_per_s",
+                      round(self._edge_rows_total / el, 1))
         return super()._finish(res, obs, fp_count, table=table,
                                fp_cap=fp_cap)
 

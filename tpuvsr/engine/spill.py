@@ -216,3 +216,109 @@ class SpillTier:
         self._ram = []
         self._ram_count = 0
         self._last = None
+
+
+class EdgeCSR:
+    """Incremental host CSR builder for the streamed behavior graph
+    (ISSUE 15).  The level kernel's edge-emission commit drains
+    ``(src gid, action id, dst gid)`` triples here in COMMIT ORDER;
+    ``finalize(n)`` assembles the CSR arrays ``(indptr[n+1], aid[m],
+    tid[m])`` the fair-SCC machinery consumes, preserving the drained
+    order within each source's segment (the documented bit-identity
+    contract: streamed vs two-pass CSRs agree modulo edge order within
+    a (src, level) segment).
+
+    Two storage modes: plain RAM blocks, or — past a RAM budget — the
+    :class:`SpillTier` disk tier (append-only edge page files under
+    ``<spill_dir>/edges``), so a 10^8-edge graph's triples never
+    compete with the frontier for host RAM during the BFS.  A per-src
+    degree count accumulates as blocks arrive, so ``finalize`` is two
+    sequential passes (prefix-sum the counts, then scatter each block
+    into its cursor positions) with no global sort."""
+
+    #: bytes one edge row costs on the device append buffer
+    ROW_BYTES = 12          # 3 x int32
+
+    def __init__(self, spill_dir=None, ram_rows=None, obs=None):
+        self._tier = None
+        self._blocks = []
+        if spill_dir:
+            self._tier = SpillTier(os.path.join(spill_dir, "edges"),
+                                   0, ram_rows or (1 << 20), obs=obs)
+        self._counts = np.zeros(1024, np.int64)
+        self.rows = 0
+
+    def append(self, src, aid, dst):
+        src = np.ascontiguousarray(src, np.int64)
+        n = int(src.shape[0])
+        if n == 0:
+            return
+        hi = int(src.max()) + 1
+        if hi > self._counts.shape[0]:
+            grown = np.zeros(max(hi, 2 * self._counts.shape[0]),
+                             np.int64)
+            grown[:self._counts.shape[0]] = self._counts
+            self._counts = grown
+        self._counts[:hi] += np.bincount(src, minlength=hi)
+        block = {"src": src,
+                 "aid": np.ascontiguousarray(aid, np.int32),
+                 "dst": np.ascontiguousarray(dst, np.int32)}
+        if self._tier is not None:
+            self._tier.append(block)
+        else:
+            self._blocks.append(block)
+        self.rows += n
+
+    def seed(self, block):
+        """Re-seed from a checkpoint's reassembled edge payload (one
+        dict of concatenated src/aid/dst arrays): the resumed stream
+        continues in the same order, so the final CSR is bit-identical
+        to an uninterrupted run's."""
+        self.append(block["src"], block["aid"], block["dst"])
+
+    def blocks(self):
+        """Iterator of the accumulated blocks in drain order — the
+        checkpoint writer's streaming input (one page resident at a
+        time on the disk tier)."""
+        if self._tier is not None:
+            for _pos, _n, load in self._tier._iter_pages():
+                yield load()
+        else:
+            yield from self._blocks
+
+    def finalize(self, n):
+        """Assemble ``(indptr, aid, tid)`` over node ids ``0..n-1``."""
+        assert int(self._counts[n:].sum()) == 0, \
+            "edge stream names a src gid beyond the state count"
+        if self._counts.shape[0] < n:
+            # counts only grow to the highest EDGE-EMITTING src gid —
+            # trailing terminal states (no enabled action) are legal
+            # zero-degree nodes, so pad rather than crash
+            grown = np.zeros(n, np.int64)
+            grown[:self._counts.shape[0]] = self._counts
+            self._counts = grown
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(self._counts[:n], out=indptr[1:])
+        assert int(indptr[-1]) == self.rows
+        aid = np.empty(self.rows, np.int32)
+        tid = np.empty(self.rows, np.int32)
+        cursor = indptr[:-1].copy()
+        for block in self.blocks():
+            s = np.asarray(block["src"], np.int64)
+            order = np.argsort(s, kind="stable")
+            ss = s[order]
+            first = np.concatenate([[True], ss[1:] != ss[:-1]])
+            starts = np.flatnonzero(first)
+            runs = np.diff(np.concatenate([starts, [ss.shape[0]]]))
+            rank = np.arange(ss.shape[0]) - np.repeat(starts, runs)
+            pos = cursor[ss] + rank
+            aid[pos] = np.asarray(block["aid"], np.int32)[order]
+            tid[pos] = np.asarray(block["dst"], np.int32)[order]
+            cursor[ss[starts]] += runs
+        assert (cursor == indptr[1:]).all()
+        return indptr, aid, tid
+
+    def drop(self):
+        if self._tier is not None:
+            self._tier.drop()
+        self._blocks = []

@@ -36,6 +36,10 @@ EVENT_REQUIRED = {
                    "elapsed_s"),
     "checkpoint": ("path", "depth", "distinct", "elapsed_s"),
     "spill": ("depth", "rows", "bytes", "elapsed_s"),
+    # streamed edge emission (ISSUE 15): a committed block of behavior-
+    # graph (src, action, dst) triples drained off the device append
+    # buffer into the host CSR builder — the edge-stream spill analog
+    "edge_flush": ("depth", "rows", "bytes", "elapsed_s"),
     "grow": ("what", "to", "elapsed_s"),
     "violation": ("kind", "name", "elapsed_s"),
     "run_end": ("ok", "elapsed_s"),
@@ -66,6 +70,10 @@ EVENT_REQUIRED = {
     # heartbeat; this row is the human-readable trail)
     "sched_decision": ("job_id", "tenant", "policy"),
     "worker_heartbeat": ("job_id", "worker"),
+    # serving tier (ISSUE 15 satellite): the pool parent respawned a
+    # dead worker process (bounded restarts with backoff; `rc` is the
+    # dead child's exit status, `attempt` the restart count)
+    "worker_respawn": ("worker", "attempt", "rc"),
     # walker-fleet simulation (ISSUE 7): the chunk boundary is the
     # sim analog of level_done (walks/steps cumulative); `split` is an
     # importance-splitting resample; `hunt_violation` a UNIQUE

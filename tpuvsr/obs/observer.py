@@ -99,6 +99,12 @@ class RunObserver:
         # on engines without the seam — journaled on run_start with
         # key-set parity
         self.bounds = None
+        # streamed edge emission in effect (ISSUE 15): True when the
+        # run's level kernel appends (src, action, dst) triples to the
+        # behavior-graph stream, False when the seam exists but is
+        # off, None on engines without it — journaled on run_start
+        # with key-set parity
+        self.edges = None
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -171,7 +177,8 @@ class RunObserver:
                            pack=bool(self.pack),
                            commit=self.commit,
                            symmetry=self.symmetry,
-                           bounds=self.bounds, **extra)
+                           bounds=self.bounds,
+                           edges=self.edges, **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")
@@ -250,6 +257,17 @@ class RunObserver:
         self.count("grows")
         self.count(f"grow_{what}")
         self.journal.write("grow", what=what, to=int(to),
+                           elapsed_s=round(self.elapsed(), 3))
+
+    def edge_flush(self, depth, rows, nbytes):
+        """A committed block of behavior-graph edge triples drained
+        off the device append buffer into the host CSR builder
+        (ISSUE 15) — the edge-stream analog of ``spill``."""
+        self.count("edge_flushes")
+        self.count("edge_rows", rows)
+        self.count("edge_bytes", nbytes)
+        self.journal.write("edge_flush", depth=int(depth),
+                           rows=int(rows), bytes=int(nbytes),
                            elapsed_s=round(self.elapsed(), 3))
 
     # -- resilience events (ISSUE 3) -----------------------------------

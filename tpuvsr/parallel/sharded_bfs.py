@@ -627,6 +627,9 @@ class ShardedBFS:
         self.axis = axis
         self.D = mesh.shape[axis]
         self.tile = tile
+        # streamed edge emission (ISSUE 15) is a single-device paged
+        # seam — the sharded engine journals the key as off
+        self._edges_on = False
         # level-kernel commit mode (ISSUE 10): "fused" compacts each
         # tile's enabled lanes through the guard matrix before
         # expansion (occupancy-packed; exact-need cap growth);
@@ -858,6 +861,7 @@ class ShardedBFS:
         obs.commit = self.commit
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
+        obs.edges = self._edges_on
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)

@@ -17,6 +17,16 @@ never mistaken for dead) — the kill-one-of-N drill in
 ``scripts/fault_matrix.py`` proves the survivor finishes the dead
 worker's job bit-identically.
 
+The parent also RESPAWNS dead workers (ISSUE 15 satellite, the
+ROADMAP item 2 residual): a child that exits nonzero (SIGKILL, OOM,
+crash) is relaunched under the same worker id after an exponential
+backoff, up to ``max_restarts`` times per worker slot — journaled as
+``worker_respawn`` into ``<spool>/pool.jsonl`` — so a transiently
+killed fleet heals itself instead of merely having its stale claims
+swept onto the survivors.  Clean exits (rc 0, a finished --drain) are
+never respawned, and a slot that keeps dying stays down once its
+budget is spent.
+
 Device groups are sized, not pinned: each child gets ``--devices
 total//N`` (its DevicePool budget).  On the CPU stub harness every
 process sees its own virtual devices, so groups never collide; real
@@ -60,7 +70,8 @@ class WorkerPool:
 
     def __init__(self, spool, workers=2, *, devices=None, drain=True,
                  max_seconds=None, max_jobs=None, extra_args=(),
-                 env=None, python=None, log=None):
+                 env=None, python=None, log=None, max_restarts=3,
+                 restart_backoff=1.0):
         self.spool = os.path.abspath(spool)
         self.workers = max(1, int(workers))
         self.devices = devices
@@ -73,6 +84,15 @@ class WorkerPool:
         self.log = log
         self.procs = []
         self.log_dir = os.path.join(self.spool, "workers")
+        # dead-worker respawn budget (ISSUE 15 satellite): per worker
+        # SLOT, with exponential backoff between restarts; journaled
+        # to <spool>/pool.jsonl as worker_respawn events
+        self.max_restarts = max(0, int(max_restarts))
+        self.restart_backoff = float(restart_backoff)
+        self._restarts = {}        # slot index -> restart count
+        self._next_try = {}        # slot index -> earliest retry time
+        self.respawned = 0         # total respawns this pool lifetime
+        self._journal = None
 
     def _cmd(self, i):
         cmd = [self.python, "-m", "tpuvsr", "serve",
@@ -93,17 +113,20 @@ class WorkerPool:
             return self.env
         return child_env()
 
+    def _spawn(self, i):
+        log_path = os.path.join(self.log_dir, f"w{i}.log")
+        fh = open(log_path, "ab")
+        p = subprocess.Popen(
+            self._cmd(i), stdout=fh, stderr=subprocess.STDOUT,
+            env=self._env(), cwd=self.spool)
+        fh.close()                        # the child holds its own fd
+        p._tpuvsr_log = log_path
+        return p
+
     def start(self):
         os.makedirs(self.log_dir, exist_ok=True)
-        env = self._env()
         for i in range(self.workers):
-            log_path = os.path.join(self.log_dir, f"w{i}.log")
-            fh = open(log_path, "ab")
-            p = subprocess.Popen(
-                self._cmd(i), stdout=fh, stderr=subprocess.STDOUT,
-                env=env, cwd=self.spool)
-            fh.close()                    # the child holds its own fd
-            p._tpuvsr_log = log_path
+            p = self._spawn(i)
             self.procs.append(p)
             if self.log:
                 self.log(f"pool: worker w{i} pid {p.pid}")
@@ -111,6 +134,57 @@ class WorkerPool:
 
     def alive(self):
         return sum(1 for p in self.procs if p.poll() is None)
+
+    def pending_respawn(self):
+        """True when some slot is dead-nonzero with restart budget
+        left (possibly waiting out its backoff) — the supervision
+        loop must NOT drain the pool while this holds, or backoff
+        windows would silently eat the remaining budget."""
+        return any(
+            p.poll() is not None and p.poll() != 0
+            and self._restarts.get(i, 0) < self.max_restarts
+            for i, p in enumerate(self.procs))
+
+    def _pool_journal(self):
+        if self._journal is None:
+            from ..obs.journal import Journal
+            self._journal = Journal(
+                os.path.join(self.spool, "pool.jsonl"))
+        return self._journal
+
+    def respawn_dead(self):
+        """Relaunch worker slots whose child exited NONZERO (killed /
+        crashed), bounded to ``max_restarts`` per slot with
+        exponential backoff between attempts; journaled as
+        ``worker_respawn``.  Clean exits (rc 0 — a finished --drain)
+        stay down.  Returns the slot indices respawned this call.
+        Idempotent and cheap: the supervision loop calls it every
+        sweep tick."""
+        out = []
+        now = time.time()
+        for i, p in enumerate(self.procs):
+            rc = p.poll()
+            if rc is None or rc == 0:
+                continue
+            n = self._restarts.get(i, 0)
+            if n >= self.max_restarts:
+                continue
+            if now < self._next_try.get(i, 0.0):
+                continue
+            self._restarts[i] = n + 1
+            self._next_try[i] = now + self.restart_backoff * (2 ** n)
+            self.procs[i] = self._spawn(i)
+            self.respawned += 1
+            out.append(i)
+            self._pool_journal().write(
+                "worker_respawn", worker=f"w{i}",
+                attempt=self._restarts[i], rc=int(rc),
+                pid=self.procs[i].pid)
+            if self.log:
+                self.log(f"pool: worker w{i} died rc={rc}; respawned "
+                         f"as pid {self.procs[i].pid} (attempt "
+                         f"{self._restarts[i]}/{self.max_restarts})")
+        return out
 
     def kill_one(self, i, sig=signal.SIGKILL):
         """Hard-kill worker `i` (fault drills: the dead-worker half of
@@ -138,6 +212,9 @@ class WorkerPool:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait(15)
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
         return [p.returncode for p in self.procs]
 
     def stop(self, sig=signal.SIGTERM):

@@ -160,6 +160,13 @@ def build_parser():
     sv.add_argument("--worker-id", default=None,
                     help="this worker's identity in claim files and "
                          "journals (default: worker-<pid>)")
+    sv.add_argument("--max-restarts", type=int, default=3,
+                    help="--workers N>1: how many times the parent "
+                         "respawns one dead (nonzero-exit) worker "
+                         "slot, with exponential backoff; journaled "
+                         "as worker_respawn in <spool>/pool.jsonl "
+                         "(0 = sweep stale claims only, the pre-"
+                         "ISSUE-15 behavior)")
     sv.add_argument("--http", type=int, default=None, metavar="PORT",
                     help="raise the HTTP front on PORT (0 = an "
                          "ephemeral port, printed on stderr): "
@@ -528,9 +535,17 @@ def _serve_pool(args, q, log, t0, http):
     pool = WorkerPool(
         q.spool, args.workers, devices=args.devices,
         drain=args.drain, max_seconds=args.max_seconds,
-        max_jobs=args.max_jobs, extra_args=passthrough, log=log)
+        max_jobs=args.max_jobs, extra_args=passthrough, log=log,
+        max_restarts=args.max_restarts)
     pool.start()
-    while pool.alive():
+    while True:
+        # respawn BEFORE the liveness check: a tick where every child
+        # died nonzero must relaunch, not drain the pool (ISSUE 15
+        # satellite — the ROADMAP item 2 respawn residual).  A slot
+        # waiting out its backoff counts as pending, not drained
+        pool.respawn_dead()
+        if not pool.alive() and not pool.pending_respawn():
+            break
         q.recover_stale(log=log)
         time.sleep(0.5)
     codes = pool.wait()

@@ -568,6 +568,187 @@ def stub_sym_sharded(n_devices=2, symmetry="auto", inv_pair=False,
         fpset_capacity=kw.pop("fpset_capacity", 1 << 8), **kw)
 
 
+# ---------------------------------------------------------------------
+# liveness fixture (ISSUE 15): a stoppable modular ticker with WEAK
+# FAIRNESS and temporal properties — the tier-1 stand-in for the A01
+# liveness configs.  x cycles mod `modulus` (duplicate-heavy: the wrap
+# edge targets a level-0 state) while Stop freezes the system, so
+# []<>AtZero fails even under WF(Tick) (a stopped state's stuttering
+# lasso is fair: Tick is disabled there) and the stop-free variant
+# satisfies it.  Drives the REAL PagedBFS edge stream + DeviceGraph +
+# fair-SCC machinery with no reference mount.
+# ---------------------------------------------------------------------
+TICKER = """---- MODULE ObsTicker ----
+EXTENDS Naturals
+VARIABLES x, stopped
+
+Init ==
+    /\\ x = 0
+    /\\ stopped = FALSE
+
+Tick ==
+    /\\ stopped = FALSE
+    /\\ x' = (x + 1) % {mod}
+    /\\ UNCHANGED stopped
+
+Stop ==
+    /\\ stopped' = TRUE
+    /\\ UNCHANGED x
+
+Next ==
+    \\/ Tick
+    \\/ Stop
+
+AtZero == x = 0
+Hit == x = 2
+
+Spec == Init /\\ [][Next]_vars
+FairSpec == Init /\\ [][Next]_vars /\\ WF_vars(Tick)
+
+AlwaysEventuallyZero == []<>AtZero
+EventuallyHit == AtZero ~> Hit
+
+vars == <<x, stopped>>
+====
+"""
+
+
+def ticker_spec(spec_name="FairSpec", props=("AlwaysEventuallyZero",),
+                modulus=3, stop=True):
+    """The liveness fixture spec: ``2 * modulus`` reachable states
+    (``modulus`` with ``stop=False``), dup-heavy wrap edges, and a
+    PROPERTY cfg so ``liveness_check`` runs end to end.  The stop-free
+    ``FairSpec`` satisfies []<>AtZero; every stoppable variant
+    violates it by a fair stuttering lasso."""
+    src = TICKER.replace("{mod}", str(int(modulus)))
+    if not stop:
+        src = src.replace("    \\/ Stop\n", "")
+    cfg = parse_cfg_text(f"SPECIFICATION {spec_name}\nPROPERTY\n"
+                         + "\n".join(props) + "\n")
+    return SpecModel(parse_module_text(src), cfg)
+
+
+def stub_ticker_factory(modulus=3, stop=True):
+    """``model_factory`` for the Ticker fixture: the codec/kernel pair
+    the PagedBFS edge stream and the DeviceGraph predicate batcher
+    consume (ISSUE 15)."""
+    import jax
+    import jax.numpy as jnp
+
+    class _Shape:
+        MAX_MSGS = 4
+
+    class TickCodec:
+        MSG_KEYS = ()
+
+        def __init__(self):
+            self.shape = _Shape()
+
+        def zero_state(self):
+            return {"status": 0, "x": 0, "stopped": 0, "err": 0}
+
+        def plane_bounds(self, ranges):
+            return {"status": (0, 1), "x": (0, modulus - 1),
+                    "stopped": (0, 1), "err": (0, 1)}
+
+        def encode(self, st):
+            return {"status": np.int32(0), "x": np.int32(st["x"]),
+                    "stopped": np.int32(bool(st["stopped"])),
+                    "err": np.int32(0)}
+
+        def decode(self, d):
+            return {"x": int(np.asarray(d["x"])),
+                    "stopped": bool(int(np.asarray(d["stopped"])))}
+
+        def pad_msgs(self, batch, old):
+            return batch
+
+    class TickKern:
+        action_names = ["Tick", "Stop"] if stop else ["Tick"]
+        n_lanes = 2 if stop else 1
+
+        def _lane_count(self, name):
+            return 1
+
+        def _guard_fns(self):
+            fns = [lambda st, ln: st["stopped"] == 0]
+            if stop:
+                fns.append(lambda st, ln: st["status"] == 0)  # TRUE
+            return fns
+
+        def _action_fns(self):
+            def tick(st, ln):
+                succ = {"status": st["status"],
+                        "x": (st["x"] + 1) % modulus,
+                        "stopped": st["stopped"], "err": jnp.int32(0)}
+                return succ, st["stopped"] == 0
+
+            def stp(st, ln):
+                succ = {"status": st["status"], "x": st["x"],
+                        "stopped": jnp.int32(1), "err": jnp.int32(0)}
+                return succ, st["status"] == 0
+            return [tick, stp] if stop else [tick]
+
+        lane_action = (np.array([0, 1], np.int32) if stop
+                       else np.array([0], np.int32))
+        lane_param = (np.array([0, 0], np.int32) if stop
+                      else np.array([0], np.int32))
+
+        def step_all(self, st):
+            succs, ens = [], []
+            for f in self._action_fns():
+                s, e = f(st, jnp.int32(0))
+                succs.append(s)
+                ens.append(e)
+            return ({k: jnp.stack([s[k] for s in succs])
+                     for k in succs[0]}, jnp.stack(ens))
+
+        def fingerprint(self, st):
+            x = jnp.uint32(st["x"])
+            s = jnp.uint32(st["stopped"])
+            return jnp.stack([x * jnp.uint32(2) + s + jnp.uint32(1),
+                              x + jnp.uint32(1), s + jnp.uint32(1),
+                              jnp.uint32(55)])
+
+        def fingerprint_batch(self, batch):
+            arr = {k: jnp.asarray(v) for k, v in batch.items()}
+            return jax.vmap(self.fingerprint)(arr)
+
+        def invariant_fn(self, names):
+            return lambda st: jnp.asarray(True)
+
+    return lambda spec, max_msgs=None: (TickCodec(), TickKern())
+
+
+def canon_csr(csr_or_graph):
+    """Per-src sorted CSR segments — the ONE comparison form of the
+    documented streamed/two-pass bit-identity contract (ISSUE 15:
+    edge order within one source's segment is unordered).  Accepts a
+    DeviceGraph or a raw ``(indptr, aid, tid)`` triple; shared by the
+    tests, ``scripts/liveness_speedup.py`` and
+    ``scripts/fault_matrix.py`` so the oracle cannot drift."""
+    indptr, aid, tid = getattr(csr_or_graph, "csr", csr_or_graph)
+    return [sorted(zip(aid[indptr[u]:indptr[u + 1]],
+                       tid[indptr[u]:indptr[u + 1]]))
+            for u in range(len(indptr) - 1)]
+
+
+def stub_graph_engine(spec=None, modulus=3, stop=True, **kw):
+    """A small ``PagedBFS(retain_levels=True, edges=True)`` over the
+    Ticker fixture — the standard harness for the streamed behavior
+    graph (ISSUE 15).  ``edges="two-pass"``-style oracles pass
+    ``edges=False`` and build the graph through
+    ``DeviceGraph(mode="two-pass")``."""
+    from .engine.paged_bfs import PagedBFS
+    return PagedBFS(
+        spec or ticker_spec(modulus=modulus, stop=stop),
+        model_factory=stub_ticker_factory(modulus=modulus, stop=stop),
+        hash_mode="full", tile_size=kw.pop("tile_size", 4),
+        fpset_capacity=kw.pop("fpset_capacity", 1 << 8),
+        next_capacity=kw.pop("next_capacity", 1 << 6),
+        retain_levels=True, edges=kw.pop("edges", True), **kw)
+
+
 def bad_counter_spec():
     """A counter-spec variant that FAILS the speclint frames pass
     (IncX leaves ``y`` unframed) — the admission-rejection fixture for
