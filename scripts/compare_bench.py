@@ -274,6 +274,49 @@ def gate_symmetry(base_doc, cand_doc, max_regression):
     return rc
 
 
+def gate_por(base_doc, cand_doc, max_regression):
+    """The partial-order-reduction regression gate (ISSUE 16): 0
+    ok/advisory/absent, 1 when — at matching por modes — the
+    candidate's ``por_cut_ratio`` (generated kept / generated full
+    under the ample filter; lower is better) GREW beyond tolerance:
+    the static independence facts or the ample filter stopped cutting
+    interleavings they used to cut.  A por-mode mismatch (the gauge
+    present in only one document, or different eligible-action
+    counts) measures different explorations — advisory, like the
+    symmetry and commit mismatches."""
+    bm, cm = find_metrics(base_doc), find_metrics(cand_doc)
+    if not (bm and cm):
+        return 0
+    bg, cg = bm.get("gauges", {}), cm.get("gauges", {})
+    b, c = bg.get("por_cut_ratio"), cg.get("por_cut_ratio")
+    if b is None and c is None:
+        return 0
+    if b is None or c is None:
+        print(f"  por_cut_ratio: {b} -> {c} (POR toggled between the "
+              f"documents — comparison is advisory)")
+        return 0
+    print(f"por_cut_ratio: baseline {b} -> candidate {c}  "
+          f"[{fmt_delta(b, c)}]")
+    be = bg.get("por_eligible_actions")
+    ce = cg.get("por_eligible_actions")
+    if be != ce:
+        print(f"  por_eligible_actions: {be} -> {ce} (different "
+              f"ample filters — comparison is advisory)")
+        return 0
+    if not be:
+        print("  no eligible actions in either document — por gate "
+              "not applicable")
+        return 0
+    # cut ratio is a cost: growth beyond tolerance means the
+    # reduction regressed (gate direction inverted, like bytes/state)
+    if b > 0 and c > b * (1.0 + max_regression / 100.0):
+        print(f"compare_bench: por_cut_ratio REGRESSION beyond "
+              f"{max_regression:.1f}% tolerance (the ample-set "
+              f"reduction weakened)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def liveness_stats(doc):
     """Liveness-path health of a document (ISSUE 15):
     ``(edges_per_s, check_s, mode, overhead)`` or all-None.  Reads
@@ -476,8 +519,12 @@ def main(argv=None):
     # check_s growth fail at matching graph-construction modes;
     # streamed-vs-two-pass mismatches are advisory
     liv_rc = gate_liveness(base_doc, cand_doc, args.max_regression)
+    # the ample-set reduction likewise (ISSUE 16): por_cut_ratio
+    # growth fails at matching por modes; on/off mismatches are
+    # advisory
+    por_rc = gate_por(base_doc, cand_doc, args.max_regression)
     sim_rc = (sim_rc or val_rc or pack_rc or sym_rc or liv_rc
-              or (1 if occ_regressed else 0))
+              or por_rc or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch or commit_mismatch:

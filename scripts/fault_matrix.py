@@ -70,6 +70,12 @@ reference mount, no TPU, seconds on the CPU backend:
                      off) kill/resume pairs both reach the exact
                      fixpoint, and a flipped -bounds resume is
                      REFUSED (policy error)
+  kill-por-resume    SIGTERM mid-run with the ample-set reduction
+                     live (ISSUE 16) -> rescue snapshot recording the
+                     independence facts digest; the matched resume
+                     completes the exact REDUCED fixpoint, and a
+                     flipped -por resume is REFUSED in both
+                     directions
   kill-validate-resume  SIGTERM mid-batch on a kind="validate" job
                      (ISSUE 8) -> candidate-frontier rescue at the
                      committed chunk boundary, preempt-requeue through
@@ -467,6 +473,76 @@ def scenario_kill_bounds_resume(tmp):
         "distinct_tightened": res_on.distinct_states,
         "distinct_untightened": res_off.distinct_states,
         "flip_refused": flipped,
+    }
+
+
+def scenario_kill_por_resume(tmp):
+    """ISSUE 16 satellite: kill mid-run with the ample-set reduction
+    live -> rescue checkpoint recording the independence facts digest;
+    the matched resume completes the exact REDUCED fixpoint
+    bit-identically, and a flipped -por resume is REFUSED in both
+    directions (on-snapshot -> off engine, off-snapshot -> on
+    engine)."""
+    from tpuvsr.core.values import TLAError
+    from tpuvsr.obs import RunObserver, read_journal
+    from tpuvsr.resilience import faults
+    from tpuvsr.resilience.supervisor import (Preempted,
+                                              PreemptionGuard)
+    from tpuvsr.testing import (POR_STUB_DISTINCT, POR_STUB_LEVELS,
+                                counter_spec, stub_device_engine)
+
+    def kill_run(ck, jp, **kw):
+        faults.install("kill@level=3")
+        preempted = None
+        try:
+            with PreemptionGuard():
+                try:
+                    eng = stub_device_engine(
+                        spec=counter_spec(inv_free=True), **kw)
+                    eng.run(checkpoint_path=ck,
+                            obs=RunObserver(journal_path=jp))
+                except Preempted as p:
+                    preempted = p
+        finally:
+            faults.clear()
+        return preempted
+
+    ck_on = os.path.join(tmp, "por-on-ck")
+    jp = os.path.join(tmp, "por.jsonl")
+    p_on = kill_run(ck_on, jp, por="on")
+    if p_on is None:
+        return {"ok": False, "why": "no Preempted raised (on leg)"}
+    res_on = stub_device_engine(spec=counter_spec(inv_free=True),
+                                por="on").run(resume_from=ck_on)
+    flip_off = False
+    try:
+        stub_device_engine(spec=counter_spec(inv_free=True)).run(
+            resume_from=ck_on)
+    except TLAError:
+        flip_off = True
+    ck_off = os.path.join(tmp, "por-off-ck")
+    p_off = kill_run(ck_off, os.path.join(tmp, "por-off.jsonl"))
+    if p_off is None:
+        return {"ok": False, "why": "no Preempted raised (off leg)"}
+    flip_on = False
+    try:
+        stub_device_engine(spec=counter_spec(inv_free=True),
+                           por="on").run(resume_from=ck_off)
+    except TLAError:
+        flip_on = True
+    starts = [e for e in read_journal(jp)
+              if e["event"] == "run_start"]
+    return {
+        "ok": (p_on.depth == 3 and res_on.ok
+               and res_on.distinct_states == POR_STUB_DISTINCT
+               and res_on.levels == POR_STUB_LEVELS
+               and flip_off and flip_on
+               and all((e.get("por") or {}).get("eligible_actions")
+                       == 2 for e in starts)),
+        "rescue_depth": p_on.depth,
+        "distinct_reduced": res_on.distinct_states,
+        "flip_off_refused": flip_off,
+        "flip_on_refused": flip_on,
     }
 
 
@@ -1056,6 +1132,7 @@ SCENARIOS = [
     ("kill-canon-resume", scenario_kill_canon_resume),
     ("kill-spill-resume", scenario_kill_spill_resume),
     ("kill-bounds-resume", scenario_kill_bounds_resume),
+    ("kill-por-resume", scenario_kill_por_resume),
     ("corrupt-ckpt", scenario_corrupt_ckpt),
     ("garble-ckpt", scenario_garble_ckpt),
     ("exchange-drop", scenario_exchange_drop),

@@ -9,13 +9,17 @@ kernel).  Exit code 0 when every model is clean of error-severity
 findings, 1 otherwise, 3 when the reference corpus is not mounted.
 
 Usage:
-    python scripts/lint_corpus.py [--json] [--bounds] [only_stem_substr]
+    python scripts/lint_corpus.py [--json] [--bounds] [--independence]
+                                  [only_stem_substr]
 
 --json emits one JSON object: {model: report_dict, ...} plus an "ok"
 summary key, mirroring the CLI's `-lint -json` per-spec shape.
 --bounds adds a per-model bounds-pass column (ISSUE 13): tightened?,
 dead-action count and the static state bound — the facts the engines
 consume, read straight off each report's extras["bounds"] section.
+--independence adds the pass-7 column (ISSUE 16): independent-pair
+count, poisoned/invisible action tallies and monotone-witness count —
+how much ample-set reduction each corpus model statically admits.
 """
 
 import json
@@ -143,9 +147,28 @@ def _bounds_col(report):
             f"state_bound={'unbounded' if sb is None else sb}")
 
 
+def _indep_col(report):
+    """One-line independence summary column (ISSUE 16): the pairs the
+    ample-set filter could consume plus the refusal tallies (poisoned
+    actions, invariant-visible actions, monotone witnesses — the
+    sharded proviso's currency)."""
+    d = report.extras.get("independence") or {}
+    if not d:
+        return "independence: (pass did not run)"
+    vis = d.get("visible") or {}
+    mono = d.get("monotone") or {}
+    return (f"independence: pairs={d.get('independent_pairs')} "
+            f"actions={len(d.get('actions') or [])} "
+            f"poisoned={len(d.get('poisoned') or {})} "
+            f"invisible={sum(1 for v in vis.values() if not v)} "
+            f"witnesses={sum(1 for v in mono.values() if v)} "
+            f"digest={d.get('digest')}")
+
+
 def main(argv):
     as_json = "--json" in argv
     with_bounds = "--bounds" in argv
+    with_indep = "--independence" in argv
     rest = [a for a in argv if not a.startswith("--")]
     only = rest[0] if rest else ""
 
@@ -171,6 +194,8 @@ def main(argv):
             print(f"==== {stem} ({dt:.2f}s)")
             if with_bounds:
                 print(_bounds_col(r))
+            if with_indep:
+                print(_indep_col(r))
             print(r.render())
         print(f"==== corpus {'CLEAN' if ok else 'HAS ERRORS'} "
               f"({time.time() - t0:.2f}s total)")

@@ -182,6 +182,64 @@ def test_cli_bounds_flag_conflicts_exit_2(bad):
 
 
 @pytest.mark.parametrize("bad", [
+    ["-por", "on", "-lint=off"],
+    ["-por", "on", "-engine", "interp"],
+    ["-por", "on", "-fpset", "host"],
+    ["-por", "on", "-simulate"],
+    ["-por", "on", "-validate", "t.jsonl"],
+    ["-por", "on", "-edges", "on"],
+    ["-por", "on", "-commit", "per-action"],
+    ["-por", "maybe"],
+], ids=["lint-off", "interp", "fpset-host", "simulate", "validate",
+        "edges-on", "per-action", "bad-mode"])
+def test_cli_por_flag_conflicts_exit_2(bad):
+    """ISSUE 16 satellite: -por on consumes the speclint independence
+    pass inside the fused device commit, so -lint=off (untrusted
+    facts), the interpreter engine, the non-BFS modes, -edges on (the
+    behavior graph must cover the full relation) and -commit
+    per-action are argparse errors (exit 2) before any spec is
+    loaded."""
+    r = _run("X.tla", *bad)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "usage" in r.stderr or "error" in r.stderr
+
+
+def test_cli_por_on_spec_level_refusals_exit_2(tmp_path):
+    """The two refusals that need the spec: -por on with a PROPERTY
+    cfg (the reduction preserves invariant/deadlock verdicts, not the
+    liveness graph) and -por on resolving to the interpreter (a
+    forced flag must not be silently inert) — both exit 2."""
+    spec = """---- MODULE Po ----
+EXTENDS Naturals
+VARIABLES x
+Init == x = 0
+Incr == x' = (x + 1) % 3
+Next == Incr
+vars == <<x>>
+AtZero == x = 0
+Prop == []<>AtZero
+Spec == Init /\\ [][Next]_vars
+====
+"""
+    (tmp_path / "Po.tla").write_text(spec)
+    (tmp_path / "Po.cfg").write_text(
+        "SPECIFICATION Spec\nPROPERTY Prop\n")
+    r = _run(str(tmp_path / "Po.tla"), "-por", "on")
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "temporal" in r.stderr
+    # no PROPERTY, but the module has no compiled device kernel: the
+    # auto-resolved interpreter cannot host the ample filter
+    (tmp_path / "Po.cfg").write_text("INIT Init\nNEXT Next\n")
+    r2 = _run(str(tmp_path / "Po.tla"), "-por", "on")
+    assert r2.returncode == 2, (r2.stdout, r2.stderr)
+    assert "interpreter" in r2.stderr
+    # -por off is inert everywhere — parses and runs
+    r3 = _run(str(tmp_path / "Po.tla"), "-por", "off",
+              "-engine", "interp")
+    assert r3.returncode == 0, (r3.stdout, r3.stderr)
+
+
+@pytest.mark.parametrize("bad", [
     ["-edges", "on", "-simulate"],
     ["-edges", "on", "-validate", "t.jsonl"],
     ["-edges", "on", "-symmetry", "on"],

@@ -299,6 +299,46 @@ def test_compare_bench_gate_liveness(tmp_path):
               {"metrics": _metrics_doc(1000.0)}) == 0
 
 
+def _por_doc(distinct_per_s, cut=None, eligible=2):
+    d = _metrics_doc(distinct_per_s)
+    if cut is not None:
+        d["gauges"].update(por_cut_ratio=cut, ample_states=3,
+                           por_eligible_actions=eligible)
+    return d
+
+
+def test_compare_bench_gate_por(tmp_path):
+    """ISSUE 16 satellite: por_cut_ratio GROWTH (the reduction
+    weakened — cost metric, inverted gate) fails at matching por
+    modes; on/off toggles and different ample filters are advisory,
+    like the symmetry and commit mismatches."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import compare_bench
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_por_doc(1000.0, cut=0.6667)))
+
+    def rc(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return compare_bench.main([str(base), str(p)])
+    # within tolerance
+    assert rc("good.json", _por_doc(1000.0, cut=0.68)) == 0
+    # cut ratio grew beyond tolerance at matching mode: fail
+    assert rc("weak.json", _por_doc(1000.0, cut=0.95)) == 1
+    # POR toggled off in the candidate: advisory
+    assert rc("toggled.json", _por_doc(1000.0)) == 0
+    # different ample filters (eligible-action counts): advisory
+    assert rc("filters.json",
+              _por_doc(1000.0, cut=0.95, eligible=1)) == 0
+    # inert filter on both sides (0 eligible): informational only
+    inert = tmp_path / "inert_base.json"
+    inert.write_text(json.dumps(_por_doc(1000.0, cut=1.0, eligible=0)))
+    p = tmp_path / "inert_cand.json"
+    p.write_text(json.dumps(_por_doc(1000.0, cut=1.0, eligible=0)))
+    assert compare_bench.main([str(inert), str(p)]) == 0
+
+
 # ---------------------------------------------------------------------
 # CLI flags (interp engine; no reference needed)
 # ---------------------------------------------------------------------

@@ -642,8 +642,8 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 22 scenarios since ISSUE 15 (kill-liveness-resume)
-    assert out["ok"] and len(out["scenarios"]) == 22
+    # 23 scenarios since ISSUE 16 (kill-por-resume)
+    assert out["ok"] and len(out["scenarios"]) == 23
 
 
 # ---------------------------------------------------------------------

@@ -17,16 +17,24 @@ properties that are provable BEFORE dispatch:
             and state-space upper bounds — FACTS the engines consume
             (tightened packing, pruned lane tables, exact expansion
             caps, service admission), not just properties they check
+  independence  static action-independence relation (pass 7, ISSUE
+            16): column-refined read/write access sets, the n x n
+            independence matrix, invariant visibility and monotone
+            progress witnesses — the facts behind the engines'
+            ample-set partial-order reduction (``-por``,
+            engine/por.py); unattributable actions poison to
+            dependent-with-all, mirroring bounds' refusal discipline
 
 Entry points:
 
 * ``run_lint(spec)`` — full report (CLI ``-lint``,
   scripts/lint_corpus.py);
-* ``preflight(spec)`` — the engine gate: all six passes (the drift
+* ``preflight(spec)`` — the engine gate: all seven passes (the drift
   kernel cross-check became cheap once the key tables moved to class
-  attributes; the bounds fixpoint is pure-AST and cached), raises
-  ``LintError`` on error-severity findings, caches per spec object,
-  honors ``TPUVSR_LINT=off`` (the CLI's ``-lint=off``).
+  attributes; the bounds fixpoint and independence matrix are
+  pure-AST and cached), raises ``LintError`` on error-severity
+  findings, caches per spec object, honors ``TPUVSR_LINT=off`` (the
+  CLI's ``-lint=off``).
 """
 
 from __future__ import annotations
@@ -43,7 +51,7 @@ __all__ = ["run_lint", "preflight", "lint_enabled", "Finding",
 
 
 def run_lint(spec, passes=None) -> LintReport:
-    """Run the requested passes (default: all six, in canonical
+    """Run the requested passes (default: all seven, in canonical
     order) over a bound spec and return the report."""
     report = LintReport(module=spec.module.name)
     for name in (passes if passes is not None else PASS_ORDER):
@@ -60,7 +68,7 @@ def lint_enabled() -> bool:
 def preflight(spec, log=None):
     """Fail-fast gate the engines call before dispatch.
 
-    Runs all six passes (including the kernel drift cross-check) once
+    Runs all seven passes (including the kernel drift cross-check) once
     per spec object; raises ``LintError`` if any error-severity finding
     survives.  Returns the report (or None when disabled via
     TPUVSR_LINT=off)."""

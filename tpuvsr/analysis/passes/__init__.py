@@ -12,10 +12,10 @@ five-pass set runs at every ``run()`` entry (ROADMAP open item, PR 2);
 
 from __future__ import annotations
 
-from . import bounds, drift, frames, symmetry, vacuity, widths
+from . import bounds, drift, frames, independence, symmetry, vacuity, widths
 
 PASSES = {m.PASS: m.run for m in (frames, widths, vacuity, symmetry,
-                                  drift, bounds)}
+                                  drift, bounds, independence)}
 PASS_ORDER = ("frames", "widths", "vacuity", "symmetry", "drift",
-              "bounds")
+              "bounds", "independence")
 PREFLIGHT_PASSES = PASS_ORDER

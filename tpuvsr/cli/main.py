@@ -227,6 +227,10 @@ host-page store); -bounds on with -lint=off (tightened facts from an
 unverified spec cannot be trusted), with -engine interp/-fpset host,
 or with -simulate/-validate (the fleet and the validator consume no
 bounds facts — a forced flag must not be silently inert);
+-por on with -lint=off/-engine interp/-fpset host/-simulate/
+-validate/-edges on/-commit per-action, or a PROPERTY cfg (the
+ample-set reduction preserves invariant/deadlock verdicts, not the
+behavior graph — the cfg conflict is checked after it loads);
 -validate with -simulate/-hunt/-fused/-supervise/-deadlock/
 -maxstates/-checkpoint/-engine sharded/-fpset hbm|paged (validation
 is its own engine mode: rescue checkpoints are preemption-driven, the
@@ -404,6 +408,23 @@ def build_parser():
                         "on/off; snapshots record the facts digest "
                         "(resuming under a flipped -bounds is a policy "
                         "error)")
+    p.add_argument("-por", choices=["on", "off"], default=None,
+                   metavar="MODE",
+                   help="ample-set partial-order reduction in the "
+                        "fused commit (default on while the lint gate "
+                        "is live and no blocker applies): the speclint "
+                        "independence pass (pass 7) proves pairwise "
+                        "action commutativity; at states where one "
+                        "independent invisible action suffices, the "
+                        "level kernel expands only that action.  "
+                        "Invariant and deadlock verdicts are "
+                        "bit-identical on/off; state/transition COUNTS "
+                        "may shrink.  Refused (forced on errors; auto "
+                        "stays off) under temporal properties, "
+                        "-edges on, -commit per-action, -simulate/"
+                        "-validate, or -lint=off.  Snapshots record "
+                        "the facts digest (resuming under a flipped "
+                        "-por is a policy error)")
     p.add_argument("-lint", nargs="?", const="full", default=None,
                    choices=["full", "off"], metavar="MODE",
                    help="run the speclint static analyzer and exit "
@@ -588,6 +609,38 @@ def validate_args(parser, args):
                          "fleet and the validator consume no bounds "
                          "facts (a forced flag must not be silently "
                          "inert) — drop -bounds on or run BFS mode")
+    if args.por == "on":
+        # ample-set POR (ISSUE 16): verdict-sound only inside the
+        # fused BFS commit with the speclint gate live — every other
+        # mode must refuse a forced flag rather than run it inert
+        if args.lint == "off":
+            parser.error("-por on cannot be combined with -lint=off: "
+                         "the ample-set filter consumes the speclint "
+                         "independence pass — commutativity facts "
+                         "from an unverified spec cannot be trusted "
+                         "(drop -lint=off or run -por off)")
+        if args.engine == "interp" or args.fpset == "host":
+            parser.error("-por on configures the device engines' "
+                         "fused commit (the ample-set filter lives in "
+                         "the level kernel); it cannot be combined "
+                         "with -engine interp/-fpset host")
+        if args.simulate or args.validate is not None:
+            parser.error("-por on configures the BFS engines; the "
+                         "fleet and the validator consume no "
+                         "independence facts (a forced flag must not "
+                         "be silently inert) — drop -por on or run "
+                         "BFS mode")
+        if args.edges == "on":
+            parser.error("-por on cannot be combined with -edges on: "
+                         "the reduced run omits transitions by "
+                         "design, so the streamed behavior graph "
+                         "would be incomplete (and the two share the "
+                         "FPSet gids column)")
+        if args.commit == "per-action":
+            parser.error("-por on needs the fused commit (the "
+                         "ample-set filter is a stage of the fused "
+                         "level kernel); it cannot be combined with "
+                         "-commit per-action")
     if args.validate is not None:
         # trace validation is its own engine mode (ISSUE 8): the
         # check/simulate mode switches and their engine shapes don't
@@ -831,6 +884,11 @@ def main(argv=None):
         parser.error("-edges on: the cfg declares no PROPERTY — "
                      "there is no temporal check to consume the "
                      "behavior-graph stream")
+    if args.por == "on" and spec.temporal_props:
+        parser.error("-por on cannot be combined with temporal "
+                     "properties: the reduced run preserves "
+                     "invariant/deadlock verdicts, not the full "
+                     "behavior graph the liveness checker consumes")
 
     engine = _pick_engine(args.engine, args.fpset, spec)
     if args.spill is not None:
@@ -843,6 +901,12 @@ def main(argv=None):
                          "spec resolved to the interpreter (no "
                          "compiled device kernel)")
         engine = "paged"            # -spill implies the paged engine
+    if args.por == "on" and engine == "interp":
+        # same loud contract as -spill: auto-resolution landing on
+        # the interpreter must not leave a forced -por silently inert
+        parser.error("-por on needs a compiled device kernel (the "
+                     "ample-set filter is a stage of the fused level "
+                     "kernel); this spec resolved to the interpreter")
     if args.pipeline is None:
         # default 2 on every device engine (ISSUE 9: the sharded step
         # now donates its buffers, so the K-generations-in-HBM cost
@@ -860,6 +924,11 @@ def main(argv=None):
     # bounds pre-pass consumption (ISSUE 13): "auto" = on iff the
     # speclint gate is live (engine/bounds.resolve_bounds)
     bounds_kw = {"on": True, "off": False}.get(args.bounds, "auto")
+    # ample-set POR (ISSUE 16): "auto" = on iff the speclint gate is
+    # live and no soundness blocker applies (engine/por.resolve_por);
+    # forced-on conflicts were rejected above, so resolve_por's own
+    # TLAError only fires for spec-level refusals (poisoned facts)
+    por_kw = args.por or "auto"
     spill_kw = ({"spill_dir": args.spill} if args.spill is not None
                 else {})
 
@@ -997,7 +1066,8 @@ def main(argv=None):
                                    "pack": pack_kw,
                                    "commit": commit_kw,
                                    "symmetry": symmetry_kw,
-                                   "bounds": bounds_kw})
+                                   "bounds": bounds_kw,
+                                   "por": por_kw})
                 try:
                     res = sup.run(max_states=args.maxstates,
                                   max_seconds=args.maxseconds,
@@ -1025,7 +1095,7 @@ def main(argv=None):
                 eng = ShardedBFS(spec, mesh, pipeline=args.pipeline,
                                  pack=pack_kw, commit=commit_kw,
                                  symmetry=symmetry_kw,
-                                 bounds=bounds_kw)
+                                 bounds=bounds_kw, por=por_kw)
                 res = eng.run(
                     max_states=args.maxstates,
                     max_seconds=args.maxseconds,
@@ -1059,12 +1129,13 @@ def main(argv=None):
                     eng = PagedBFS(spec, pipeline=args.pipeline,
                                    pack=pack_kw, commit=commit_kw,
                                    symmetry=symmetry_kw,
-                                   bounds=bounds_kw, **spill_kw)
+                                   bounds=bounds_kw, por=por_kw,
+                                   **spill_kw)
                 else:
                     eng = DeviceBFS(spec, pipeline=args.pipeline,
                                     pack=pack_kw, commit=commit_kw,
                                     symmetry=symmetry_kw,
-                                    bounds=bounds_kw)
+                                    bounds=bounds_kw, por=por_kw)
                 use_fused = (args.fused and isinstance(eng, DeviceBFS)
                              and not isinstance(eng, PagedBFS))
                 if args.fused and not use_fused:

@@ -139,7 +139,8 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
                     h_action, h_param, init_dense, level_sizes, depth,
                     fp_count, states_generated, max_msgs, expand_mults,
                     elapsed, digest=None, extra=None, pack=None,
-                    canon=None, bounds=None, frontier_blocks=None,
+                    canon=None, bounds=None, por=None,
+                    frontier_blocks=None,
                     gids=None, edge_blocks=None, graph_blocks=None,
                     obs=None):
     """Write a complete engine snapshot to `path` (atomic + durable).
@@ -239,6 +240,13 @@ def save_checkpoint(path, *, slots, frontier=None, n_front, h_parent,
         # Resuming under a flipped -bounds or changed cfg constants
         # is a policy error, mirroring the pack/canon rules
         "bounds": bounds,
+        # independence-facts identity (ISSUE 16): digest of the
+        # speclint independence pass facts the writer's ample-set
+        # partial-order reduction consumed (the reduced reachable set
+        # depends on them), None when POR off.  Resuming under a
+        # flipped -por or changed facts is a policy error, mirroring
+        # the pack/canon/bounds rules
+        "por": por,
         # engine-specific payload (e.g. the sharded driver's per-shard
         # frontier counts and exchange capacities)
         "extra": extra,
@@ -427,5 +435,6 @@ def load_checkpoint(path, expect_digest=None, log=None):
         "pack": manifest.get("pack"),
         "canon": manifest.get("canon"),
         "bounds": manifest.get("bounds"),
+        "por": manifest.get("por"),
         "restored_from": used,
     }

@@ -137,7 +137,7 @@ class DeviceBFS:
                  next_capacity=1 << 14, chunk_tiles=64, expand_mult=2,
                  expand_mults=None, model_factory=None, pipeline=2,
                  pack="auto", commit="fused", symmetry="auto",
-                 bounds="auto", edges=False):
+                 bounds="auto", edges=False, por="off"):
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
                            f"(got {commit!r})")
@@ -232,6 +232,23 @@ class DeviceBFS:
         from .bounds import resolve_bounds
         self._facts = resolve_bounds(spec, bounds)
         self._pruned = []
+        # ample-set partial-order reduction (ISSUE 16): consume the
+        # independence pass's facts behind the same resolve contract
+        # as -bounds, with the soundness blockers (temporal
+        # properties, edge emission, non-fused commit) refused here
+        # for library callers and at argparse time for the CLI.
+        # Constructor default is "off" — the reduction shrinks
+        # distinct-state counts, so library callers opt in; the CLI's
+        # -por defaults to auto
+        from .por import resolve_por
+        self._por_facts = resolve_por(
+            spec, por,
+            temporal=bool(getattr(spec, "temporal_props", ())),
+            edges=self._edges_on, commit=self.commit)
+        self._por = None
+        self._por_kept = 0
+        self._por_full = 0
+        self._por_amp = 0
         registry.ensure_compile_cache()
         self.debug_checks = registry.ensure_debug_flags()
         self._build(max_msgs)
@@ -345,6 +362,17 @@ class DeviceBFS:
             self._pk_decl = (build_pack_spec(
                 self.codec, spec=spec,
                 force=self._pack_req is True) if tighten else self._pk)
+        # ample-set filter bound to THIS kernel (ISSUE 16): rebuilt
+        # with the kernel so the action-name alignment survives bag
+        # growth and pruning.  _por_active gates the device tables —
+        # facts with no eligible action journal their digest but leave
+        # every jitted graph untouched (bit-identical to por=off)
+        if self._por_facts is not None:
+            from .por import PORFilter
+            self._por = PORFilter(self._por_facts, self.kern)
+        self._por_active = (self._por is not None
+                            and self._por.any_eligible
+                            and self.commit == "fused")
         self._level = jax.jit(self._make_level(),
                               donate_argnums=(0, 4, 5, 6, 7, 10))
         self._ml = None         # fused pass, built lazily (run_fused)
@@ -428,7 +456,10 @@ class DeviceBFS:
             np.arange(len(caps), dtype=np.int32), caps))
 
         def make_body(frontier, n_front, want_deadlock, chunk_ctx=None,
-                      edge_bases=None):
+                      edge_bases=None, pdepth=None):
+            # pdepth is the fused commit's POR level marker; POR is a
+            # resolve_por blocker under per-action commit, so it is
+            # accepted here only for the shared launcher signature
             F_cap = (frontier.shape[0] if pk is not None
                      else frontier["status"].shape[0])
 
@@ -698,9 +729,21 @@ class DeviceBFS:
                                       caps))
         guard_mat = self._guard_matrix(kern)
         edges_on = self._edges_on
+        # ample-set POR (ISSUE 16): amat[a, b] says "expanding only a
+        # is safe given an enabled b" (por.PORFilter); qoff slices the
+        # action-major staging queue back into per-action segments for
+        # the kept-lane counters.  POR and edge emission are mutually
+        # exclusive (resolve_por blocker), so the FPSet gids column
+        # has exactly one meaning per run: graph node ids under
+        # -edges, C3 level markers under -por
+        por_active = self._por_active
+        if por_active:
+            assert not edges_on
+            amat_dev = jnp.asarray(self._por.amat)
+            qoff = np.concatenate(([0], np.cumsum(caps))).astype(int)
 
         def make_body(frontier, n_front, want_deadlock, chunk_ctx=None,
-                      edge_bases=None):
+                      edge_bases=None, pdepth=None):
             F_cap = (frontier.shape[0] if pk is not None
                      else frontier["status"].shape[0])
 
@@ -737,6 +780,22 @@ class DeviceBFS:
                                      jnp.argmax(ovf_vec).astype(I32),
                                      c["grow_aid"])
                 need = jnp.maximum(c["need"], cnts.astype(jnp.uint32))
+                if por_active:
+                    # ample candidate per frontier row: one gather of
+                    # the enabled bitmask against the independence
+                    # matrix — row r may shortcut iff some enabled
+                    # action conflicts with NO enabled action
+                    # (ineligible rows of amat are all-False, so they
+                    # self-veto).  Computed on the UNMASKED guard
+                    # matrix, like en_any/deadlock and need/caps —
+                    # the reduction only ever touches the commit
+                    en_act = jnp.stack([e.any(axis=1) for e in en_segs],
+                                       axis=1)               # [T, n_act]
+                    conflict = (en_act.astype(I32)
+                                @ (~amat_dev).astype(I32).T) > 0
+                    cand = en_act & ~conflict
+                    has_cand = cand.any(axis=1)
+                    aid_star = jnp.argmax(cand, axis=1).astype(I32)
 
                 slots = c["slots"]
                 nb, nbp, nba, nbprm = c["nb"], c["nbp"], c["nba"], c["nbprm"]
@@ -846,7 +905,30 @@ class DeviceBFS:
                 lane_q = jnp.concatenate(lane_segs)
 
                 # -- stage 3: ONE insert batch + ONE scatter per tile --
-                mcommit = en_q & (aid_q < first_bad) & commit0
+                keep_q = en_q
+                if por_active:
+                    # C3 proviso (timing-immune level markers): a row
+                    # takes the ample shortcut only if its ample
+                    # successor is FRESH — absent from the visited set
+                    # (-1) or committed while generating THIS level
+                    # (marker pdepth+1).  A marker <= pdepth means the
+                    # successor closes a potential cycle at this or an
+                    # earlier level: fall back to full expansion.
+                    # Probed on the PRE-insert slots, so a paused
+                    # tile's re-entry sees its own earlier inserts as
+                    # marker pdepth+1 (= fresh) and repeats the same
+                    # decision bit-identically.  Violations/deadlock/
+                    # need stay on the full en_q (stages 1-2 above)
+                    is_amp = (en_q & has_cand[pidx_q]
+                              & (aid_q == aid_star[pidx_q]))
+                    g = lookup_gids({"slots": slots}, c["gids"],
+                                    fp_q, is_amp)
+                    old_i = is_amp & (g >= 0) & (g <= pdepth)
+                    amp_bad = jnp.zeros((T,), bool).at[pidx_q].max(old_i)
+                    take = has_cand & ~amp_bad
+                    keep_q = en_q & (~take[pidx_q]
+                                     | (aid_q == aid_star[pidx_q]))
+                mcommit = keep_q & (aid_q < first_bad) & commit0
                 # stable first-occurrence dedup: the winner among equal
                 # fingerprints is the earliest queue item (= earliest
                 # action, matching the per-action commit order); the
@@ -904,6 +986,35 @@ class DeviceBFS:
                     "act": c["act"] + jnp.where(
                         commit, cnts.astype(jnp.uint32), jnp.uint32(0)),
                 }
+                if por_active:
+                    # gen/act count the KEPT expansions (they feed
+                    # states_generated and action_expansions, which
+                    # must describe the reduced run); gfull keeps the
+                    # unreduced count for the por_cut_ratio gauge, amp
+                    # counts rows where the shortcut dropped real work
+                    kept_act = jnp.stack(
+                        [keep_q[qoff[a]:qoff[a + 1]].sum(dtype=I32)
+                         for a in range(n_act)])
+                    ret["gen"] = c["gen"] + jnp.where(
+                        commit, kept_act.sum(), 0)
+                    ret["act"] = c["act"] + jnp.where(
+                        commit, kept_act.astype(jnp.uint32),
+                        jnp.uint32(0))
+                    ret["gfull"] = c["gfull"] + jnp.where(
+                        commit, gen_local, 0)
+                    n_en_row = en_act.sum(axis=1, dtype=I32)
+                    ret["amp"] = c["amp"] + jnp.where(
+                        commit,
+                        (take & (n_en_row > 1)).sum(dtype=I32), 0)
+                    # level markers ride the insert UNGATED (mask =
+                    # fresh), mirroring the edge-gid persistence rule:
+                    # insert_core mutates slots even on a tile that
+                    # ends up pausing, so the marker must land beside
+                    # the fingerprint for re-entry to probe
+                    ret["gids"] = store_gids(
+                        slots, c["gids"], fp_q,
+                        jnp.full((total_E,), 1, I32) * (pdepth + 1),
+                        fresh)
                 if edges_on:
                     # edge emission (ISSUE 15): stage 3 already holds
                     # (source row, action, successor fp) for every
@@ -950,9 +1061,11 @@ class DeviceBFS:
         kern = self.kern
         guard_mat = self._guard_matrix(kern) if fused else None
 
+        por_active = self._por_active
+
         def level(table, frontier, n_front, start_t,
                   nb, nbp, nba, nbprm, n_next0, want_deadlock,
-                  eb, edge_meta):
+                  eb, edge_meta, pdepth=None):
             # `table` bundles the FPSet slots (+ the parallel gid
             # column in edge-emission mode); `eb` is None or the
             # (src, aid, dst) edge append buffers — DONATED, they are
@@ -996,7 +1109,7 @@ class DeviceBFS:
                                 edge_meta["gid_base"]))
             body = make_body(frontier, n_front, want_deadlock,
                              chunk_ctx=chunk_ctx,
-                             edge_bases=edge_bases)
+                             edge_bases=edge_bases, pdepth=pdepth)
             init = {
                 "t": jnp.asarray(start_t, I32),
                 "reason": jnp.asarray(RUNNING, I32),
@@ -1015,6 +1128,10 @@ class DeviceBFS:
                 init["gids"] = table["gids"]
                 init["eb_src"], init["eb_aid"], init["eb_dst"] = eb
                 init["edge_n"] = edge_meta["n"]
+            if por_active:
+                init["gids"] = table["gids"]
+                init["gfull"] = jnp.asarray(0, I32)
+                init["amp"] = jnp.asarray(0, I32)
             return jax.lax.while_loop(cond, body, init)
 
         return level
@@ -1037,13 +1154,15 @@ class DeviceBFS:
                 "the chunked paged engine")
         T = self.tile
         _caps, _tot, make_body = self._tile_body_factory()
+        por_active = self._por_active
 
         def multilevel(slots, front, nb, nbp, nba, nbprm,
                        tpp, tpa, tpm, lvl_buf,
                        n_front, start_t, nn0, gen_level0, depth0,
                        level_base0, fp_count0,
                        want_deadlock, max_depth, max_states, max_lvls,
-                       tiles0, tile_budget):
+                       tiles0, tile_budget,
+                       gids=None, gfull_level0=None, amp_level0=None):
             F_cap = nbp.shape[0]
             TP_CAP = tpp.shape[0]
             LVL_CAP = lvl_buf.shape[0]
@@ -1072,7 +1191,11 @@ class DeviceBFS:
             def obody(c):
                 n_front_l = c["n_front"]
                 n_tiles = (n_front_l + T - 1) // T
-                body = make_body(c["front"], n_front_l, want_deadlock)
+                # POR C3: the frontier being expanded sits at level
+                # c["depth"], which is exactly the marker threshold
+                body = make_body(c["front"], n_front_l, want_deadlock,
+                                 pdepth=c["depth"] if por_active
+                                 else None)
                 # remaining per-dispatch tile budget, as an inner
                 # bound.  Saturated: the fused mode's 2^31-1 sentinel
                 # budget added to a carried start_t > 0 (a re-entry
@@ -1101,6 +1224,10 @@ class DeviceBFS:
                     "gen": c["gen_level"],
                     "act": c["act"],
                 }
+                if por_active:
+                    iinit["gids"] = c["gids"]
+                    iinit["gfull"] = c["gfull_level"]
+                    iinit["amp"] = c["amp_level"]
                 r = jax.lax.while_loop(icond, body, iinit)
                 # level committed only when every tile ran; a budget
                 # stop mid-level exits the outer loop with the partial
@@ -1135,7 +1262,21 @@ class DeviceBFS:
                 nb = jax.tree_util.tree_map(
                     lambda a, b: jnp.where(swap, a, b),
                     c["front"], r["nb"])
+                ext = {}
+                if por_active:
+                    # gfull/amp mirror gen's swap discipline: the
+                    # completed level's deltas fold into the dispatch
+                    # totals, a partial level rides the *_level carry
+                    ext = {
+                        "gids": r["gids"],
+                        "gfull_level": jnp.where(swap, 0, r["gfull"]),
+                        "gfull": c["gfull"] + jnp.where(
+                            swap, r["gfull"], 0),
+                        "amp_level": jnp.where(swap, 0, r["amp"]),
+                        "amp": c["amp"] + jnp.where(swap, r["amp"], 0),
+                    }
                 return {
+                    **ext,
                     "slots": r["slots"],
                     "front": front, "nb": nb,
                     "nbp": r["nbp"], "nba": r["nba"],
@@ -1180,6 +1321,12 @@ class DeviceBFS:
                 "need": jnp.zeros((len(_caps),), jnp.uint32),
                 "act": jnp.zeros((len(_caps),), jnp.uint32),
             }
+            if por_active:
+                init["gids"] = gids
+                init["gfull_level"] = jnp.asarray(gfull_level0, I32)
+                init["gfull"] = jnp.asarray(0, I32)
+                init["amp_level"] = jnp.asarray(amp_level0, I32)
+                init["amp"] = jnp.asarray(0, I32)
             return jax.lax.while_loop(ocond, obody, init)
 
         return multilevel
@@ -1467,6 +1614,50 @@ class DeviceBFS:
             ratio = self._pk_decl.total_bits / self._pk.total_bits
         obs.gauge("bound_tightening_ratio", round(ratio, 4))
 
+    # -- ample-set POR consumption (ISSUE 16) --------------------------
+    def _por_doc(self):
+        """The run_start journal `por` object (None = off) — key-set
+        parity across all engines (obs/SCHEMA.md)."""
+        return (self._por.journal_doc()
+                if self._por is not None else None)
+
+    def _por_manifest(self):
+        """Checkpoint manifest record of the consumed independence
+        facts (None = POR off): flip-on-resume policy anchor."""
+        return self._por.manifest() if self._por is not None else None
+
+    def _check_por_manifest(self, ck, path):
+        """Resume-seam policy (ISSUE 16 satellite): a snapshot records
+        the independence facts its reduced exploration trusted;
+        resuming under a flipped ``-por`` or changed facts is a loud
+        policy error, mirroring the pack/canon/bounds rules — the
+        stored frontier/visited set cover a DIFFERENT (reduced or
+        full) slice of the space, so the resumed run would silently
+        drop or re-admit interleavings."""
+        theirs = (ck.get("por") or {}).get("digest")
+        mine = self._por.digest if self._por is not None else None
+        if theirs != mine:
+            raise TLAError(
+                f"checkpoint {path} was written under POR facts "
+                f"{theirs or 'off'} but this engine consumes "
+                f"{mine or 'off'}; the explored state sets are not "
+                f"comparable — resume with the matching -por setting "
+                f"(and the same spec/cfg)")
+
+    def _por_gauges(self, obs):
+        """por_cut_ratio / ample_states (ISSUE 16): generated kept /
+        generated full under the ample filter (1.0 when POR off or
+        inert), and how many expanded states took the shortcut with
+        real work elided."""
+        if self._por is None:
+            return
+        full = int(self._por_full)
+        kept = int(self._por_kept)
+        obs.gauge("por_cut_ratio",
+                  round(kept / full, 4) if full else 1.0)
+        obs.gauge("ample_states", int(self._por_amp))
+        obs.gauge("por_eligible_actions", self._por.n_eligible)
+
     def _register_init(self, res):
         """Encode, dedup, and FPSet-register the initial states; seed
         the host pointer store and check invariants on them (shared by
@@ -1502,6 +1693,13 @@ class DeviceBFS:
                 jnp.asarray(fps[keep]),
                 jnp.arange(n0, dtype=jnp.int32),
                 jnp.ones((n0,), bool))
+        if self._por_active:
+            # C3 level-marker column (ISSUE 16): init states are level
+            # 0, and a zeros column gives every one of them marker 0
+            # without a store pass; empty-slot values are never read
+            # (lookup_gids returns -1 for absent fingerprints)
+            table["gids"] = jnp.zeros((self.fpset_capacity,),
+                                      jnp.int32)
         # host trace store: gid -> (parent gid, action, param)
         self._h_parent = [np.full(n0, -1, np.int64)]
         self._h_action = [np.full(n0, -1, np.int32)]
@@ -1531,6 +1729,7 @@ class DeviceBFS:
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
         obs.edges = self._edges_on
+        obs.por = self._por_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec  # codec only for init encode
         # per-action expansion counters (on-device accumulator, pulled
@@ -1540,6 +1739,7 @@ class DeviceBFS:
                                     np.int64)
         self._tiles_done = 0
         self._lanes_disp = 0
+        self._por_kept = self._por_full = self._por_amp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend(),
@@ -1571,6 +1771,15 @@ class DeviceBFS:
             self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
+            if self._por_active:
+                self._check_por_manifest(ck, resume_from)
+                # markers are NOT snapshotted: at a level boundary
+                # every stored fingerprint belongs to the frontier's
+                # level or earlier, so an all-zeros column (marker 0
+                # <= any pdepth = old) reproduces every C3 decision
+                table["gids"] = jnp.zeros((fp_cap,), jnp.int32)
+            elif ck.get("por"):
+                self._check_por_manifest(ck, resume_from)
             self._init_dense = ck["init_dense"]
             self._init_states = [codec.decode(d)
                                  for d in ck["init_dense"]]
@@ -1639,9 +1848,11 @@ class DeviceBFS:
         def pull(o):
             # ONE host round-trip for all control scalars — separate
             # int() pulls cost one tunnel RTT each on a remote TPU
-            return jax.device_get([o["reason"], o["t"], o["nn"],
-                                   o["gen"], o["dist"], o["act"],
-                                   o["need"]])
+            vals = [o["reason"], o["t"], o["nn"], o["gen"], o["dist"],
+                    o["act"], o["need"]]
+            if self._por_active:
+                vals += [o["gfull"], o["amp"]]
+            return jax.device_get(vals)
         return self._chunk_loop(
             res, obs, pipe, pull, table=table, front=front,
             bufs=bufs, fpar=fpar, fact=fact, fprm=fprm,
@@ -1686,10 +1897,13 @@ class DeviceBFS:
                         jnp.asarray(n_front, I32), pend_t,
                         nb, nbp, nba, nbprm, pend_nn,
                         jnp.asarray(bool(check_deadlock)), None, None,
+                        jnp.asarray(depth - 1, I32),
                         fresh=self._fresh_jit,
                         label=f"level {depth} dispatch")
                     self._fresh_jit = False
                     table = {"slots": out["slots"]}
+                    if self._por_active:
+                        table["gids"] = out["gids"]
                     bufs = (out["nb"], out["nbp"], out["nba"],
                             out["nbprm"])
                     pend_t, pend_nn = out["t"], out["nn"]
@@ -1700,6 +1914,10 @@ class DeviceBFS:
                 fp_count += dist_add
                 self._act_counts += np.asarray(sc[5], np.int64)
                 self._fold_need(sc[6])
+                if self._por_active:
+                    self._por_kept += gen_add
+                    self._por_full += int(sc[7])
+                    self._por_amp += int(sc[8])
 
                 if reason == RUNNING:
                     obs.progress(depth=depth, distinct=fp_count,
@@ -1846,7 +2064,8 @@ class DeviceBFS:
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
                         canon=self._canon_manifest(),
-                        bounds=self._bounds_manifest(), obs=obs)
+                        bounds=self._bounds_manifest(),
+                        por=self._por_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "
@@ -1936,6 +2155,7 @@ class DeviceBFS:
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
         obs.edges = self._edges_on
+        obs.por = self._por_doc()
         obs.gauge("pipeline_depth", 1)
         self._obs_active = obs          # closes_observer finalizes it
         spec, codec = self.spec, self.codec
@@ -1943,6 +2163,7 @@ class DeviceBFS:
                                     np.int64)
         self._tiles_done = 0
         self._lanes_disp = 0
+        self._por_kept = self._por_full = self._por_amp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend())
@@ -1975,6 +2196,8 @@ class DeviceBFS:
         ms = int(max_states) if max_states else 2**31 - 1
         n_front, start_t, nn, gen_level = n0, 0, 0, 0
         depth, level_base, fp_count = 0, 0, n0
+        por_on = self._por_active
+        gfull_level, amp_level = 0, 0
         self.level_sizes = [n0]
         last_checkpoint = time.time()
         # adaptive dispatch quantum: small first dispatches give the
@@ -2012,12 +2235,16 @@ class DeviceBFS:
                     jnp.asarray(md, I32), jnp.asarray(ms, I32),
                     jnp.asarray(min(quantum, levels_per_dispatch), I32),
                     jnp.asarray(0, I32),
-                    jnp.asarray(2**31 - 1, I32))
+                    jnp.asarray(2**31 - 1, I32),
+                    *((table["gids"], jnp.asarray(gfull_level, I32),
+                       jnp.asarray(amp_level, I32)) if por_on else ()))
                 out["reason"].block_until_ready()
             self._fresh_jit = False
             obs.count("dispatches")
             quantum = min(quantum * 4, q_cap)
             table = {"slots": out["slots"]}
+            if por_on:
+                table["gids"] = out["gids"]
             front, nb = out["front"], out["nb"]
             nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
             tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
@@ -2028,12 +2255,20 @@ class DeviceBFS:
                                       "nn", "gen_level", "gen", "depth",
                                       "level_base", "fp_count",
                                       "lvl_cur", "act", "tiles",
-                                      "need")])
+                                      "need")]
+                    + ([out[k] for k in ("gfull", "gfull_level",
+                                         "amp", "amp_level")]
+                       if por_on else []))
             (reason, n_front, start_t, nn, gen_level, gen_add, depth,
              level_base, fp_count, lvl_cur) = (int(x) for x in sc[:10])
             self._act_counts += np.asarray(sc[10], np.int64)
             self._account_tiles(int(sc[11]))
             self._fold_need(sc[12])
+            if por_on:
+                self._por_kept += gen_add
+                self._por_full += int(sc[13])
+                self._por_amp += int(sc[15])
+                gfull_level, amp_level = int(sc[14]), int(sc[16])
             res.states_generated += gen_add
             if lvl_cur:
                 # level boundaries inside one dispatch share its
@@ -2101,7 +2336,8 @@ class DeviceBFS:
                             digest=spec_digest(spec),
                             pack=self._pack_manifest(),
                             canon=self._canon_manifest(),
-                        bounds=self._bounds_manifest(), obs=obs)
+                            bounds=self._bounds_manifest(),
+                            por=self._por_manifest(), obs=obs)
                     last_checkpoint = time.time()
                     obs.checkpoint(checkpoint_path, depth, fp_count)
                     emit(f"checkpoint written to {checkpoint_path} "
@@ -2144,6 +2380,10 @@ class DeviceBFS:
                 # committed tiles of the in-flight level count (run()
                 # adds per-chunk gen on every call incl. the last)
                 res.states_generated += gen_level
+                if por_on:
+                    self._por_kept += gen_level
+                    self._por_full += gfull_level
+                    self._por_amp += amp_level
                 vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
                 gid = level_base + vp
                 parent_dense = self._fetch_row(front, vp)
@@ -2166,6 +2406,10 @@ class DeviceBFS:
                                     table=table, fp_cap=fp_cap)
             if reason == R_DEADLOCK:
                 res.states_generated += gen_level
+                if por_on:
+                    self._por_kept += gen_level
+                    self._por_full += gfull_level
+                    self._por_amp += amp_level
                 di = int(out["dead"])
                 set_pointers(level_base + n_front)
                 res.ok = False
@@ -2208,6 +2452,10 @@ class DeviceBFS:
         # a limit break straight after a growth pause still carries an
         # in-flight level's committed-tile gen (run() adds per chunk)
         res.states_generated += gen_level
+        if por_on:
+            self._por_kept += gen_level
+            self._por_full += gfull_level
+            self._por_amp += amp_level
         set_pointers(fp_count if reason == RUNNING and n_front == 0
                      else level_base + n_front)
         res.diameter = depth
@@ -2266,12 +2514,14 @@ class DeviceBFS:
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
         obs.edges = self._edges_on
+        obs.por = self._por_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
         self._tiles_done = 0
         self._lanes_disp = 0
+        self._por_kept = self._por_full = self._por_amp = 0
         res = CheckResult()
         t0 = time.time()
         obs.start(t0, backend=jax.default_backend())
@@ -2303,6 +2553,10 @@ class DeviceBFS:
         d_depth = jnp.asarray(0, I32)
         d_level_base = jnp.asarray(0, I32)
         d_fp = jnp.asarray(n0, I32)
+        por_on = self._por_active
+        d_gfull_level = jnp.asarray(0, I32)
+        d_amp_level = jnp.asarray(0, I32)
+        gfull_level, amp_level = 0, 0
         self.level_sizes = [n0]
         depth, fp_count, n_front = 0, n0, n0
         level_base, gen_level = 0, 0
@@ -2313,11 +2567,14 @@ class DeviceBFS:
                                 ready=lambda o: o["reason"])
 
         def pull(o):
-            return jax.device_get(
-                [o["reason"], o["n_front"], o["depth"], o["fp_count"],
-                 o["level_base"], o["lvl_cur"], o["gen"],
-                 o["gen_level"], o["act"], o["start_t"], o["nn"],
-                 o["tiles"], o["need"]])
+            vals = [o["reason"], o["n_front"], o["depth"],
+                    o["fp_count"], o["level_base"], o["lvl_cur"],
+                    o["gen"], o["gen_level"], o["act"], o["start_t"],
+                    o["nn"], o["tiles"], o["need"]]
+            if por_on:
+                vals += [o["gfull"], o["gfull_level"],
+                         o["amp"], o["amp_level"]]
+            return jax.device_get(vals)
 
         def set_pointers(n):
             self._h_parent = [np.asarray(tpp[:n]).astype(np.int64)]
@@ -2329,6 +2586,7 @@ class DeviceBFS:
             host-side totals, and emit its committed levels."""
             nonlocal depth, fp_count, n_front, level_base, gen_level
             nonlocal h_start, h_nn, levels_unck
+            nonlocal gfull_level, amp_level
             out, sc = pipe.collect(pull)
             (reason, n_front, depth, fp_count, level_base, lvl_cur,
              gen_add, gen_level) = (int(x) for x in sc[:8])
@@ -2338,6 +2596,11 @@ class DeviceBFS:
             levels_unck += lvl_cur
             self._account_tiles(int(sc[11]))
             self._fold_need(sc[12])
+            if por_on:
+                self._por_kept += gen_add
+                self._por_full += int(sc[13])
+                self._por_amp += int(sc[15])
+                gfull_level, amp_level = int(sc[14]), int(sc[16])
             if lvl_cur:
                 # each dispatch records its own committed levels from
                 # slot 0 of ITS lvl_buf output (which is why lvl_buf is
@@ -2365,6 +2628,7 @@ class DeviceBFS:
             nonlocal table, front, nb, nbp, nba, nbprm, tpp, tpa, tpm
             nonlocal lvl_buf, d_n_front, d_start, d_nn, d_gen_level
             nonlocal d_depth, d_level_base, d_fp
+            nonlocal d_gfull_level, d_amp_level
             fresh = self._fresh_jit or self._wl is None
             if self._wl is None:
                 # the SAME pass run_fused jits, minus the lvl_buf
@@ -2383,9 +2647,15 @@ class DeviceBFS:
                 jnp.asarray(max_lvls, I32),
                 jnp.asarray(0, I32),
                 jnp.asarray(tile_budget, I32),
+                *((table["gids"], d_gfull_level, d_amp_level)
+                  if por_on else ()),
                 fresh=fresh, label=f"window (depth {depth}+)")
             self._fresh_jit = False
             table = {"slots": out["slots"]}
+            if por_on:
+                table["gids"] = out["gids"]
+                d_gfull_level = out["gfull_level"]
+                d_amp_level = out["amp_level"]
             front, nb = out["front"], out["nb"]
             nbp, nba, nbprm = out["nbp"], out["nba"], out["nbprm"]
             tpp, tpa, tpm = out["tpp"], out["tpa"], out["tpm"]
@@ -2489,7 +2759,8 @@ class DeviceBFS:
                                 digest=spec_digest(spec),
                                 pack=self._pack_manifest(),
                                 canon=self._canon_manifest(),
-                        bounds=self._bounds_manifest(), obs=obs)
+                                bounds=self._bounds_manifest(),
+                                por=self._por_manifest(), obs=obs)
                         last_checkpoint = time.time()
                         obs.checkpoint(checkpoint_path, depth, fp_count)
                         emit(f"checkpoint written to {checkpoint_path} "
@@ -2516,6 +2787,10 @@ class DeviceBFS:
             pipe.drain()
             if reason == R_VIOLATION:
                 res.states_generated += gen_level
+                if por_on:
+                    self._por_kept += gen_level
+                    self._por_full += gfull_level
+                    self._por_amp += amp_level
                 vp, va, vprm = (int(v) for v in np.asarray(out["viol"]))
                 gid = level_base + vp
                 parent_dense = self._fetch_row(front, vp)
@@ -2536,6 +2811,10 @@ class DeviceBFS:
                                     table=table, fp_cap=fp_cap)
             if reason == R_DEADLOCK:
                 res.states_generated += gen_level
+                if por_on:
+                    self._por_kept += gen_level
+                    self._por_full += gfull_level
+                    self._por_amp += amp_level
                 di = int(out["dead"])
                 set_pointers(level_base + n_front)
                 res.ok = False
@@ -2576,6 +2855,10 @@ class DeviceBFS:
                     "multi-slot layout (vsr.py docstring)")
 
         res.states_generated += gen_level
+        if por_on:
+            self._por_kept += gen_level
+            self._por_full += gfull_level
+            self._por_amp += amp_level
         set_pointers(fp_count if (stop is None and n_front == 0)
                      else level_base + n_front)
         if stop:
@@ -2625,6 +2908,7 @@ class DeviceBFS:
         res.distinct_states = fp_count
         self._pack_gauges(obs)
         self._bounds_gauges(obs)
+        self._por_gauges(obs)
         # symmetry canonicalization gauges (ISSUE 11): group order
         # this run reduced by (1 = off), and the headline
         # generated/distinct-after-canon ratio — on a symmetry-on run

@@ -226,12 +226,14 @@ class PagedBFS(DeviceBFS):
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
         obs.edges = self._edges_on
+        obs.por = self._por_doc()
         self._obs_active = obs          # closes_observer finalizes it
         spec = self.spec
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
         self._tiles_done = 0
         self._lanes_disp = 0
+        self._por_kept = self._por_full = self._por_amp = 0
         res = CheckResult()
         t0 = time.time()
         self._run_t0 = t0
@@ -273,6 +275,16 @@ class PagedBFS(DeviceBFS):
             self._check_canon_manifest(ck, resume_from)
             table = {"slots": jnp.asarray(ck["slots"])}
             fp_cap = int(ck["slots"].shape[0])
+            # POR manifest policy (ISSUE 16): resuming under a flipped
+            # -por or changed independence facts is a loud error; on a
+            # matching resume the C3 level markers are rebuilt as
+            # zeros — at a level boundary every stored fingerprint is
+            # old, which reproduces the writer's decisions exactly
+            if self._por_active:
+                self._check_por_manifest(ck, resume_from)
+                table["gids"] = jnp.zeros((fp_cap,), jnp.int32)
+            elif ck.get("por"):
+                self._check_por_manifest(ck, resume_from)
             if self._edges_on:
                 # edge-stream resume seam (ISSUE 15): the snapshot
                 # must carry the gid column and the drained edge rows
@@ -390,6 +402,8 @@ class PagedBFS(DeviceBFS):
                     o["dist"], o["act"], o["need"]]
             if self._edges_on:
                 keys.append(o["edge_n"])
+            if self._por_active:
+                keys += [o["gfull"], o["amp"]]
             return jax.device_get(keys)
 
         while n_front > 0 and stop is None:
@@ -529,10 +543,13 @@ class PagedBFS(DeviceBFS):
                             nb, nbp, nba, nbprm, pend_nn,
                             jnp.asarray(bool(check_deadlock)),
                             eb_arg, emeta_arg,
+                            jnp.asarray(depth - 1, I32),
                             fresh=self._fresh_jit,
                             label=f"level {depth} dispatch")
                         self._fresh_jit = False
                         table = {"slots": out["slots"]}
+                        if self._por_active:
+                            table["gids"] = out["gids"]
                         bufs = (out["nb"], out["nbp"], out["nba"],
                                 out["nbprm"])
                         pend_t, pend_nn = out["t"], out["nn"]
@@ -550,6 +567,10 @@ class PagedBFS(DeviceBFS):
                     self._fold_need(sc[6])
                     if self._edges_on:
                         n_edge = int(sc[7])
+                    if self._por_active:
+                        self._por_kept += gen_add
+                        self._por_full += int(sc[7])
+                        self._por_amp += int(sc[8])
 
                     if reason == RUNNING:
                         obs.progress(depth=depth, distinct=fp_count,
@@ -779,7 +800,8 @@ class PagedBFS(DeviceBFS):
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
                         canon=self._canon_manifest(),
-                        bounds=self._bounds_manifest(), obs=obs)
+                        bounds=self._bounds_manifest(),
+                        por=self._por_manifest(), obs=obs)
                 last_checkpoint = time.time()
                 obs.checkpoint(checkpoint_path, depth, fp_count)
                 emit(f"checkpoint written to {checkpoint_path} "

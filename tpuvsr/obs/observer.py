@@ -105,6 +105,12 @@ class RunObserver:
         # off, None on engines without it — journaled on run_start
         # with key-set parity
         self.edges = None
+        # ample-set partial-order reduction in effect (ISSUE 16): the
+        # compact {digest, actions, eligible_actions, sharded_proviso,
+        # independence} object when the run's fused commit applies the
+        # ample filter, None when off or on engines without the seam —
+        # journaled on run_start with key-set parity
+        self.por = None
         self._log = log
         # stats table on stderr: on when explicitly requested, else only
         # for runs that asked for observability artifacts
@@ -178,7 +184,8 @@ class RunObserver:
                            commit=self.commit,
                            symmetry=self.symmetry,
                            bounds=self.bounds,
-                           edges=self.edges, **extra)
+                           edges=self.edges,
+                           por=self.por, **extra)
         self._profile_cm = profile_trace(log=self._log)
         self._profile_cm.__enter__()
         self.metrics.begin("check")

@@ -182,7 +182,7 @@ def convert_sharded_snapshot(path, spec, log=None):
         # merged fingerprints are still canon/bounds-dependent, and
         # the resuming engine's policy checks compare against them
         pack=ck.get("pack"), canon=ck.get("canon"),
-        bounds=ck.get("bounds"), extra=None)
+        bounds=ck.get("bounds"), por=ck.get("por"), extra=None)
     return True
 
 
@@ -234,13 +234,13 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                        tile: int, bucket_cap: int,
                        check_deadlock: bool = False, pack_spec=None,
                        commit: str = "fused", expand_caps=None,
-                       canon=None):
+                       canon=None, por=None):
     """Build the jitted one-tile sharded BFS step.
 
     step(tables, frontier, n_front, start_t, nb, nbp, nba, nbprm, nn,
          base_gid)
       -> (tables, nb, nbp, nba, nbprm, nn, t, reason, viol, gen, sent,
-          dead, act)
+          dead, act, need, gfull, amp)
     Every array is sharded over `axis`; scalars come back as [D] arrays
     (one per device; identical where globally agreed).  With
     ``check_deadlock`` a frontier state with no enabled successor
@@ -273,7 +273,23 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     ``need`` so the host grows once to the true count.  The dedup that
     feeds the exchange tie-breaks on the canonical state-major flat
     index, so bucket contents — and every downstream result — are
-    bit-identical to ``commit="per-action"`` (the step_all path)."""
+    bit-identical to ``commit="per-action"`` (the step_all path).
+
+    Ample-set partial-order reduction (ISSUE 16): with a ``por``
+    filter (engine/por.PORFilter built with ``sharded=True``) the
+    fused stage 1 masks the guard segments BEFORE compaction — on
+    frontier states where a conflict-free candidate action exists,
+    only that action's lanes enter the work queue.  Pre-expansion
+    masking is what the owner-partitioned FPSet forces: successor
+    freshness cannot be probed locally (the fingerprints live on
+    other shards), so the C3 no-ignoring proviso is fully static —
+    the filter only admits actions carrying a monotone progress
+    witness (see engine/por.py).  Deadlock detection reads the
+    UNMASKED guard matrix; the reduction is weaker than the
+    single-device engines' level-marker proviso but deterministic
+    and collective-free.  ``gfull``/``amp`` carry the unreduced
+    generated count and the shortcut-state tally (equal to ``gen`` /
+    zero when POR is off)."""
     n_dev = mesh.shape[axis]
     L = kern.n_lanes
     T = tile
@@ -299,6 +315,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
         caps_v = jnp.asarray(caps, jnp.int32)
         guards = kern._guard_fns()
         fns = kern._action_fns()
+    por_amat = (jnp.asarray(por.amat) if por is not None else None)
 
     def step_shard(tables, frontier, n_front, start_t,
                    nb, nbp, nba, nbprm, nn0, base_gid):
@@ -334,6 +351,35 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                     seg = jax.vmap(lambda st: jax.vmap(
                         lambda ln, g=guard: g(st, ln))(lanes))(tile_st)
                     en_segs.append(seg & valid[:, None])
+                # deadlock witness from the UNMASKED matrix (POR must
+                # not manufacture deadlocks), before any ample masking
+                en_state = jnp.zeros((T,), bool)
+                for e in en_segs:
+                    en_state = en_state | e.any(axis=1)
+                if por_amat is not None:
+                    # ample-set stage-1 masking (ISSUE 16): rows with
+                    # a conflict-free candidate keep ONLY that
+                    # action's lanes; everything downstream (counts,
+                    # caps, compaction, exchange) sees the reduced
+                    # queue.  aid_star = lowest candidate id — a
+                    # deterministic pick keeps runs reproducible
+                    en_act_m = jnp.stack(
+                        [e.any(axis=1) for e in en_segs], axis=1)
+                    n_full = jnp.stack(
+                        [e.sum(dtype=jnp.int32)
+                         for e in en_segs]).sum()
+                    conflict = (en_act_m.astype(jnp.int32)
+                                @ (~por_amat).astype(jnp.int32).T) > 0
+                    cand_m = en_act_m & ~conflict
+                    has_cand = cand_m.any(axis=1)
+                    aid_star = jnp.argmax(cand_m, axis=1
+                                          ).astype(jnp.int32)
+                    en_segs = [e & (~has_cand
+                                    | (aid_star == a))[:, None]
+                               for a, e in enumerate(en_segs)]
+                    amp_t = (has_cand
+                             & (en_act_m.sum(axis=1, dtype=jnp.int32)
+                                > 1)).sum(dtype=jnp.int32)
                 cnts = jnp.stack([e.sum(dtype=jnp.int32)
                                   for e in en_segs])
                 n_en = cnts.sum()
@@ -341,9 +387,6 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 ovf_vec = cnts > caps_v
                 ovf_e = ovf_vec.any()
                 need = jnp.maximum(c["need"], cnts.astype(U32))
-                en_state = jnp.zeros((T,), bool)
-                for e in en_segs:
-                    en_state = en_state | e.any(axis=1)
 
                 # -- stage 2: per-action work-queue compaction; only
                 # REAL items are expanded (step_all expanded all T x L
@@ -391,6 +434,9 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 ovf_e = jnp.asarray(False)
                 need = c["need"]
                 flatpos = jnp.arange(T * L, dtype=jnp.int32)
+            if por_amat is None:
+                n_full = n_en
+                amp_t = jnp.asarray(0, jnp.int32)
             if pack_spec is not None:
                 # pack successors ONCE, right after expansion: the
                 # buckets, the wire, and the next frontier all move
@@ -564,6 +610,13 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 # device shipped (the wire moves full static buckets)
                 "sent": c["sent"] + jnp.where(
                     commit & ~g_povf, b_mask.sum().astype(jnp.int32), 0),
+                # POR accounting (ISSUE 16): unreduced generated count
+                # and shortcut-state tally; gfull == gen, amp == 0
+                # when the filter is off/inert
+                "gfull": c["gfull"] + jnp.where(commit & ~g_povf,
+                                                n_full, 0),
+                "amp": c["amp"] + jnp.where(commit & ~g_povf,
+                                            amp_t, 0),
             }
 
         init = {
@@ -578,6 +631,8 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
             "gen": jnp.asarray(0, jnp.int32),
             "act": jnp.zeros((n_act,), jnp.uint32),
             "sent": jnp.asarray(0, jnp.int32),
+            "gfull": jnp.asarray(0, jnp.int32),
+            "amp": jnp.asarray(0, jnp.int32),
         }
         out = jax.lax.while_loop(cond, body, init)
         one = lambda x: x[None]
@@ -585,7 +640,8 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
                 out["nb"], out["nbp"], out["nba"], out["nbprm"],
                 one(out["nn"]), one(out["t"]), one(out["reason"]),
                 out["viol"][None], one(out["gen"]), one(out["sent"]),
-                one(out["dead"]), out["act"][None], out["need"][None])
+                one(out["dead"]), out["act"][None], out["need"][None],
+                one(out["gfull"]), one(out["amp"]))
 
     sp = P(axis)
     # donate the FPSet shards + the next-frontier buffer set (args 0,
@@ -598,7 +654,7 @@ def make_sharded_level(kern, inv_fn, mesh: Mesh, axis: str,
     step = jax.jit(_shard_map(
         step_shard, mesh=mesh,
         in_specs=(sp,) * 10,
-        out_specs=(sp,) * 14), donate_argnums=(0, 4, 5, 6, 7))
+        out_specs=(sp,) * 16), donate_argnums=(0, 4, 5, 6, 7))
     return step
 
 
@@ -617,7 +673,7 @@ class ShardedBFS:
                  model_factory=None, pipeline=2, exchange_retries=5,
                  exchange_backoff=0.05, exchange_backoff_cap=2.0,
                  sleep=time.sleep, pack="auto", commit="fused",
-                 symmetry="auto", bounds="auto"):
+                 symmetry="auto", bounds="auto", por="off"):
         from ..core.values import TLAError
         if commit not in ("fused", "per-action"):
             raise TLAError(f"commit must be 'fused' or 'per-action' "
@@ -687,6 +743,18 @@ class ShardedBFS:
         from ..engine.bounds import resolve_bounds
         self._facts = resolve_bounds(spec, bounds)
         self._pruned = []
+        # ample-set partial-order reduction (ISSUE 16): same resolve
+        # contract as DeviceBFS (constructor default "off", CLI
+        # -por auto); the filter is rebuilt in _build with
+        # sharded=True — the static monotone-witness C3 proviso the
+        # owner-partitioned FPSet forces (see make_sharded_level)
+        from ..engine.por import resolve_por
+        self._por_facts = resolve_por(
+            spec, por,
+            temporal=bool(getattr(spec, "temporal_props", ())),
+            edges=False, commit=self.commit)
+        self._por = None
+        self._por_kept = self._por_full = self._por_amp = 0
         self._build(max_msgs)
 
     def _build(self, max_msgs):
@@ -763,6 +831,14 @@ class ShardedBFS:
             if self._need_seen is None or \
                     len(self._need_seen) != len(names):
                 self._need_seen = np.zeros(len(names), np.int64)
+        self._por = None
+        if self._por_facts is not None:
+            from ..engine.por import PORFilter
+            self._por = PORFilter(self._por_facts, self.kern,
+                                  sharded=True)
+        self._por_active = (self._por is not None
+                            and self._por.any_eligible
+                            and self.commit == "fused")
         self._step = make_sharded_level(self.kern, self._inv, self.mesh,
                                         self.axis, self.tile,
                                         self.bucket_cap,
@@ -770,7 +846,10 @@ class ShardedBFS:
                                         pack_spec=self._pk,
                                         commit=self.commit,
                                         expand_caps=self.expand_caps,
-                                        canon=self._canon)
+                                        canon=self._canon,
+                                        por=(self._por
+                                             if self._por_active
+                                             else None))
         self._fresh_jit = True   # first dispatch after a (re)jit is
         #                          charged to the "compile" phase
         self._sh = NamedSharding(self.mesh, P(self.axis))
@@ -795,6 +874,10 @@ class ShardedBFS:
     _bounds_manifest = _DB._bounds_manifest
     _check_bounds_manifest = _DB._check_bounds_manifest
     _bounds_gauges = _DB._bounds_gauges
+    _por_doc = _DB._por_doc
+    _por_manifest = _DB._por_manifest
+    _check_por_manifest = _DB._check_por_manifest
+    _por_gauges = _DB._por_gauges
 
     def _flush_pointers(self):
         """No-op: the sharded driver's pointer pulls are synchronous
@@ -862,11 +945,13 @@ class ShardedBFS:
         obs.symmetry = self._symmetry_on()
         obs.bounds = self._bounds_doc()
         obs.edges = self._edges_on
+        obs.por = self._por_doc()
         self._obs_active = obs          # closes_observer finalizes it
         self._act_counts = np.zeros(len(self.kern.action_names),
                                     np.int64)
         self._tiles_done = 0
         self._lanes_disp = 0
+        self._por_kept = self._por_full = self._por_amp = 0
         # multi-process: every rank collects, only host 0 writes the
         # journal / metrics file / stats table (per-shard numbers are
         # reduced host-side before they reach the collector)
@@ -946,6 +1031,12 @@ class ShardedBFS:
             self._check_bounds_manifest(ck, resume_from)
             self._check_pack_manifest(ck, resume_from)
             self._check_canon_manifest(ck, resume_from)
+            # POR flip/digest policy (ISSUE 16): the explored state
+            # sets of a reduced and an unreduced run are not
+            # comparable (no level markers to rebuild here — the
+            # sharded C3 proviso is fully static)
+            if self._por_active or ck.get("por"):
+                self._check_por_manifest(ck, resume_from)
             rows = ck["frontier"]
             h_parent = np.asarray(ck["h_parent"])
             h_action = np.asarray(ck["h_action"])
@@ -1161,23 +1252,26 @@ class ShardedBFS:
                                 ready=lambda o: o[7])
 
         pack_scalars = jax.jit(
-            lambda r, s, g, a: jnp.concatenate(
-                [r[:, None], s[:, None], g[:, None],
-                 a.astype(jnp.int32)], axis=1))
+            lambda r, s, g, gf, am, a: jnp.concatenate(
+                [r[:, None], s[:, None], g[:, None], gf[:, None],
+                 am[:, None], a.astype(jnp.int32)], axis=1))
 
         def pull(o):
             # ONE replication pull for all per-dispatch control
             # scalars — separate _pull calls cost one collective (a
             # tunnel RTT on a remote TPU) EACH; pack [D] reason/sent/
-            # gen and the [D, A] act counters into a single [D, 3+A]
-            # array first
+            # gen/gfull/amp and the [D, A] act counters into a single
+            # [D, 5+A] array first
             packed = np.asarray(self._pull(
-                pack_scalars(o[7], o[10], o[9], o[12])), np.int64)
+                pack_scalars(o[7], o[10], o[9], o[14], o[15],
+                             o[12])), np.int64)
             reason = int(packed[0, 0])
             sent = int(packed[:, 1].sum())
             gen = int(packed[:, 2].sum())
-            act = packed[:, 3:].sum(axis=0)
-            return reason, sent, gen, act
+            gfull = int(packed[:, 3].sum())
+            amp = int(packed[:, 4].sum())
+            act = packed[:, 5:].sum(axis=0)
+            return reason, sent, gen, gfull, amp, act
 
         # shard context for fault hooks: the HOST process in
         # multi-process runs; a single-process mesh drives every
@@ -1247,7 +1341,7 @@ class ShardedBFS:
                     (tables, nb, nbp, nba, nbprm, nn,
                      start_t) = out[:7]
                 out, sc = pipe.collect(pull)
-                reason, sent, gen_add, act_add = sc
+                reason, sent, gen_add, gfull_add, amp_add, act_add = sc
                 exch_rows_useful += sent
                 exch_bytes_useful += sent * _row_bytes()
                 # generated is accumulated per dispatch attempt (a
@@ -1255,6 +1349,10 @@ class ShardedBFS:
                 # replays in the window are discarded by drain())
                 res.states_generated += gen_add
                 self._act_counts += act_add
+                if self._por_active:
+                    self._por_kept += gen_add
+                    self._por_full += gfull_add
+                    self._por_amp += amp_add
                 if reason == RUNNING:
                     pipe.drain()     # trailing tickets are no-ops
                     break
@@ -1486,7 +1584,8 @@ class ShardedBFS:
                         digest=spec_digest(spec),
                         pack=self._pack_manifest(),
                         canon=self._canon_manifest(),
-                        bounds=self._bounds_manifest(), obs=obs,
+                        bounds=self._bounds_manifest(),
+                        por=self._por_manifest(), obs=obs,
                         extra={"sharded": True,
                                "shard_counts": [int(x) for x in nn_h],
                                "bucket_cap": self.bucket_cap,
@@ -1536,6 +1635,7 @@ class ShardedBFS:
 
     def _finish(self, res, obs, fp_count):
         self._bounds_gauges(obs)
+        self._por_gauges(obs)
         res.distinct_states = fp_count
         self._pack_gauges(obs)
         obs.gauge("symmetry_perms",

@@ -52,6 +52,17 @@ COUNTER_CFG = ("CONSTANTS\n    Limit = 3\n"
 STUB_DISTINCT = 16
 STUB_LEVELS = [1, 2, 3, 4, 3, 2, 1]
 
+#: the ``inv_free`` fixture's reduced fixpoint under the ample-set
+#: partial-order reduction (ISSUE 16): with IncX/IncY independent and
+#: invisible, every state expands ONE action — the 4 x 4 grid
+#: collapses to a single interleaving per level, the (3,3) deadlock
+#: survives, and generated-kept/generated-full gives the cut ratio
+#: oracle 6/9 ≈ 0.67
+POR_STUB_DISTINCT = 7
+POR_STUB_LEVELS = [1, 1, 1, 1, 1, 1, 1]
+POR_STUB_KEPT = 6
+POR_STUB_FULL = 9
+
 
 #: the dead-action fixture text (ISSUE 13): `Limit > 5` folds FALSE
 #: under the cfg's Limit = 3, so Jump can never fire — the bounds
@@ -65,7 +76,7 @@ DEAD_ACTION = """Jump ==
 
 
 def counter_spec(inv_bound=None, inv_x_bound=None, dead_action=False,
-                 nonlinear_guard=False, limit=None):
+                 nonlinear_guard=False, limit=None, inv_free=False):
     """The inline two-counter spec (16 states, diameter 6).
 
     With ``inv_bound`` the Bound invariant tightens to
@@ -88,8 +99,19 @@ def counter_spec(inv_bound=None, inv_x_bound=None, dead_action=False,
     the bounds pass's interval domain, so tightening must be REFUSED
     (bounds{tightened:false}); note it also shrinks the reachable
     space (x stops at 2 under Limit = 3).  ``limit`` overrides the
-    cfg's Limit binding."""
+    cfg's Limit binding.
+
+    ``inv_free`` replaces Bound with ``Limit >= 0`` — an invariant
+    reading NEITHER counter, which makes IncX/IncY independent AND
+    invisible (both also carry ``x' = x + 1`` monotone witnesses):
+    the ISSUE 16 fixture on which the ample-set partial-order
+    reduction is live on every engine, single-device and sharded.
+    The reduced space is ``POR_STUB_DISTINCT`` states (of 16) and the
+    (Limit, Limit) deadlock survives."""
     src = COUNTER
+    if inv_free:
+        src = src.replace("Bound == x + y <= 2 * Limit",
+                          "Bound == Limit >= 0")
     if inv_x_bound is not None:
         src = src.replace("Bound == x + y <= 2 * Limit",
                           f"Bound == x <= {int(inv_x_bound)}")
