@@ -683,6 +683,35 @@ def _attach_and_lift():
             "cpu"):
         RESULT["defect_tpu_distinct_per_s"] = dw.get("distinct_per_s")
         RESULT["defect_tpu_vs_cpu_window"] = dw.get("vs_cpu_window_1160")
+    _embed_telemetry()
+
+
+def _embed_telemetry():
+    """Embed a tpuvsr-telemetry/1 snapshot in the round doc
+    (ISSUE 17): run one stub job through a throwaway service spool,
+    fold its journals with the streamed aggregator, and record the
+    fleet-level series (queue-wait/run-time histograms, per-window
+    rates, worker utilization) next to the engine headline — every
+    BENCH_r*.json from r07 on carries them, and compare_bench's
+    ``gate_telemetry`` fold-determinism drill activates on rounds
+    that do."""
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="tpuvsr-bench-telemetry-")
+    try:
+        from tpuvsr.obs.telemetry import TelemetryAggregator
+        from tpuvsr.service.queue import JobQueue
+        from tpuvsr.service.worker import Worker
+        q = JobQueue(os.path.join(tmp, "spool"))
+        q.submit("<stub>", engine="device", flags={"stub": True})
+        Worker(q, devices=1).drain()
+        agg = TelemetryAggregator(q.spool, journal_breaches=False)
+        agg.poll()
+        RESULT["telemetry"] = agg.snapshot()
+    except Exception as e:  # noqa: BLE001 — the embed never kills bench
+        RESULT["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _stub_round(reason):

@@ -21,9 +21,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import tempfile
 
 METRICS_SCHEMA = "tpuvsr-metrics/1"
+TELEMETRY_SCHEMA = "tpuvsr-telemetry/1"
 
 
 def load(path):
@@ -317,6 +320,125 @@ def gate_por(base_doc, cand_doc, max_regression):
     return 0
 
 
+def telemetry_snapshot(doc):
+    """The embedded tpuvsr-telemetry/1 snapshot inside `doc`, or
+    None (bench.py rounds embed one under "telemetry" since
+    ISSUE 17)."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    t = doc.get("telemetry")
+    if isinstance(t, dict) and t.get("schema") == TELEMETRY_SCHEMA:
+        return t
+    return None
+
+
+#: the synthesized journal the fold-determinism drill replays: one
+#: job's full service story (submit -> drr pop -> start -> engine run
+#: crossing a window boundary -> fault/retry -> done) plus a pool
+#: heartbeat/respawn pair — enough to touch every fold family
+_DRILL_JOB = [
+    {"event": "job_submitted", "ts": 100.0, "run_id": "svc-submit",
+     "job_id": "j0001-drill", "spec": "s.tla", "engine": "device",
+     "tenant": "acme", "trace_id": "feedfacefeedface",
+     "span_id": "rfeedface"},
+    {"event": "sched_decision", "ts": 100.4, "run_id": "svc",
+     "job_id": "j0001-drill", "tenant": "acme", "policy": "drr",
+     "weight": 2, "deficit": 1.5, "priority": 0, "aged_priority": 0,
+     "waited_s": 0.4, "worker": "w0"},
+    {"event": "job_started", "ts": 100.5, "run_id": "svc",
+     "job_id": "j0001-drill", "attempt": 1, "devices": 1},
+    {"event": "run_start", "ts": 100.6, "run_id": "r1",
+     "schema": "tpuvsr-journal/1", "engine": "device",
+     "module": "Drill", "backend": "cpu", "resumed": False},
+    {"event": "level_done", "ts": 101.0, "run_id": "r1", "depth": 1,
+     "frontier": 3, "distinct": 4, "generated": 6, "elapsed_s": 0.4},
+    {"event": "fault", "ts": 104.0, "run_id": "r1", "what": "oom",
+     "site": "level", "elapsed_s": 3.4},
+    {"event": "retry", "ts": 104.1, "run_id": "r1", "attempt": 1,
+     "backoff_s": 0.0, "elapsed_s": 3.5},
+    {"event": "level_done", "ts": 111.0, "run_id": "r1", "depth": 2,
+     "frontier": 5, "distinct": 9, "generated": 14,
+     "elapsed_s": 10.4},
+    {"event": "run_end", "ts": 111.4, "run_id": "r1", "ok": True,
+     "elapsed_s": 10.8, "distinct": 9, "generated": 14},
+    {"event": "job_done", "ts": 111.5, "run_id": "svc",
+     "job_id": "j0001-drill", "state": "done", "elapsed_s": 11.0},
+]
+
+_DRILL_POOL = [
+    {"event": "worker_heartbeat", "ts": 101.5, "run_id": "pool",
+     "job_id": "j0001-drill", "worker": "w0"},
+    {"event": "worker_respawn", "ts": 112.0, "run_id": "pool",
+     "worker": "w1", "attempt": 1, "rc": 1},
+]
+
+
+def gate_telemetry(base_doc, cand_doc, max_regression):
+    """The telemetry fold-determinism gate (ISSUE 17): 0 ok/absent,
+    1 when the streamed journal aggregator's fold stopped being a
+    pure function of the journal bytes.  Drill: replay a synthesized
+    spool through two fresh aggregators AND an incremental
+    (poll, append, poll) one — all three snapshots must be
+    IDENTICAL, or restart reconvergence is broken.  Runs only when a
+    document embeds a tpuvsr-telemetry/1 snapshot (bench.py rounds
+    since ISSUE 17).  Embedded counter drift between the documents
+    prints as advisory context — fleet composition differences are
+    not regressions."""
+    bt = telemetry_snapshot(base_doc)
+    ct = telemetry_snapshot(cand_doc)
+    if bt is None and ct is None:
+        return 0
+    if bt and ct:
+        bc, cc = bt.get("counters", {}), ct.get("counters", {})
+        for k in sorted(set(bc) | set(cc)):
+            b, c = bc.get(k, 0), cc.get(k, 0)
+            if b or c:
+                print(f"  telemetry.{k}: {b} -> {c} (advisory — "
+                      f"fleet composition, not a regression)")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from tpuvsr.obs.telemetry import TelemetryAggregator
+    except Exception as e:  # noqa: BLE001 — advisory outside the repo
+        print(f"  telemetry gate skipped (cannot import the "
+              f"aggregator: {e})")
+        return 0
+    with tempfile.TemporaryDirectory(
+            prefix="tpuvsr-telemetry-gate-") as tmp:
+        jdir = os.path.join(tmp, "journals")
+        os.makedirs(jdir)
+        jp = os.path.join(jdir, "j0001-drill.jsonl")
+        half = len(_DRILL_JOB) // 2
+        with open(jp, "w") as f:
+            for ev in _DRILL_JOB[:half]:
+                f.write(json.dumps(ev) + "\n")
+        inc = TelemetryAggregator(tmp, journal_breaches=False)
+        inc.poll()                      # mid-stream fold, then resume
+        with open(jp, "a") as f:
+            for ev in _DRILL_JOB[half:]:
+                f.write(json.dumps(ev) + "\n")
+        with open(os.path.join(tmp, "pool.jsonl"), "w") as f:
+            for ev in _DRILL_POOL:
+                f.write(json.dumps(ev) + "\n")
+        inc.poll()
+        a = TelemetryAggregator(tmp, journal_breaches=False)
+        a.poll()
+        b = TelemetryAggregator(tmp, journal_breaches=False)
+        b.poll()
+        s_inc, s_a, s_b = inc.snapshot(), a.snapshot(), b.snapshot()
+    if s_a == s_b == s_inc and s_a["events"] == len(_DRILL_JOB) + \
+            len(_DRILL_POOL):
+        print(f"  telemetry fold: deterministic (fresh == fresh == "
+              f"incremental over {s_a['events']} events)")
+        return 0
+    print("compare_bench: telemetry fold NONDETERMINISM — the same "
+          "journal bytes produced different folds (restart "
+          "reconvergence is broken)", file=sys.stderr)
+    return 1
+
+
 def liveness_stats(doc):
     """Liveness-path health of a document (ISSUE 15):
     ``(edges_per_s, check_s, mode, overhead)`` or all-None.  Reads
@@ -523,8 +645,12 @@ def main(argv=None):
     # growth fails at matching por modes; on/off mismatches are
     # advisory
     por_rc = gate_por(base_doc, cand_doc, args.max_regression)
+    # the telemetry fold likewise (ISSUE 17): same journals must
+    # produce an identical fold — determinism regressions fail,
+    # embedded fleet-counter drift is advisory
+    tel_rc = gate_telemetry(base_doc, cand_doc, args.max_regression)
     sim_rc = (sim_rc or val_rc or pack_rc or sym_rc or liv_rc
-              or por_rc or (1 if occ_regressed else 0))
+              or por_rc or tel_rc or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch or commit_mismatch:

@@ -82,6 +82,19 @@ reference mount, no TPU, seconds on the CPU backend:
                      the queue, and the resumed attempt's divergence
                      report is bit-identical to an undisturbed oracle
                      job's
+  kill-aggregator-mid-tail  SIGKILL the telemetry aggregator mid-tail
+                     (ISSUE 17) -> the spool stays fully servable: the
+                     torn breach-journal tail is held back, a fresh
+                     aggregator refolds from byte 0, and two fresh
+                     folds are bit-identical (the fold is a pure
+                     function of the journal bytes)
+  kill-worker-mid-event  SIGKILL a worker mid-run under
+                     TPUVSR_JOURNAL_FSYNC=1 (ISSUE 17) -> the dead
+                     worker's journal is a valid prefix (every
+                     complete line parses), the live aggregator folds
+                     it, the survivor resumes the job, and the
+                     incremental fold reconverges exactly with a
+                     from-scratch fold
   kill-liveness-resume  SIGTERM mid-graph-build on a STREAMED temporal
                      run (ISSUE 15: edges flowing out of the fused
                      commit) -> rescue snapshot carrying gid column +
@@ -939,6 +952,133 @@ def scenario_kill_one_of_n_workers(tmp):
     }
 
 
+#: the killed telemetry aggregator: tails the spool in a tight poll
+#: loop under a microscopic queue-wait SLO (so it journals
+#: ``slo_breach`` lines to its own telemetry/events.jsonl), then
+#: SIGKILLs itself after the first poll that folded events — offsets
+#: lost, breach journal mid-life
+_DOOMED_AGGREGATOR = """\
+import os, signal, sys, time
+from tpuvsr.obs.telemetry import TelemetryAggregator
+
+agg = TelemetryAggregator(sys.argv[1], window_s=1.0,
+                          slo={"queue_wait_p99_s": 1e-9})
+while True:
+    agg.poll()
+    if agg.snapshot()["events"] > 0:
+        os.kill(os.getpid(), signal.SIGKILL)
+    time.sleep(0.05)
+"""
+
+
+def scenario_kill_aggregator_mid_tail(tmp):
+    """ISSUE 17: the telemetry aggregator is a pure READER — SIGKILL
+    it mid-tail (in-memory offsets lost, its breach journal possibly
+    torn mid-append) and the spool must stay fully servable: a torn
+    events.jsonl tail is held back by the \\n-holdback discipline, a
+    fresh aggregator refolds from byte 0 without error, and two
+    independent fresh folds are IDENTICAL (the fold is a pure
+    function of the journal bytes — nothing the dead reader held
+    mattered)."""
+    import subprocess
+    from tpuvsr.obs.telemetry import TelemetryAggregator
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import subprocess_env
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool)
+    q.submit("<stub>", engine="device", flags={"stub": True})
+    Worker(q, devices=1).drain()
+    p = subprocess.run(
+        [sys.executable, "-c", _DOOMED_AGGREGATOR, spool],
+        env=subprocess_env(), capture_output=True, text=True,
+        timeout=300)
+    killed = p.returncode in (-9, 137)
+    # simulate the worst kill point: a half-appended breach line with
+    # no terminating newline left on the aggregator's own journal
+    evp = os.path.join(spool, "telemetry", "events.jsonl")
+    breach_lines = 0
+    if os.path.exists(evp):
+        with open(evp) as f:
+            breach_lines = sum(1 for ln in f if ln.endswith("\n"))
+        with open(evp, "a") as f:
+            f.write('{"event": "slo_br')
+    # more fleet activity lands AFTER the reader died
+    j2 = q.submit("<stub:after>", engine="device",
+                  flags={"stub": True})
+    Worker(q, devices=1).drain()
+    a1 = TelemetryAggregator(spool, journal_breaches=False)
+    a1.poll()
+    a2 = TelemetryAggregator(spool, journal_breaches=False)
+    a2.poll()
+    s1, s2 = a1.snapshot(), a2.snapshot()
+    done = q.get(j2.job_id)
+    ok = (killed and breach_lines >= 1 and s1 == s2
+          and s1["counters"]["jobs_submitted"] == 2
+          and s1["counters"]["slo_breaches"] >= 1
+          and done.state == "done")
+    return {
+        "ok": ok, "killed_rc": p.returncode,
+        "breach_lines_journaled": breach_lines,
+        "events_folded": s1["events"],
+        "slo_breaches": s1["counters"]["slo_breaches"],
+        "reconverged": s1 == s2,
+    }
+
+
+def scenario_kill_worker_mid_event(tmp):
+    """ISSUE 17: a worker SIGKILLed mid-run under
+    ``TPUVSR_JOURNAL_FSYNC=1`` leaves a journal that is a valid
+    prefix — every complete line parses, at most the last line is
+    torn — the live aggregator folds it without error, the survivor
+    recovers and finishes the job, and the killed-then-resumed
+    incremental fold reconverges EXACTLY with a from-scratch fold."""
+    import subprocess
+    from tpuvsr.obs.telemetry import TelemetryAggregator
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import subprocess_env
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool)
+    job = q.submit("<stub>", engine="device", flags={"stub": True})
+    p = subprocess.run(
+        [sys.executable, "-c", _DOOMED_WORKER, spool],
+        env=subprocess_env({"TPUVSR_JOURNAL_FSYNC": "1"}),
+        capture_output=True, text=True, timeout=300)
+    killed = p.returncode in (-9, 137)
+    # the dead worker's journal: every \n-terminated line is valid
+    # JSON (fsync-per-event means nothing buffered was lost)
+    torn, parsed = 0, []
+    with open(q.journal_path(job.job_id)) as f:
+        for line in f:
+            if not line.endswith("\n"):
+                torn += 1
+                continue
+            parsed.append(json.loads(line))
+    mid = TelemetryAggregator(spool, journal_breaches=False)
+    mid.poll()
+    mid_events = mid.snapshot()["events"]
+    # the survivor's ordinary drain recovers the stale claim
+    Worker(q, devices=1, owner="wB", light_threads=0).drain()
+    done = q.get(job.job_id)
+    mid.poll()                 # the mid-kill aggregator keeps tailing
+    fresh = TelemetryAggregator(spool, journal_breaches=False)
+    fresh.poll()
+    s_resumed, s_fresh = mid.snapshot(), fresh.snapshot()
+    ok = (killed and torn <= 1 and len(parsed) >= 3
+          and mid_events >= len(parsed)
+          and done.state == "done"
+          and s_resumed == s_fresh
+          and s_fresh["counters"]["requeues"] >= 1
+          and s_fresh["jobs_by_state"].get("done") == 1)
+    return {
+        "ok": ok, "killed_rc": p.returncode,
+        "torn_lines": torn, "parsed_lines": len(parsed),
+        "state": done.state,
+        "incremental_fold_reconverged": s_resumed == s_fresh,
+    }
+
+
 def scenario_sim_oom_shrink(tmp):
     """Injected OOM inside a fleet chunk (ISSUE 7): the fleet's own
     degrade ladder halves the walker count, journals
@@ -1143,6 +1283,8 @@ SCENARIOS = [
     ("service-preempt-requeue", scenario_service_preempt_requeue),
     ("service-oom-degrade", scenario_service_oom_degrade),
     ("kill-one-of-n-workers", scenario_kill_one_of_n_workers),
+    ("kill-aggregator-mid-tail", scenario_kill_aggregator_mid_tail),
+    ("kill-worker-mid-event", scenario_kill_worker_mid_event),
     ("sim-oom-shrink", scenario_sim_oom_shrink),
     ("kill-hunt-resume", scenario_kill_hunt_resume),
     ("kill-validate-resume", scenario_kill_validate_resume),

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Render a job's end-to-end span tree from its journal (ISSUE 17).
+
+Every journal event of a submitted job carries the ``trace_id`` minted
+at ``job_submitted`` plus a ``span_id``/``parent_span`` naming the
+process segment that wrote it (service root span -> per-attempt worker
+span -> per-engine-run segment), so the journal alone reconstructs the
+job's whole story across the service / worker / engine hops:
+
+    python scripts/trace_view.py SPOOL/journals/j0001-xxxx.jsonl
+    python scripts/trace_view.py --spool SPOOL --job j0001-xxxx
+    python scripts/trace_view.py J.jsonl --trace 3f709578dcd6457b
+    python scripts/trace_view.py J.jsonl --perfetto out.json \
+        [--merge profile_trace.json]
+
+The default output is an indented span tree with per-span timing and
+event rollups.  ``--perfetto`` exports Chrome/Perfetto trace-event
+JSON (``ph: "X"`` duration slices per span, ``ph: "i"`` instants for
+faults/violations/breaches); ``--merge`` folds the ``traceEvents`` of
+an existing profiler export (a ``TPUVSR_PROFILE`` run) into the same
+file, so the service-level spans and the jitted-step spans land on one
+Perfetto timeline.
+
+Stdlib only — usable against a live spool while workers run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load_events(path):
+    """Parse a journal leniently: skip torn/garbage lines (a live
+    worker may be mid-append) — the viewer is a reader, not a
+    validator."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.endswith("\n"):
+                    break                      # torn tail
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict) and "event" in ev:
+                    out.append(ev)
+    except OSError as e:
+        raise SystemExit(f"trace_view: cannot read {path}: {e}")
+    return out
+
+
+def build_spans(events, trace_id=None):
+    """Fold events into ``{span_id: span}`` for one trace.  Returns
+    ``(trace_id, spans)``; events without trace keys (pre-telemetry
+    journals) fold into a synthetic ``untraced`` span so old journals
+    still render."""
+    traces = {}
+    for ev in events:
+        traces.setdefault(ev.get("trace_id"), []).append(ev)
+    if trace_id is None:
+        # prefer the (single) real trace; fall back to untraced
+        real = [t for t in traces if t]
+        if len(real) > 1:
+            raise SystemExit(
+                "trace_view: journal holds several traces "
+                f"({', '.join(sorted(real))}); pick one with --trace")
+        trace_id = real[0] if real else None
+    evs = traces.get(trace_id)
+    if not evs:
+        raise SystemExit(f"trace_view: no events for trace "
+                         f"{trace_id!r}")
+    spans = {}
+    for ev in evs:
+        sid = ev.get("span_id") or "untraced"
+        s = spans.get(sid)
+        if s is None:
+            s = spans[sid] = {"span_id": sid, "parent": None,
+                              "t0": None, "t1": None, "events": 0,
+                              "kinds": {}, "run_ids": set(),
+                              "marks": []}
+        if ev.get("parent_span"):
+            s["parent"] = ev["parent_span"]
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            s["t0"] = ts if s["t0"] is None else min(s["t0"], ts)
+            s["t1"] = ts if s["t1"] is None else max(s["t1"], ts)
+        s["events"] += 1
+        kind = ev["event"]
+        s["kinds"][kind] = s["kinds"].get(kind, 0) + 1
+        if ev.get("run_id"):
+            s["run_ids"].add(ev["run_id"])
+        if kind in ("fault", "violation", "hunt_violation",
+                    "divergence", "slo_breach", "degrade",
+                    "rescue_checkpoint"):
+            s["marks"].append((ts, kind, ev))
+    # orphan parents (a span referenced but never written to — e.g. a
+    # worker died before its first event) become empty placeholders
+    for s in list(spans.values()):
+        p = s["parent"]
+        if p and p not in spans:
+            spans[p] = {"span_id": p, "parent": None, "t0": s["t0"],
+                        "t1": s["t1"], "events": 0, "kinds": {},
+                        "run_ids": set(), "marks": []}
+    return trace_id, spans
+
+
+def _label(s):
+    kinds = s["kinds"]
+    if "job_started" in kinds:
+        return "attempt"
+    if "job_submitted" in kinds or "job_done" in kinds \
+            or "sched_decision" in kinds:
+        return "service"
+    if "run_start" in kinds or "level_done" in kinds \
+            or "sim_chunk" in kinds or "validate_chunk" in kinds:
+        return "engine-run"
+    return "segment"
+
+
+def render_tree(trace_id, spans, out=sys.stdout):
+    roots = sorted((s for s in spans.values() if not s["parent"]),
+                   key=lambda s: (s["t0"] is None, s["t0"]))
+    kids = {}
+    for s in spans.values():
+        if s["parent"]:
+            kids.setdefault(s["parent"], []).append(s)
+    t_base = min((s["t0"] for s in spans.values()
+                  if s["t0"] is not None), default=0.0)
+    print(f"trace {trace_id}", file=out)
+
+    def walk(s, depth):
+        dur = ((s["t1"] - s["t0"])
+               if s["t0"] is not None and s["t1"] is not None else None)
+        rel = (s["t0"] - t_base) if s["t0"] is not None else None
+        top = ", ".join(
+            f"{k}x{n}" if n > 1 else k
+            for k, n in sorted(s["kinds"].items(),
+                               key=lambda kv: -kv[1])[:4])
+        bits = [f"{s['span_id']}", f"[{_label(s)}]"]
+        if rel is not None:
+            bits.append(f"+{rel:.3f}s")
+        if dur is not None:
+            bits.append(f"{dur:.3f}s")
+        bits.append(f"{s['events']} ev" + (f" ({top})" if top else ""))
+        print("  " * depth + "- " + "  ".join(bits), file=out)
+        for ts, kind, ev in sorted(s["marks"],
+                                   key=lambda m: m[0] or 0):
+            what = ev.get("what") or ev.get("name") or \
+                ev.get("kind") or ""
+            print("  " * (depth + 1) + f"! {kind} {what}".rstrip(),
+                  file=out)
+        for kid in sorted(kids.get(s["span_id"], []),
+                          key=lambda k: (k["t0"] is None, k["t0"])):
+            walk(kid, depth + 1)
+
+    for r in roots:
+        walk(r, 1)
+
+
+def perfetto_events(trace_id, spans):
+    """Chrome/Perfetto trace-event rows: one ``X`` slice per span,
+    ``i`` instants for the notable marks.  ``tid`` is a small stable
+    integer per span (sorted order), ``pid`` 1 — the profiler merge
+    keeps its own pids so both land on one timeline."""
+    rows = []
+    order = {sid: i + 1 for i, sid in enumerate(sorted(spans))}
+    for sid, s in sorted(spans.items()):
+        if s["t0"] is None:
+            continue
+        dur = max(0.0, (s["t1"] or s["t0"]) - s["t0"])
+        rows.append({
+            "name": f"{_label(s)} {sid}", "cat": "tpuvsr",
+            "ph": "X", "ts": s["t0"] * 1e6,
+            "dur": max(1.0, dur * 1e6),
+            "pid": 1, "tid": order[sid],
+            "args": {"trace_id": trace_id, "span_id": sid,
+                     "parent_span": s["parent"],
+                     "events": s["events"],
+                     "run_ids": sorted(s["run_ids"])}})
+        for ts, kind, ev in s["marks"]:
+            if ts is None:
+                continue
+            rows.append({
+                "name": kind, "cat": "tpuvsr", "ph": "i",
+                "ts": ts * 1e6, "pid": 1, "tid": order[sid],
+                "s": "t",
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("ts",)}})
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a job's span tree from its journal")
+    ap.add_argument("journal", nargs="?", default=None,
+                    help="path to a journal .jsonl (or use "
+                         "--spool/--job)")
+    ap.add_argument("--spool", default=None)
+    ap.add_argument("--job", default=None)
+    ap.add_argument("--trace", default=None,
+                    help="trace id to render when the journal holds "
+                         "several")
+    ap.add_argument("--perfetto", default=None, metavar="OUT.json",
+                    help="export Chrome/Perfetto trace-event JSON")
+    ap.add_argument("--merge", default=None, metavar="PROFILE.json",
+                    help="fold an existing trace-event file (a "
+                         "TPUVSR_PROFILE export) into --perfetto's "
+                         "output")
+    args = ap.parse_args(argv)
+    path = args.journal
+    if path is None:
+        if not (args.spool and args.job):
+            ap.error("give a JOURNAL path, or --spool and --job")
+        path = os.path.join(args.spool, "journals",
+                            f"{args.job}.jsonl")
+    events = load_events(path)
+    if not events:
+        raise SystemExit(f"trace_view: {path} holds no events")
+    trace_id, spans = build_spans(events, trace_id=args.trace)
+    render_tree(trace_id, spans)
+    if args.perfetto:
+        rows = perfetto_events(trace_id, spans)
+        if args.merge:
+            try:
+                with open(args.merge) as f:
+                    prof = json.load(f)
+            except (OSError, ValueError) as e:
+                raise SystemExit(
+                    f"trace_view: cannot merge {args.merge}: {e}")
+            rows.extend(prof.get("traceEvents", prof)
+                        if isinstance(prof, dict) else prof)
+        with open(args.perfetto, "w") as f:
+            json.dump({"traceEvents": rows,
+                       "displayTimeUnit": "ms"}, f)
+        print(f"perfetto export: {args.perfetto} "
+              f"({len(rows)} event(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,8 +15,10 @@ Device half (reference-gated, CPU backend like every device test):
   continuous event stream with cumulative elapsed preserved.
 """
 
+import io
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -28,8 +30,10 @@ from tpuvsr.engine.bfs import bfs_check
 from tpuvsr.engine.spec import SpecModel
 from tpuvsr.frontend.cfg import parse_cfg_text
 from tpuvsr.frontend.parser import parse_module_text
-from tpuvsr.obs import (Metrics, RunObserver, read_journal,
-                        validate_journal_line, validate_metrics)
+from tpuvsr.obs import (Journal, Metrics, RunObserver, new_span_id,
+                        new_trace_id, read_journal, root_span,
+                        trace_env, trace_scope, validate_journal_line,
+                        validate_metrics)
 # the inline counter spec + stub device kernel live in tpuvsr.testing
 # (shared with tests/test_resilience.py and scripts/fault_matrix.py)
 from tpuvsr.testing import COUNTER, COUNTER_CFG, counter_spec
@@ -645,3 +649,225 @@ def test_recover_continues_one_journal(tmp_path):
     res3 = eng3.run(max_depth=7)
     assert res2.distinct_states == res3.distinct_states
     assert res2.levels == res3.levels
+
+
+# ---------------------------------------------------------------------
+# end-to-end trace correlation (ISSUE 17)
+# ---------------------------------------------------------------------
+def test_trace_helper_units():
+    tids = {new_trace_id() for _ in range(64)}
+    assert len(tids) == 64
+    tid = tids.pop()
+    assert re.fullmatch(r"[0-9a-f]{16}", tid)
+    # the root span is DERIVABLE by any process that knows the trace
+    assert root_span(tid) == "r" + tid[:8]
+    assert root_span(tid) == root_span(tid)
+    assert re.fullmatch(r"[0-9a-f]{8}", new_span_id())
+    # trace_env omits unset members so a child never sees "None"
+    assert trace_env(tid, parent_span="aaaa0001") == {
+        "TPUVSR_TRACE_ID": tid, "TPUVSR_PARENT_SPAN": "aaaa0001"}
+    assert trace_env() == {}
+
+
+def test_trace_scope_sets_scrubs_and_restores_env(monkeypatch):
+    monkeypatch.setenv("TPUVSR_TRACE_ID", "outer-trace")
+    monkeypatch.setenv("TPUVSR_SPAN_ID", "outer-span")
+    monkeypatch.delenv("TPUVSR_PARENT_SPAN", raising=False)
+    with trace_scope("feedfacefeedface", parent_span="aaaa0001"):
+        assert os.environ["TPUVSR_TRACE_ID"] == "feedfacefeedface"
+        assert os.environ["TPUVSR_PARENT_SPAN"] == "aaaa0001"
+        # the scope SCRUBS members it does not set — a child must not
+        # inherit the outer scope's span as its own
+        assert "TPUVSR_SPAN_ID" not in os.environ
+    assert os.environ["TPUVSR_TRACE_ID"] == "outer-trace"
+    assert os.environ["TPUVSR_SPAN_ID"] == "outer-span"
+    assert "TPUVSR_PARENT_SPAN" not in os.environ
+
+
+def test_journal_trace_stamping_and_env_suppression(tmp_path,
+                                                    monkeypatch):
+    p = str(tmp_path / "j.jsonl")
+    # explicit context: stamped verbatim on every line
+    j = Journal(p, run_id="r1", trace_id="feedfacefeedface",
+                span_id="rfeedface")
+    j.write("worker_heartbeat", job_id="x", worker="w0")
+    j.close()
+    # inherited context (trace_scope): the journal mints its OWN
+    # segment span under the scope's parent
+    with trace_scope("feedfacefeedface", parent_span="aaaa0001"):
+        j2 = Journal(p, run_id="r2")
+        j2.write("worker_heartbeat", job_id="x", worker="w0")
+        j2.close()
+        assert j2.span_id not in (None, "aaaa0001")
+    # explicit "" suppresses the env fallback entirely (a threaded
+    # worker's service journal beside a sibling job's scope)
+    monkeypatch.setenv("TPUVSR_TRACE_ID", "contamination")
+    j3 = Journal(p, run_id="r3", trace_id="", span_id="",
+                 parent_span="")
+    j3.write("worker_heartbeat", job_id="x", worker="w0")
+    j3.close()
+    rows = read_journal(p)
+    assert rows[0]["trace_id"] == "feedfacefeedface"
+    assert rows[0]["span_id"] == "rfeedface"
+    assert rows[1]["trace_id"] == "feedfacefeedface"
+    assert rows[1]["parent_span"] == "aaaa0001"
+    assert rows[1]["span_id"] == j2.span_id
+    assert "trace_id" not in rows[2] and "span_id" not in rows[2]
+
+
+def test_stub_job_trace_chain_service_to_engine(tmp_path):
+    """One stub job's journal reconstructs the whole story: submit
+    (service root span) -> attempt (worker span parented on root) ->
+    engine segment (minted span parented on the attempt)."""
+    from tpuvsr.service import JobQueue, Worker
+    q = JobQueue(str(tmp_path / "spool"))
+    j = q.submit("<stub>", engine="device", flags={"stub": True})
+    assert re.fullmatch(r"[0-9a-f]{16}", j.trace_id)
+    Worker(q, devices=1).drain()
+    assert q.get(j.job_id).state == "done"
+    events = read_journal(q.journal_path(j.job_id))
+    assert events
+    # ONE trace: every event of the job carries the submit-minted id
+    assert all(e.get("trace_id") == j.trace_id for e in events)
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["event"], []).append(e)
+    root = root_span(j.trace_id)
+    sub = by_kind["job_submitted"][0]
+    assert sub["span_id"] == root and "parent_span" not in sub
+    started = by_kind["job_started"][0]
+    attempt = started["span_id"]
+    assert attempt != root and started["parent_span"] == root
+    done = by_kind["job_done"][0]
+    assert done["span_id"] == attempt
+    # the engine-run segment minted its own span under the attempt
+    rs = by_kind["run_start"][0]
+    seg = rs["span_id"]
+    assert seg not in (root, attempt)
+    assert rs["parent_span"] == attempt
+    for kind in ("level_done", "run_end"):
+        assert all(e["span_id"] == seg for e in by_kind[kind])
+    assert all(e["trace_id"] == j.trace_id
+               for e in by_kind["sched_decision"])
+
+
+def test_worker_pool_shell_jobs_propagate_trace_env(tmp_path):
+    """Across PROCESS boundaries: each shell child of a 2-worker pool
+    sees its submitting job's trace_id and the attempt span as
+    TPUVSR_PARENT_SPAN — and no TPUVSR_SPAN_ID (the child's journals
+    mint their own segment spans)."""
+    from tpuvsr.serve import WorkerPool
+    from tpuvsr.service import JobQueue
+    from tpuvsr.testing import subprocess_env
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool)
+    dump = ("import os, sys, json; "
+            "json.dump({k: os.environ.get(k) for k in "
+            "('TPUVSR_TRACE_ID', 'TPUVSR_SPAN_ID', "
+            "'TPUVSR_PARENT_SPAN')}, open(sys.argv[1], 'w'))")
+    jobs = []
+    for i in range(4):
+        out = str(tmp_path / f"env{i}.json")
+        job = q.submit(f"env{i}", kind="shell",
+                       flags={"argv": [sys.executable, "-c", dump,
+                                       out],
+                              "timeout": 60})
+        jobs.append((job, out))
+    pool = WorkerPool(spool, 2, devices=2, drain=True,
+                      env=subprocess_env()).start()
+    assert pool.wait(timeout=120) == [0, 0]
+    q2 = JobQueue(spool)
+    for job, out in jobs:
+        assert q2.get(job.job_id).state == "done"
+        with open(out) as f:
+            seen = json.load(f)
+        assert seen["TPUVSR_TRACE_ID"] == job.trace_id
+        assert seen["TPUVSR_SPAN_ID"] is None
+        parent = seen["TPUVSR_PARENT_SPAN"]
+        assert parent and parent != root_span(job.trace_id)
+        # the parent handed down IS the attempt span journaled at
+        # job_started
+        events = read_journal(q.journal_path(job.job_id))
+        started = [e for e in events if e["event"] == "job_started"]
+        assert started[-1]["span_id"] == parent
+        assert all(e.get("trace_id") == job.trace_id for e in events)
+
+
+def _trace_story():
+    tid = "feedfacefeedface"
+    root = "rfeedface"
+    return tid, [
+        {"event": "job_submitted", "ts": 100.0, "run_id": "svc",
+         "job_id": "j1", "spec": "s.tla", "engine": "device",
+         "trace_id": tid, "span_id": root},
+        {"event": "sched_decision", "ts": 100.4, "run_id": "svc",
+         "job_id": "j1", "tenant": None, "policy": "drr",
+         "trace_id": tid, "span_id": root},
+        {"event": "job_started", "ts": 100.5, "run_id": "svc",
+         "job_id": "j1", "attempt": 1, "devices": 1,
+         "trace_id": tid, "span_id": "aaaa0001",
+         "parent_span": root},
+        {"event": "run_start", "ts": 100.6, "run_id": "r1",
+         "schema": "tpuvsr-journal/1", "engine": "device",
+         "module": "M", "backend": "cpu", "resumed": False,
+         "trace_id": tid, "span_id": "bbbb0001",
+         "parent_span": "aaaa0001"},
+        {"event": "fault", "ts": 104.0, "run_id": "r1",
+         "kind": "oom", "depth": 2, "action": "degrade",
+         "trace_id": tid, "span_id": "bbbb0001",
+         "parent_span": "aaaa0001"},
+        {"event": "run_end", "ts": 111.4, "run_id": "r1", "ok": True,
+         "elapsed_s": 10.8, "distinct": 9, "trace_id": tid,
+         "span_id": "bbbb0001", "parent_span": "aaaa0001"},
+        {"event": "job_done", "ts": 111.5, "run_id": "svc",
+         "job_id": "j1", "state": "done", "elapsed_s": 11.5,
+         "trace_id": tid, "span_id": "aaaa0001",
+         "parent_span": root},
+    ]
+
+
+def test_trace_view_span_tree_and_perfetto(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import trace_view
+    tid, story = _trace_story()
+    jp = str(tmp_path / "j1.jsonl")
+    with open(jp, "w") as f:
+        for ev in story:
+            f.write(json.dumps(ev) + "\n")
+        f.write('{"event": "torn')              # held back, not fatal
+    events = trace_view.load_events(jp)
+    assert len(events) == len(story)
+    got_tid, spans = trace_view.build_spans(events)
+    assert got_tid == tid
+    assert set(spans) == {"rfeedface", "aaaa0001", "bbbb0001"}
+    assert spans["aaaa0001"]["parent"] == "rfeedface"
+    assert spans["bbbb0001"]["parent"] == "aaaa0001"
+    assert trace_view._label(spans["rfeedface"]) == "service"
+    assert trace_view._label(spans["aaaa0001"]) == "attempt"
+    assert trace_view._label(spans["bbbb0001"]) == "engine-run"
+    buf = io.StringIO()
+    trace_view.render_tree(got_tid, spans, out=buf)
+    tree = buf.getvalue()
+    assert f"trace {tid}" in tree
+    # the tree nests service -> attempt -> engine-run and surfaces
+    # the fault as a mark line
+    assert tree.index("[service]") < tree.index("[attempt]") \
+        < tree.index("[engine-run]")
+    assert "! fault" in tree
+    rows = trace_view.perfetto_events(got_tid, spans)
+    slices = [r for r in rows if r["ph"] == "X"]
+    instants = [r for r in rows if r["ph"] == "i"]
+    assert len(slices) == 3 and len(instants) == 1
+    assert instants[0]["name"] == "fault"
+    by_span = {r["args"]["span_id"]: r for r in slices}
+    assert by_span["aaaa0001"]["ts"] == 100.5 * 1e6
+    # an old journal with no trace keys folds into ONE untraced span
+    legacy = str(tmp_path / "legacy.jsonl")
+    with open(legacy, "w") as f:
+        for ev in story[:3]:
+            ev = {k: v for k, v in ev.items()
+                  if k not in ("trace_id", "span_id", "parent_span")}
+            f.write(json.dumps(ev) + "\n")
+    got, spans = trace_view.build_spans(trace_view.load_events(legacy))
+    assert got is None and set(spans) == {"untraced"}

@@ -642,8 +642,9 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 23 scenarios since ISSUE 16 (kill-por-resume)
-    assert out["ok"] and len(out["scenarios"]) == 23
+    # 25 scenarios since ISSUE 17 (kill-aggregator-mid-tail +
+    # kill-worker-mid-event)
+    assert out["ok"] and len(out["scenarios"]) == 25
 
 
 # ---------------------------------------------------------------------

@@ -471,9 +471,10 @@ def test_http_round_trip_matches_cli(tmp_path, capsys):
                          "--flag", "inv_x_bound=2", "--engine",
                          "device", "--spool", spool, "--json"]) == 0
         cli_job = json.loads(capsys.readouterr().out.strip())
-        # same record shape either way (ids/seq/timestamps differ)
+        # same record shape either way (ids/seq/timestamps/trace
+        # differ — each submission mints its own trace_id)
         volatile = {"job_id", "seq", "submitted_ts", "updated_ts",
-                    "spec", "journal", "metrics"}
+                    "spec", "journal", "metrics", "trace_id"}
         wire_view = {k: v for k, v in wire_job.items()
                      if k not in volatile and k in cli_job}
         cli_view = {k: v for k, v in cli_job.items()

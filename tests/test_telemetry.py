@@ -1,0 +1,576 @@
+"""Fleet telemetry plane tests (ISSUE 17): the streamed journal
+aggregator's fold (windows, deltas, bounded memory, torn-tail
+holdback), fold determinism / restart reconvergence, the Prometheus
+text exposition held to the format grammar (golden lines, HELP/TYPE
+pairing, label escaping, bucket monotonicity), the SLO watchdog
+(queue-wait targets, throughput baselines, journal-derived dedup),
+and the three exposition surfaces (CLI verb, status --json embed,
+HTTP endpoints).
+
+Everything here folds HAND-WRITTEN journals — no jax, no engines —
+so the whole file runs in well under a second plus the two service
+drills at the end.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import os
+import re
+
+import pytest
+
+from tpuvsr.exitcodes import EX_USAGE
+from tpuvsr.obs.journal import Journal, validate_journal_line
+from tpuvsr.obs.telemetry import (BUCKETS, TELEMETRY_SCHEMA, Histogram,
+                                  TelemetryAggregator, prometheus_text,
+                                  render_watch)
+
+
+# ---------------------------------------------------------------------
+# fixture journals
+# ---------------------------------------------------------------------
+def _write(path, events, mode="a"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, mode) as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _job_story(job_id="j0001-aaaa", tenant="acme", t0=100.0,
+               run_id="r1", devices=1, trace_id="feedfacefeedface"):
+    """One job's full service story: submit -> drr pop -> start ->
+    engine run crossing a window boundary -> done.  Queue wait 0.5 s,
+    run time 11.0 s, 9 distinct states (4 in the first window, 5 in
+    the second)."""
+    return [
+        {"event": "job_submitted", "ts": t0, "run_id": "svc",
+         "job_id": job_id, "spec": "s.tla", "engine": "device",
+         "tenant": tenant, "trace_id": trace_id,
+         "span_id": f"r{trace_id[:8]}"},
+        {"event": "sched_decision", "ts": t0 + 0.4, "run_id": "svc",
+         "job_id": job_id, "tenant": tenant, "policy": "drr",
+         "weight": 2, "deficit": 1.5, "priority": 0,
+         "aged_priority": 0, "waited_s": 0.4, "worker": "w0"},
+        {"event": "job_started", "ts": t0 + 0.5, "run_id": "svc",
+         "job_id": job_id, "attempt": 1, "devices": devices},
+        {"event": "run_start", "ts": t0 + 0.6, "run_id": run_id,
+         "schema": "tpuvsr-journal/1", "engine": "device",
+         "module": "Drill", "backend": "cpu", "resumed": False},
+        {"event": "level_done", "ts": t0 + 1.0, "run_id": run_id,
+         "depth": 1, "frontier": 3, "distinct": 4, "generated": 6,
+         "elapsed_s": 0.4},
+        {"event": "level_done", "ts": t0 + 11.0, "run_id": run_id,
+         "depth": 2, "frontier": 5, "distinct": 9, "generated": 14,
+         "elapsed_s": 10.4},
+        {"event": "run_end", "ts": t0 + 11.4, "run_id": run_id,
+         "ok": True, "elapsed_s": 10.8, "distinct": 9},
+        {"event": "job_done", "ts": t0 + 11.5, "run_id": "svc",
+         "job_id": job_id, "state": "done", "elapsed_s": 11.5},
+    ]
+
+
+def _spool(tmp_path, extra_events=(), tenant="acme"):
+    spool = str(tmp_path / "spool")
+    _write(os.path.join(spool, "journals", "j0001-aaaa.jsonl"),
+           _job_story(tenant=tenant) + list(extra_events))
+    return spool
+
+
+# ---------------------------------------------------------------------
+# histogram unit
+# ---------------------------------------------------------------------
+def test_histogram_buckets_and_quantiles():
+    h = Histogram()
+    assert h.quantile(0.5) is None
+    for v in (0.003, 0.02, 0.3, 0.3, 7.0):
+        h.observe(v)
+    assert h.total == 5 and h.inf == 0
+    assert h.quantile(0.5) == 0.5       # 3rd of 5 lands in le=0.5
+    assert h.quantile(0.99) == 10.0
+    h.observe(5000.0)                   # beyond the last bound
+    assert h.inf == 1
+    assert math.isinf(h.quantile(1.0))
+    d = h.to_dict()
+    assert d["count"] == 6 and d["inf"] == 1
+    assert d["p50"] == 0.5
+    assert sum(d["buckets"]) + d["inf"] == d["count"]
+    # negative observations clamp to zero, never a negative sum
+    h2 = Histogram()
+    h2.observe(-3.0)
+    assert h2.sum == 0.0 and h2.counts[0] == 1
+
+
+# ---------------------------------------------------------------------
+# the fold
+# ---------------------------------------------------------------------
+def test_fold_windows_deltas_tenants_workers(tmp_path):
+    spool = _spool(tmp_path, extra_events=[
+        # push the fold clock past window 11 so window 11 is the
+        # "last complete" one the headline rates read from
+        {"event": "worker_heartbeat", "ts": 125.0, "run_id": "svc",
+         "job_id": "j0001-aaaa", "worker": "w0"}])
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    n = agg.poll()
+    assert n == 9
+    s = agg.snapshot()
+    assert s["schema"] == TELEMETRY_SCHEMA
+    assert s["as_of_ts"] == 125.0           # fold clock = max event ts
+    assert s["counters"]["jobs_submitted"] == 1
+    assert s["counters"]["sched_decisions"] == 1
+    assert s["jobs_by_state"] == {"done": 1}
+    assert s["in_flight"] == 0              # job_done pruned it
+    # windows: ts 100-109 -> window 10 (4 distinct), 110-119 -> 11 (5)
+    by_key = {w["window"]: w for w in s["windows"]}
+    assert by_key[10]["distinct"] == 4
+    assert by_key[11]["distinct"] == 5
+    assert by_key[11]["generated"] == 8     # 14 - 6 cumulative delta
+    # last complete window (11): 5 distinct / 10 s
+    assert s["rates"]["distinct_per_s"] == 0.5
+    t = s["tenants"]["acme"]
+    assert t["queue_wait"]["count"] == 1
+    assert t["queue_wait"]["p50"] == 0.5    # 0.5 s wait -> le=0.5
+    assert t["run_time"]["p50"] == 25.0     # 11 s run -> le=25
+    assert t["device_s"] == 11.0
+    assert t["device_share"] == 1.0
+    assert t["weight"] == 2 and t["deficit"] == 1.5
+    w0 = s["workers"]["w0"]
+    assert w0["jobs"] == 1 and w0["busy_s"] == 11.0
+    assert w0["utilization"] == round(11.0 / (125.0 - 100.4), 4)
+
+
+def test_fold_requeue_resets_queue_wait_and_counts(tmp_path):
+    spool = str(tmp_path / "spool")
+    story = _job_story()[:5] + [
+        {"event": "job_requeued", "ts": 103.0, "run_id": "svc",
+         "job_id": "j0001-aaaa", "reason": "preempted",
+         "elapsed_s": 2.5},
+        {"event": "job_started", "ts": 105.0, "run_id": "svc",
+         "job_id": "j0001-aaaa", "attempt": 2, "devices": 1},
+        {"event": "job_done", "ts": 109.0, "run_id": "svc",
+         "job_id": "j0001-aaaa", "state": "done", "elapsed_s": 9.0},
+    ]
+    _write(os.path.join(spool, "journals", "j0001-aaaa.jsonl"), story)
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    agg.poll()
+    s = agg.snapshot()
+    assert s["counters"]["requeues"] == 1
+    t = s["tenants"]["acme"]
+    # two waits (0.5 s then 2.0 s) and two attempt run times
+    assert t["queue_wait"]["count"] == 2
+    assert t["run_time"]["count"] == 2
+    assert s["jobs_by_state"] == {"done": 1}
+
+
+def test_fold_is_deterministic_and_restart_reconverges(tmp_path):
+    spool = str(tmp_path / "spool")
+    # incremental fold: poll mid-file, then the rest lands.  The
+    # first poll's clock stays inside the first window so no window
+    # has been SLO-evaluated before the stragglers arrive.
+    story1 = _job_story()
+    story2 = _job_story(job_id="j0002-bbbb", tenant="beta",
+                        run_id="r2", trace_id="beadbeadbeadbead")
+    j1 = os.path.join(spool, "journals", "j0001-aaaa.jsonl")
+    jp = os.path.join(spool, "journals", "j0002-bbbb.jsonl")
+    _write(j1, story1[:5])
+    _write(jp, story2[:4])
+    inc = TelemetryAggregator(spool, journal_breaches=False)
+    inc.poll()
+    _write(j1, story1[5:])
+    _write(jp, story2[4:])
+    _write(os.path.join(spool, "pool.jsonl"), [
+        {"event": "worker_respawn", "ts": 113.0, "run_id": "pool",
+         "worker": "w1", "attempt": 1, "rc": 1}])
+    inc.poll()
+    fresh_a = TelemetryAggregator(spool, journal_breaches=False)
+    fresh_a.poll()
+    fresh_b = TelemetryAggregator(spool, journal_breaches=False)
+    fresh_b.poll()
+    assert fresh_a.snapshot() == fresh_b.snapshot() == inc.snapshot()
+    s = fresh_a.snapshot()
+    assert s["counters"]["worker_respawns"] == 1
+    assert set(s["tenants"]) == {"acme", "beta"}
+
+
+def test_torn_tail_is_held_back_until_completed(tmp_path):
+    spool = _spool(tmp_path)
+    jp = os.path.join(spool, "journals", "j0001-aaaa.jsonl")
+    with open(jp, "a") as f:
+        f.write('{"event": "worker_heartbeat", "ts": 130.0, ')
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    assert agg.poll() == 8                  # torn line not consumed
+    assert agg.snapshot()["as_of_ts"] == 111.5
+    with open(jp, "a") as f:
+        f.write('"run_id": "svc", "job_id": "j0001-aaaa", '
+                '"worker": "w0"}\n')
+    assert agg.poll() == 1                  # completed line folds
+    assert agg.snapshot()["as_of_ts"] == 130.0
+
+
+def test_garbage_lines_fold_as_noise_not_errors(tmp_path):
+    spool = str(tmp_path / "spool")
+    jp = os.path.join(spool, "journals", "j0001-aaaa.jsonl")
+    os.makedirs(os.path.dirname(jp))
+    with open(jp, "w") as f:
+        f.write("not json at all\n")
+        f.write('{"no_event_key": 1, "ts": 5}\n')
+        f.write('{"event": "level_done", "ts": "NaNsense"}\n')
+        f.write(json.dumps({"event": "made_up_kind", "ts": 50.0,
+                            "run_id": "x"}) + "\n")
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    # only the event with a usable ts counts; unknown kinds count
+    # without folding anything else
+    assert agg.poll() == 1
+    assert agg.snapshot()["events"] == 1
+
+
+def test_bounded_memory_window_ring_and_pending_prune(tmp_path):
+    spool = str(tmp_path / "spool")
+    events = [{"event": "job_submitted", "ts": 0.0, "run_id": "svc",
+               "job_id": "j-old", "spec": "s", "engine": "device"}]
+    events += [{"event": "worker_heartbeat", "ts": float(t),
+                "run_id": "svc", "job_id": "j-old", "worker": "w0"}
+               for t in range(10, 2000, 10)]
+    _write(os.path.join(spool, "journals", "j-old.jsonl"), events)
+    agg = TelemetryAggregator(spool, window_s=10.0, max_windows=8,
+                              journal_breaches=False)
+    agg.poll()
+    s = agg.snapshot()
+    assert len(s["windows"]) <= 9           # ring: horizon + current
+    assert min(w["window"] for w in s["windows"]) >= 199 - 9
+    # the never-finished job fell off the pending horizon
+    assert s["in_flight"] == 0
+
+
+# ---------------------------------------------------------------------
+# Prometheus text exposition: golden lines + format grammar
+# ---------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'           # metric name
+    r'(\{[^}]*\})?'                          # optional labels
+    r' (NaN|[+-]Inf|-?[0-9.e+-]+)$')         # value
+
+
+def _grammar_check(text):
+    """Hold a text-format 0.0.4 exposition to the grammar: every
+    sample belongs to a metric family announced by a HELP and a TYPE
+    line, histogram buckets are cumulative-monotone and end at
+    +Inf == count."""
+    helps, types, samples = set(), {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            types[name] = mtype
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    fam = {}
+    for name, labels, value in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name) \
+            if re.search(r"_(bucket|sum|count)$", name) \
+            and re.sub(r"_(bucket|sum|count)$", "", name) in types \
+            else name
+        assert base in helps, f"{name} has no HELP line"
+        assert base in types, f"{name} has no TYPE line"
+        fam.setdefault(base, []).append((name, labels, value))
+    # histogram invariants per label set
+    for base, mtype in types.items():
+        if mtype != "histogram":
+            continue
+        series = {}
+        for name, labels, value in fam[base]:
+            if name.endswith("_bucket"):
+                le = re.search(r'le="([^"]*)"', labels).group(1)
+                key = re.sub(r',?le="[^"]*"', "", labels)
+                series.setdefault(key, []).append((le, float(value)))
+            elif name.endswith("_count"):
+                key = labels
+                series.setdefault(key, []).append(("count",
+                                                   float(value)))
+        for key, rows in series.items():
+            buckets = [(le, v) for le, v in rows if le != "count"]
+            count = dict(rows).get("count")
+            vals = [v for _le, v in buckets]
+            assert vals == sorted(vals), \
+                f"{base}{key}: buckets not monotone: {vals}"
+            les = [le for le, _v in buckets]
+            assert les[-1] == "+Inf", f"{base}{key} missing +Inf"
+            assert vals[-1] == count, \
+                f"{base}{key}: +Inf bucket {vals[-1]} != count {count}"
+    return types
+
+
+def test_prometheus_text_golden_lines(tmp_path):
+    spool = _spool(tmp_path, extra_events=[
+        {"event": "worker_heartbeat", "ts": 125.0, "run_id": "svc",
+         "job_id": "j0001-aaaa", "worker": "w0"}])
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    agg.poll()
+    text = prometheus_text(agg.snapshot())
+    lines = text.splitlines()
+    # golden lines: the fold above pins these exactly
+    for golden in (
+            "# TYPE tpuvsr_events_total counter",
+            "tpuvsr_events_total 9",
+            "tpuvsr_jobs_submitted_total 1",
+            'tpuvsr_jobs_total{state="done"} 1',
+            "tpuvsr_jobs_in_flight 0",
+            "tpuvsr_slo_breach_total 0",
+            "tpuvsr_distinct_per_s 0.5",
+            "# TYPE tpuvsr_queue_wait_seconds histogram",
+            'tpuvsr_queue_wait_seconds_bucket{tenant="acme",'
+            'le="0.5"} 1',
+            'tpuvsr_queue_wait_seconds_bucket{tenant="acme",'
+            'le="+Inf"} 1',
+            'tpuvsr_queue_wait_seconds_count{tenant="acme"} 1',
+            'tpuvsr_tenant_device_seconds_total{tenant="acme"} 11.0',
+            'tpuvsr_worker_jobs_total{worker="w0"} 1',
+    ):
+        assert golden in lines, f"missing golden line: {golden!r}"
+    types = _grammar_check(text)
+    assert types["tpuvsr_queue_wait_seconds"] == "histogram"
+    assert types["tpuvsr_run_seconds"] == "histogram"
+    assert types["tpuvsr_jobs_in_flight"] == "gauge"
+
+
+def test_prometheus_label_escaping_hostile_tenant(tmp_path):
+    hostile = 'we"ird\\te\nnant'
+    spool = _spool(tmp_path, tenant=hostile)
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    agg.poll()
+    text = prometheus_text(agg.snapshot())
+    # the raw newline never splits a sample line; the escaped form
+    # appears exactly per the exposition format
+    assert 'tenant="we\\"ird\\\\te\\nnant"' in text
+    _grammar_check(text)
+
+
+def test_prometheus_empty_fold_still_well_formed(tmp_path):
+    agg = TelemetryAggregator(str(tmp_path / "empty"),
+                              journal_breaches=False)
+    agg.poll()
+    text = prometheus_text(agg.snapshot())
+    _grammar_check(text)
+    assert "tpuvsr_events_total 0" in text.splitlines()
+
+
+# ---------------------------------------------------------------------
+# the SLO watchdog
+# ---------------------------------------------------------------------
+def test_watchdog_queue_wait_breach_journaled_and_deduped(tmp_path):
+    spool = _spool(tmp_path)
+    agg = TelemetryAggregator(spool, slo={"queue_wait_p99_s": 0.1})
+    agg.poll()
+    s = agg.snapshot()
+    assert s["counters"]["slo_breaches"] == 1
+    ev_path = os.path.join(spool, "telemetry", "events.jsonl")
+    with open(ev_path) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == 1
+    assert validate_journal_line(rows[0]) == "slo_breach"
+    assert rows[0]["what"] == "queue_wait_p99"
+    assert rows[0]["tenant"] == "acme"
+    assert rows[0]["value"] == 0.5 and rows[0]["target"] == 0.1
+    assert rows[0]["run_id"] == "telemetry"
+    # repolling never re-journals the same breach
+    agg.poll()
+    agg.poll()
+    with open(ev_path) as f:
+        assert sum(1 for _ in f) == 1
+    assert agg.snapshot()["counters"]["slo_breaches"] == 1
+    # a RESTARTED watchdog folds its predecessor's breach from the
+    # journal (counter convergent) and does not journal a duplicate
+    agg2 = TelemetryAggregator(spool, slo={"queue_wait_p99_s": 0.1})
+    agg2.poll()
+    assert agg2.snapshot()["counters"]["slo_breaches"] == 1
+    with open(ev_path) as f:
+        assert sum(1 for _ in f) == 1
+    assert "tpuvsr_slo_breach_total 1" in prometheus_text(
+        agg2.snapshot()).splitlines()
+
+
+def test_watchdog_throughput_stall_breaches_within_one_window(
+        tmp_path):
+    spool = str(tmp_path / "spool")
+    events = [
+        {"event": "run_start", "ts": 100.1, "run_id": "r1",
+         "schema": "tpuvsr-journal/1", "engine": "device",
+         "module": "M", "backend": "cpu", "resumed": False}]
+    # four healthy windows at 100 distinct/s, then a stall window at
+    # 1 distinct/s, then the clock moves on so the stall completes
+    for i, cum in enumerate((100, 200, 300, 400)):
+        events.append({"event": "level_done", "ts": 100.5 + i,
+                       "run_id": "r1", "depth": i + 1, "frontier": 1,
+                       "distinct": cum, "generated": cum,
+                       "elapsed_s": 0.5 + i})
+    events.append({"event": "level_done", "ts": 104.5, "run_id": "r1",
+                   "depth": 5, "frontier": 1, "distinct": 401,
+                   "generated": 401, "elapsed_s": 4.5})
+    events.append({"event": "worker_heartbeat", "ts": 106.5,
+                   "run_id": "svc", "job_id": "j", "worker": "w0"})
+    _write(os.path.join(spool, "journals", "j.jsonl"), events)
+    agg = TelemetryAggregator(spool, window_s=1.0)
+    agg.poll()
+    s = agg.snapshot()
+    assert s["counters"]["slo_breaches"] == 1
+    assert s["slo"]["baselines"]["device"] > 50.0
+    with open(os.path.join(spool, "telemetry", "events.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert rows[0]["what"] == "throughput"
+    assert rows[0]["engine"] == "device"
+    assert rows[0]["window"] == 104
+    assert rows[0]["value"] == 1.0
+    # the rolling baselines were published for other processes
+    with open(os.path.join(spool, "telemetry",
+                           "baselines.json")) as f:
+        doc = json.load(f)
+    assert doc["schema"] == TELEMETRY_SCHEMA
+    assert doc["engines"]["device"] > 50.0
+
+
+def test_watchdog_per_tenant_targets_and_star_default(tmp_path):
+    spool = _spool(tmp_path)                       # acme waits 0.5 s
+    story = _job_story(job_id="j0002-bbbb", tenant="beta", run_id="r2",
+                       trace_id="beadbeadbeadbead")
+    _write(os.path.join(spool, "journals", "j0002-bbbb.jsonl"), story)
+    agg = TelemetryAggregator(
+        spool, journal_breaches=False,
+        slo={"queue_wait_p99_s": {"acme": 10.0, "*": 0.1}})
+    agg.poll()
+    s = agg.snapshot()
+    # acme's generous target holds; beta falls to the "*" default
+    assert s["counters"]["slo_breaches"] == 1
+
+
+# ---------------------------------------------------------------------
+# fsync opt-in
+# ---------------------------------------------------------------------
+def test_journal_fsync_env_opt_in(tmp_path, monkeypatch):
+    p = str(tmp_path / "j.jsonl")
+    monkeypatch.delenv("TPUVSR_JOURNAL_FSYNC", raising=False)
+    assert Journal(p, run_id="x")._fsync is False
+    monkeypatch.setenv("TPUVSR_JOURNAL_FSYNC", "1")
+    j = Journal(p, run_id="x")
+    assert j._fsync is True
+    j.write("worker_heartbeat", job_id="j", worker="w0")
+    j.close()
+    with open(p) as f:
+        rows = [json.loads(line) for line in f]
+    assert rows[-1]["event"] == "worker_heartbeat"
+
+
+# ---------------------------------------------------------------------
+# exposition surfaces: CLI verb, status --json embed, HTTP endpoints
+# ---------------------------------------------------------------------
+def test_cli_telemetry_verb_json_and_prom(tmp_path, capsys):
+    from tpuvsr.service.api import main as api_main
+    spool = _spool(tmp_path)
+    assert api_main(["telemetry", spool, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == TELEMETRY_SCHEMA
+    assert doc["counters"]["jobs_submitted"] == 1
+    assert api_main(["telemetry", spool, "--prom"]) == 0
+    text = capsys.readouterr().out
+    _grammar_check(text)
+    assert "tpuvsr_jobs_submitted_total 1" in text.splitlines()
+    # default: the human watch screen, one shot
+    assert api_main(["telemetry", spool]) == 0
+    out = capsys.readouterr().out
+    assert "tpuvsr telemetry" in out and "acme" in out
+    # a nonexistent spool is a usage error, not a stack trace
+    assert api_main(["telemetry", str(tmp_path / "nope")]) == EX_USAGE
+
+
+def test_render_watch_screen(tmp_path):
+    spool = _spool(tmp_path)
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    agg.poll()
+    screen = render_watch(agg.snapshot())
+    assert "jobs: submitted=1" in screen
+    assert "acme" in screen and "w0" in screen
+    assert "slo_breaches=0" in screen
+
+
+def test_status_json_embeds_telemetry_snapshot(tmp_path, capsys):
+    from tpuvsr.service.api import main as api_main
+    from tpuvsr.service.queue import JobQueue
+    spool = str(tmp_path / "spool")
+    JobQueue(spool)  # create the spool layout
+    _write(os.path.join(spool, "journals", "j0001-aaaa.jsonl"),
+           _job_story())
+    assert api_main(["status", "--spool", spool, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["telemetry"]["schema"] == TELEMETRY_SCHEMA
+    assert doc["telemetry"]["counters"]["jobs_submitted"] == 1
+
+
+def _http_get(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    c.request("GET", path)
+    r = c.getresponse()
+    data = r.read().decode()
+    ctype = r.getheader("Content-Type")
+    c.close()
+    return r.status, ctype, data
+
+
+def test_http_metrics_and_telemetry_endpoints(tmp_path):
+    from tpuvsr.serve import ServiceHTTP
+    spool = _spool(tmp_path)
+    srv = ServiceHTTP(spool).start()
+    try:
+        st, ctype, body = _http_get(srv.port, "/v1/metrics")
+        assert st == 200
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        _grammar_check(body)
+        assert "tpuvsr_jobs_submitted_total 1" in body.splitlines()
+        st, ctype, body = _http_get(srv.port, "/v1/telemetry")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["schema"] == TELEMETRY_SCHEMA
+        assert doc["tenants"]["acme"]["queue_wait"]["count"] == 1
+        # live fold: new journal lines appear on the next scrape
+        _write(os.path.join(spool, "journals", "j0002-bbbb.jsonl"),
+               _job_story(job_id="j0002-bbbb", tenant="beta",
+                          run_id="r2", trace_id="beadbeadbeadbead"))
+        st, _ctype, body = _http_get(srv.port, "/v1/telemetry")
+        assert json.loads(body)["counters"]["jobs_submitted"] == 2
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+# compare_bench gate + bench embed wiring
+# ---------------------------------------------------------------------
+def test_compare_bench_gate_telemetry(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import compare_bench
+    # docs without a telemetry snapshot: the gate stays silent
+    assert compare_bench.gate_telemetry({}, {}, 10.0) == 0
+    # docs with one: the fold-determinism drill runs and passes
+    spool = _spool(tmp_path)
+    agg = TelemetryAggregator(spool, journal_breaches=False)
+    agg.poll()
+    doc = {"telemetry": agg.snapshot()}
+    assert compare_bench.gate_telemetry(doc, doc, 10.0) == 0
+    # and it rides main()'s gate chain end to end
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    m = {"schema": "tpuvsr-metrics/1", "run_id": "r", "engine": "d",
+         "elapsed_s": 1.0, "phases": {}, "counters": {},
+         "gauges": {"distinct_per_s": 100.0}, "levels": []}
+    base.write_text(json.dumps({"metrics": m, **doc}))
+    cand.write_text(json.dumps({"metrics": m, **doc}))
+    assert compare_bench.main([str(base), str(cand)]) == 0

@@ -839,7 +839,8 @@ def main(argv=None):
     # status|cancel ...` routes to tpuvsr/service/api.py before the
     # TLC-compatible parser ever sees the argv (a positional spec named
     # "serve" is implausible; use ./serve to check a file of that name)
-    if argv and argv[0] in ("serve", "submit", "status", "cancel"):
+    if argv and argv[0] in ("serve", "submit", "status", "cancel",
+                            "telemetry"):
         from ..service.api import main as service_main
         return service_main(argv)
     parser = build_parser()

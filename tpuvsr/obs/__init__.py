@@ -22,11 +22,15 @@ on every run.  Schemas are documented in ``SCHEMA.md``.
 from __future__ import annotations
 
 from .journal import (EVENT_REQUIRED, JOURNAL_SCHEMA, Journal,
-                      new_run_id, read_journal, validate_journal_line)
+                      new_run_id, new_span_id, new_trace_id,
+                      read_journal, root_span, trace_env, trace_scope,
+                      validate_journal_line)
 from .metrics import (LEVEL_ROW_KEYS, METRICS_SCHEMA, Metrics,
                       validate_metrics)
 from .observer import RunObserver, closes_observer
 from .profiler import annotate, profile_dir, profile_trace
+from .telemetry import (TELEMETRY_SCHEMA, TelemetryAggregator,
+                        prometheus_text)
 
 __all__ = [
     "RunObserver", "closes_observer", "Metrics", "Journal",
@@ -34,4 +38,7 @@ __all__ = [
     "LEVEL_ROW_KEYS", "new_run_id", "read_journal",
     "validate_journal_line", "validate_metrics",
     "annotate", "profile_dir", "profile_trace",
+    "new_trace_id", "new_span_id", "root_span", "trace_env",
+    "trace_scope",
+    "TELEMETRY_SCHEMA", "TelemetryAggregator", "prometheus_text",
 ]

@@ -22,6 +22,7 @@ is written, so:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -88,21 +89,116 @@ EVENT_REQUIRED = {
     # `divergence` is one trace's first spec-inconsistent event
     "validate_chunk": ("depth", "traces", "divergences", "elapsed_s"),
     "divergence": ("trace", "step", "elapsed_s"),
+    # fleet telemetry plane (ISSUE 17): the SLO watchdog inside the
+    # telemetry aggregator observed a headline gauge regress against
+    # its rolling baseline (or a tenant's p99 queue wait exceed its
+    # target) — `what` names the gauge, `value` the observed number,
+    # `target` the threshold it crossed
+    "slo_breach": ("what", "value", "target"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
+
+# Optional COMMON keys (ISSUE 17): any event may additionally carry
+# `trace_id` (one id for a whole job's story, minted at job_submitted),
+# `span_id` (this process segment), and `parent_span` (the segment that
+# spawned it).  They are deliberately NOT in EVENT_REQUIRED — journals
+# written before the telemetry plane stay valid — but every Journal
+# stamps them automatically when trace context is set (directly or via
+# the TPUVSR_TRACE_ID / TPUVSR_SPAN_ID / TPUVSR_PARENT_SPAN env vars a
+# worker exports around each engine run), so one correlation id
+# survives the service -> worker -> engine process hops.
+TRACE_KEYS = ("trace_id", "span_id", "parent_span")
 
 
 def new_run_id():
     return uuid.uuid4().hex[:12]
 
 
+def new_trace_id():
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id():
+    return uuid.uuid4().hex[:8]
+
+
+def root_span(trace_id):
+    """The deterministic service-level root span of a trace: every
+    process that touches the job (submitter, recoverer, worker) derives
+    the same root without coordination, so their events all land in one
+    span and the attempt spans parent onto it."""
+    return f"r{str(trace_id)[:8]}"
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id=None, span_id=None, parent_span=None):
+    """Export the trace env triple for the duration of a block (and
+    restore whatever was there afterwards) — how a worker hands its
+    attempt span down to the engine's RunObserver journal and to any
+    child process it launches.  Journals created inside the scope with
+    no explicit trace context inherit it, minting their own segment
+    span under ``parent_span``."""
+    keys = ("TPUVSR_TRACE_ID", "TPUVSR_SPAN_ID", "TPUVSR_PARENT_SPAN")
+    saved = {k: os.environ.get(k) for k in keys}
+    for k in keys:
+        os.environ.pop(k, None)
+    os.environ.update(trace_env(trace_id, span_id, parent_span))
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def trace_env(trace_id=None, span_id=None, parent_span=None):
+    """The env-var triple a parent exports so a child process's
+    journals inherit its trace context (None values are omitted)."""
+    env = {}
+    if trace_id:
+        env["TPUVSR_TRACE_ID"] = str(trace_id)
+    if span_id:
+        env["TPUVSR_SPAN_ID"] = str(span_id)
+    if parent_span:
+        env["TPUVSR_PARENT_SPAN"] = str(parent_span)
+    return env
+
+
 class Journal:
     """Append-only JSONL writer.  ``path=None`` makes every method a
     no-op so engines can call unconditionally."""
 
-    def __init__(self, path=None, run_id=None):
+    def __init__(self, path=None, run_id=None, trace_id=None,
+                 span_id=None, parent_span=None):
         self.path = path
         self.run_id = run_id or new_run_id()
+        # trace context (ISSUE 17): explicit args win, then the env
+        # triple a parent process exported, else no trace keys at all.
+        # Passing an explicit EMPTY string means "no trace context" and
+        # suppresses the env fallback (a multi-threaded worker's
+        # journal writes must not inherit a sibling job's exported
+        # scope)
+        def _ctx(explicit, envkey):
+            if explicit is not None:
+                return explicit or None
+            return os.environ.get(envkey)
+        self.trace_id = _ctx(trace_id, "TPUVSR_TRACE_ID")
+        self.span_id = _ctx(span_id, "TPUVSR_SPAN_ID")
+        self.parent_span = _ctx(parent_span, "TPUVSR_PARENT_SPAN")
+        if self.span_id is None and self.trace_id is not None:
+            # a traced journal with no named span is its OWN segment
+            # (an engine run inside a worker's trace_scope): mint a
+            # fresh span under parent_span, so each attempt/retry
+            # segment is distinguishable in the span tree
+            self.span_id = new_span_id()
+        # opt-in crash consistency: fsync after every event so even a
+        # SIGKILL mid-write never leaves a torn LAST line for a tailing
+        # aggregator (the flush-per-event default already guarantees a
+        # valid prefix on clean-ish deaths; fsync closes the page-cache
+        # window at a per-event latency cost)
+        self._fsync = os.environ.get("TPUVSR_JOURNAL_FSYNC") == "1"
         self._fh = None
         if path:
             d = os.path.dirname(os.path.abspath(path))
@@ -125,10 +221,18 @@ class Journal:
             return None
         rec = {"event": event, "ts": round(time.time(), 3),
                "run_id": self.run_id}
+        if self.trace_id:
+            rec["trace_id"] = self.trace_id
+        if self.span_id:
+            rec["span_id"] = self.span_id
+        if self.parent_span:
+            rec["parent_span"] = self.parent_span
         rec.update(fields)
         self._fh.write(json.dumps(rec, sort_keys=True,
                                   default=str) + "\n")
         self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
         return rec
 
     def close(self):

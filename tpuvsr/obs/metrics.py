@@ -120,9 +120,39 @@ class Metrics:
         return out
 
 
-def validate_metrics(doc):
+#: optional result-summary keys a metrics doc may carry, and the type
+#: check each must pass WHEN PRESENT (``validate_metrics(strict=True)``,
+#: ISSUE 17 satellite — the default mode keeps ignoring them, so old
+#: callers and old documents are untouched).  None is always legal
+#: (an aborted run reports what it has).
+OPTIONAL_RESULT_KEYS = {
+    "ok": lambda v: isinstance(v, bool),
+    "distinct": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "generated": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "diameter": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "walks": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "steps": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "traces": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "divergences": lambda v: isinstance(v, int) and not isinstance(
+        v, bool) and v >= 0,
+    "violated": lambda v: isinstance(v, str),
+    "error": lambda v: isinstance(v, str),
+}
+
+
+def validate_metrics(doc, strict=False):
     """Raise ValueError unless `doc` is a schema-valid
-    ``tpuvsr-metrics/1`` document.  Returns the doc."""
+    ``tpuvsr-metrics/1`` document.  Returns the doc.
+
+    ``strict=True`` additionally type-checks the OPTIONAL
+    result-summary keys when present (``OPTIONAL_RESULT_KEYS``) —
+    the default mode ignores them entirely, as it always has."""
     if not isinstance(doc, dict):
         raise ValueError(f"metrics document is {type(doc).__name__}, "
                          f"not an object")
@@ -147,4 +177,17 @@ def validate_metrics(doc):
         missing = [k for k in LEVEL_ROW_KEYS if k not in row]
         if missing:
             raise ValueError(f"level row {i} missing keys: {missing}")
+    if strict:
+        if not isinstance(doc["elapsed_s"], (int, float)) \
+                or isinstance(doc["elapsed_s"], bool) \
+                or doc["elapsed_s"] < 0:
+            raise ValueError(f"elapsed_s must be a non-negative "
+                             f"number, got {doc['elapsed_s']!r}")
+        for key, check in OPTIONAL_RESULT_KEYS.items():
+            if key not in doc or doc[key] is None:
+                continue
+            if not check(doc[key]):
+                raise ValueError(
+                    f"optional result key {key} has ill-typed value "
+                    f"{doc[key]!r}")
     return doc

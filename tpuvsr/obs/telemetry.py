@@ -1,0 +1,818 @@
+"""Fleet telemetry plane (ISSUE 17): streamed journal aggregation.
+
+``TelemetryAggregator`` tails every journal a spool holds —
+``journals/*.jsonl`` (per-job stories: job_* lifecycle events
+interleaved with each attempt's engine events), ``pool.jsonl`` (the
+worker-pool parent's respawn trail), and its own
+``telemetry/events.jsonl`` — with **bounded memory**: per-file byte
+offsets, accumulated histogram bucket counters, a bounded ring of
+recent windows, and per-job/per-run pending state pruned at terminal
+events.  No journal is ever retained whole.
+
+The fold is a **pure function of the journal contents**: every window
+is keyed on the event's own ``ts`` (``floor(ts / window_s)``), the
+fold clock is the max ``ts`` seen, and nothing reads the wall clock —
+so two aggregators over the same journals produce the identical
+snapshot (``scripts/compare_bench.py gate_telemetry`` holds this), and
+a restarted aggregator reconverges to the same fold by re-tailing from
+offset zero.
+
+What it folds:
+
+* per-tenant **queue-wait** and **run-time** log-bucket histograms
+  (p50/p95/p99 read off the bucket bounds);
+* **DRR fairness**: per-tenant sched_decision deficits/weights beside
+  the ACTUAL device-seconds consumed — the "did the fair share happen"
+  view;
+* **worker utilization** (busy-seconds over lifetime) and pool
+  **respawn** counts;
+* fleet-wide ``distinct_per_s`` / ``walks_per_s`` / ``traces_per_s``
+  per window (deltas of the engines' cumulative level/chunk counters);
+* **fault / degrade / retry / requeue** rates per window.
+
+The **SLO watchdog** rides the same fold: rolling per-engine baselines
+of the headline throughput gauges (EMA over complete windows,
+published to ``<spool>/telemetry/baselines.json``) and per-tenant p99
+queue-wait targets.  A regression journals a schema-valid
+``slo_breach`` event to ``<spool>/telemetry/events.jsonl`` — which the
+aggregator itself tails, so the breach counter
+(``tpuvsr_slo_breach_total``) is journal-derived: deterministic,
+restart-convergent, and deduplicated (a restarted watchdog sees its
+own past breaches and never re-journals them).
+
+Exposition: ``snapshot()`` is the ``tpuvsr-telemetry/1`` JSON document
+(SCHEMA.md), ``prometheus_text(snapshot)`` renders it in Prometheus
+text exposition format 0.0.4 — both served by the HTTP front
+(``GET /v1/telemetry`` / ``GET /v1/metrics``), the ``tpuvsr telemetry``
+CLI verb, and embedded in ``status --json``.
+
+This module imports neither jax nor the engines: the telemetry verb
+and the HTTP front stay milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+
+from .journal import Journal
+
+TELEMETRY_SCHEMA = "tpuvsr-telemetry/1"
+
+#: log-bucket upper bounds (seconds) for the latency histograms —
+#: roughly x2.5 steps from 5 ms to ~17 min, + the implicit +Inf
+BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+           5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0)
+
+#: default EMA smoothing for the per-engine throughput baselines
+BASELINE_ALPHA = 0.3
+#: a complete window's throughput below this fraction of the baseline
+#: (while jobs of that engine were running) is an SLO breach
+THROUGHPUT_DROP_RATIO = 0.5
+
+
+class Histogram:
+    """Fixed log-bucket histogram: O(len(BUCKETS)) memory however many
+    observations fold in.  Bucket counts are NON-cumulative here; the
+    Prometheus renderer accumulates them (`le` buckets are cumulative
+    on the wire)."""
+
+    __slots__ = ("counts", "inf", "total", "sum")
+
+    def __init__(self):
+        self.counts = [0] * len(BUCKETS)
+        self.inf = 0
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v):
+        v = max(0.0, float(v))
+        self.total += 1
+        self.sum += v
+        for i, le in enumerate(BUCKETS):
+            if v <= le:
+                self.counts[i] += 1
+                return
+        self.inf += 1
+
+    def quantile(self, q):
+        """The upper bound of the bucket holding quantile ``q`` —
+        None when empty, +inf when it lands in the overflow bucket."""
+        if not self.total:
+            return None
+        need = math.ceil(q * self.total)
+        cum = 0
+        for i, le in enumerate(BUCKETS):
+            cum += self.counts[i]
+            if cum >= need:
+                return le
+        return math.inf
+
+    def to_dict(self):
+        def fin(x):
+            return None if x is None or math.isinf(x) else x
+        return {"buckets": list(self.counts), "inf": self.inf,
+                "count": self.total, "sum": round(self.sum, 6),
+                "p50": fin(self.quantile(0.50)),
+                "p95": fin(self.quantile(0.95)),
+                "p99": fin(self.quantile(0.99))}
+
+
+def _tenant(t):
+    """Label form of a tenant (None = the anonymous CLI tenant)."""
+    return t if t else "anon"
+
+
+class TelemetryAggregator:
+    """Streamed fold over one spool's journals (see module doc).
+
+    ``poll()`` ingests every complete new line since the last call and
+    returns the number of events folded; ``snapshot()`` renders the
+    current fold.  Thread-safe: the HTTP front's handler threads share
+    one instance.
+
+    ``slo`` configures the watchdog (all optional):
+      ``queue_wait_p99_s`` — float, or {tenant: float} with ``"*"`` as
+        the default — breach when a tenant's p99 queue wait exceeds it;
+      ``throughput_drop_ratio`` — breach when a complete window's
+        per-engine throughput falls below this fraction of the rolling
+        baseline while that engine had running jobs (default 0.5);
+      ``min_baseline`` — baselines below this never trip (default 1.0,
+        units of the engine's headline counter per second).
+    ``journal_breaches=False`` folds without ever writing (the
+    restart-reconvergence / determinism drills compare pure folds).
+    """
+
+    def __init__(self, spool, *, window_s=10.0, max_windows=64,
+                 slo=None, journal_breaches=True):
+        self.spool = os.path.abspath(spool)
+        self.journals_dir = os.path.join(self.spool, "journals")
+        self.pool_journal = os.path.join(self.spool, "pool.jsonl")
+        self.telemetry_dir = os.path.join(self.spool, "telemetry")
+        self.events_path = os.path.join(self.telemetry_dir,
+                                        "events.jsonl")
+        self.baselines_path = os.path.join(self.telemetry_dir,
+                                           "baselines.json")
+        self.window_s = float(window_s)
+        self.max_windows = int(max_windows)
+        self.slo = dict(slo or {})
+        self.journal_breaches = journal_breaches
+        self._lock = threading.Lock()
+
+        # -- bounded fold state ---------------------------------------
+        self._offsets = {}       # path -> consumed byte offset
+        self._max_ts = 0.0       # the fold clock (never wall time)
+        self._events = 0
+        self._counters = {
+            "jobs_submitted": 0, "sched_decisions": 0,
+            "faults": 0, "retries": 0, "degrades": 0,
+            "requeues": 0, "violations": 0, "worker_respawns": 0,
+            "slo_breaches": 0,
+        }
+        self._jobs_by_state = {}     # terminal state -> count
+        self._tenants = {}           # tenant -> fold dict
+        self._workers = {}           # worker -> fold dict
+        self._pending = {}           # job_id -> in-flight lifecycle
+        self._runs = {}              # run_id -> engine-run progress
+        self._windows = {}           # wkey -> per-window deltas
+        self._baselines = {}         # engine -> EMA of headline rate
+        self._evaluated_wkey = None  # watchdog high-water mark
+        self._breached = set()       # breach keys already journaled
+
+    # -- tenant / worker / window cells -------------------------------
+    def _tenant_cell(self, tenant):
+        t = _tenant(tenant)
+        cell = self._tenants.get(t)
+        if cell is None:
+            cell = self._tenants[t] = {
+                "queue_wait": Histogram(), "run_time": Histogram(),
+                "sched_decisions": 0, "device_s": 0.0,
+                "weight": None, "deficit": None,
+                "jobs_done": 0, "violations": 0}
+        return cell
+
+    def _worker_cell(self, worker, ts):
+        cell = self._workers.get(worker)
+        if cell is None:
+            cell = self._workers[worker] = {
+                "jobs": 0, "busy_s": 0.0, "respawns": 0,
+                "first_ts": ts, "last_ts": ts}
+        cell["last_ts"] = max(cell["last_ts"], ts)
+        return cell
+
+    def _window(self, ts):
+        wkey = int(ts // self.window_s)
+        w = self._windows.get(wkey)
+        if w is None:
+            w = self._windows[wkey] = {
+                "distinct": 0, "generated": 0, "walks": 0,
+                "traces": 0, "faults": 0, "retries": 0,
+                "degrades": 0, "requeues": 0, "events": 0,
+                "by_engine": {}}
+            # bound the ring: drop windows older than the horizon
+            floor = wkey - self.max_windows
+            for k in [k for k in self._windows if k < floor]:
+                del self._windows[k]
+        return w
+
+    # -- tailing ------------------------------------------------------
+    def _tail(self, path):
+        """Yield the complete new lines of one journal since the last
+        poll.  A torn final line (a writer killed mid-append, or one
+        we raced) is held back until it is completed — the same
+        discipline as ``JobQueue.refresh``."""
+        pos = self._offsets.get(path, 0)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size <= pos:
+            return
+        try:
+            with open(path) as f:
+                f.seek(pos)
+                while True:
+                    line = f.readline()
+                    if not line or not line.endswith("\n"):
+                        break
+                    self._offsets[path] = f.tell()
+                    line = line.strip()
+                    if line:
+                        yield line
+        except OSError:
+            return
+
+    def poll(self):
+        """Ingest every complete new journal line; returns the number
+        of events folded this call."""
+        with self._lock:
+            n = 0
+            try:
+                names = sorted(os.listdir(self.journals_dir))
+            except OSError:
+                names = []
+            for name in names:
+                if not name.endswith(".jsonl"):
+                    continue
+                path = os.path.join(self.journals_dir, name)
+                for line in self._tail(path):
+                    n += self._fold_line(line)
+            for line in self._tail(self.pool_journal):
+                n += self._fold_line(line)
+            # our own breach journal last: a breach written THIS poll
+            # is picked up by the NEXT (the counter stays
+            # journal-derived either way)
+            for line in self._tail(self.events_path):
+                n += self._fold_line(line)
+            self._prune()
+            self._watchdog()
+            return n
+
+    # -- the fold ------------------------------------------------------
+    def _fold_line(self, line):
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            return 0
+        if not isinstance(ev, dict) or "event" not in ev \
+                or "ts" not in ev:
+            return 0
+        try:
+            ts = float(ev["ts"])
+        except (TypeError, ValueError):
+            return 0
+        self._max_ts = max(self._max_ts, ts)
+        self._events += 1
+        w = self._window(ts)
+        w["events"] += 1
+        kind = ev["event"]
+        fold = getattr(self, f"_on_{kind}", None)
+        if fold is not None:
+            try:
+                fold(ev, ts, w)
+            except (KeyError, TypeError, ValueError):
+                pass             # a malformed event folds as noise
+        return 1
+
+    # each handler folds ONE event kind; unknown kinds only count
+    def _on_job_submitted(self, ev, ts, w):
+        self._counters["jobs_submitted"] += 1
+        self._pending[ev["job_id"]] = {
+            "tenant": ev.get("tenant"), "engine": ev.get("engine"),
+            "queued_ts": ts, "started_ts": None, "devices": 0,
+            "worker": None, "last_ts": ts}
+
+    def _on_job_requeued(self, ev, ts, w):
+        self._counters["requeues"] += 1
+        w["requeues"] += 1
+        p = self._pending.get(ev["job_id"])
+        if p:
+            self._close_attempt(p, ts)
+            p["queued_ts"] = ts      # the next wait starts here
+            p["started_ts"] = None
+            p["last_ts"] = ts
+
+    def _on_job_started(self, ev, ts, w):
+        p = self._pending.get(ev["job_id"])
+        if p is None:
+            p = self._pending[ev["job_id"]] = {
+                "tenant": None, "engine": None, "queued_ts": None,
+                "started_ts": None, "devices": 0, "worker": None,
+                "last_ts": ts}
+        if p.get("queued_ts") is not None:
+            self._tenant_cell(p.get("tenant"))["queue_wait"].observe(
+                ts - p["queued_ts"])
+        p["started_ts"] = ts
+        p["devices"] = int(ev.get("devices") or 0)
+        p["last_ts"] = ts
+
+    def _on_sched_decision(self, ev, ts, w):
+        self._counters["sched_decisions"] += 1
+        cell = self._tenant_cell(ev.get("tenant"))
+        cell["sched_decisions"] += 1
+        if ev.get("weight") is not None:
+            cell["weight"] = ev["weight"]
+        if ev.get("deficit") is not None:
+            cell["deficit"] = ev["deficit"]
+        worker = ev.get("worker")
+        if worker:
+            wc = self._worker_cell(worker, ts)
+            wc["jobs"] += 1
+            p = self._pending.get(ev.get("job_id"))
+            if p:
+                p["worker"] = worker
+
+    def _on_worker_heartbeat(self, ev, ts, w):
+        if ev.get("worker"):
+            self._worker_cell(ev["worker"], ts)
+
+    def _on_worker_respawn(self, ev, ts, w):
+        self._counters["worker_respawns"] += 1
+        if ev.get("worker") is not None:
+            self._worker_cell(str(ev["worker"]), ts)["respawns"] += 1
+
+    def _on_job_done(self, ev, ts, w):
+        state = ev.get("state") or "done"
+        self._jobs_by_state[state] = \
+            self._jobs_by_state.get(state, 0) + 1
+        p = self._pending.pop(ev["job_id"], None)
+        if p:
+            cell = self._tenant_cell(p.get("tenant"))
+            cell["jobs_done"] += 1
+            if state == "violated":
+                cell["violations"] += 1
+            self._close_attempt(p, ts)
+
+    def _close_attempt(self, p, ts):
+        """Fold one finished attempt's run time, device-seconds and
+        worker busy time."""
+        t0 = p.get("started_ts")
+        if t0 is None:
+            return
+        dur = max(0.0, ts - t0)
+        cell = self._tenant_cell(p.get("tenant"))
+        cell["run_time"].observe(dur)
+        cell["device_s"] += dur * max(0, p.get("devices") or 0)
+        if p.get("worker"):
+            self._worker_cell(p["worker"], ts)["busy_s"] += dur
+
+    # engine-run progress: deltas of cumulative per-run counters
+    def _run_cell(self, ev, ts):
+        rid = ev.get("run_id") or "?"
+        r = self._runs.get(rid)
+        if r is None:
+            r = self._runs[rid] = {"engine": None, "distinct": 0,
+                                   "generated": 0, "walks": 0,
+                                   "traces": 0, "last_ts": ts}
+        r["last_ts"] = max(r["last_ts"], ts)
+        return r
+
+    def _on_run_start(self, ev, ts, w):
+        r = self._run_cell(ev, ts)
+        r["engine"] = ev.get("engine")
+
+    def _delta(self, r, key, now):
+        try:
+            now = int(now)
+        except (TypeError, ValueError):
+            return 0
+        d = now - r[key]
+        if d < 0:               # a resumed run rewound its counters
+            d = 0
+        r[key] = max(r[key], now)
+        return d
+
+    def _bump_engine(self, w, engine, key, d):
+        if not d:
+            return
+        e = w["by_engine"].setdefault(engine or "?",
+                                      {"distinct": 0, "walks": 0,
+                                       "traces": 0})
+        e[key] += d
+
+    def _on_level_done(self, ev, ts, w):
+        r = self._run_cell(ev, ts)
+        d = self._delta(r, "distinct", ev.get("distinct"))
+        g = self._delta(r, "generated", ev.get("generated"))
+        w["distinct"] += d
+        w["generated"] += g
+        self._bump_engine(w, r["engine"], "distinct", d)
+
+    def _on_sim_chunk(self, ev, ts, w):
+        r = self._run_cell(ev, ts)
+        d = self._delta(r, "walks", ev.get("walks"))
+        w["walks"] += d
+        self._bump_engine(w, r["engine"], "walks", d)
+
+    def _on_validate_chunk(self, ev, ts, w):
+        r = self._run_cell(ev, ts)
+        d = self._delta(r, "traces", ev.get("traces"))
+        w["traces"] += d
+        self._bump_engine(w, r["engine"], "traces", d)
+
+    def _on_run_end(self, ev, ts, w):
+        self._runs.pop(ev.get("run_id"), None)
+
+    def _on_fault(self, ev, ts, w):
+        self._counters["faults"] += 1
+        w["faults"] += 1
+
+    def _on_retry(self, ev, ts, w):
+        self._counters["retries"] += 1
+        w["retries"] += 1
+
+    def _on_degrade(self, ev, ts, w):
+        self._counters["degrades"] += 1
+        w["degrades"] += 1
+
+    def _on_violation(self, ev, ts, w):
+        self._counters["violations"] += 1
+
+    def _on_hunt_violation(self, ev, ts, w):
+        self._counters["violations"] += 1
+
+    def _on_slo_breach(self, ev, ts, w):
+        self._counters["slo_breaches"] += 1
+        self._breached.add((ev.get("what"), ev.get("tenant"),
+                            ev.get("engine"), ev.get("window")))
+
+    def _prune(self):
+        """Bounded memory: drop pending jobs and engine-run cells not
+        touched inside the window horizon (measured on the FOLD clock,
+        so pruning is as deterministic as the fold)."""
+        horizon = self._max_ts - self.window_s * self.max_windows
+        for jid in [j for j, p in self._pending.items()
+                    if p.get("last_ts", 0) < horizon]:
+            del self._pending[jid]
+        for rid in [r for r, c in self._runs.items()
+                    if c.get("last_ts", 0) < horizon]:
+            del self._runs[rid]
+
+    # -- the SLO watchdog ----------------------------------------------
+    def _breach(self, what, value, target, **extra):
+        key = (what, extra.get("tenant"), extra.get("engine"),
+               extra.get("window"))
+        if key in self._breached:
+            return
+        self._breached.add(key)
+        self._counters["slo_breaches"] += 1
+        if not self.journal_breaches:
+            return
+        os.makedirs(self.telemetry_dir, exist_ok=True)
+        j = Journal(self.events_path, run_id="telemetry",
+                    trace_id="", span_id="", parent_span="")
+        try:
+            j.write("slo_breach", what=what, value=value,
+                    target=target, **extra)
+        finally:
+            j.close()
+        # our own append is already folded (the counter bump above):
+        # skip it when the events journal is next tailed
+        try:
+            self._offsets[self.events_path] = \
+                os.path.getsize(self.events_path)
+        except OSError:
+            pass
+
+    def _queue_wait_target(self, tenant):
+        cfg = self.slo.get("queue_wait_p99_s")
+        if cfg is None:
+            return None
+        if isinstance(cfg, dict):
+            t = cfg.get(_tenant(tenant), cfg.get("*"))
+            return None if t is None else float(t)
+        return float(cfg)
+
+    def _watchdog(self):
+        if not self._max_ts:
+            return
+        # per-tenant p99 queue wait vs the configured target
+        for t, cell in self._tenants.items():
+            target = self._queue_wait_target(t)
+            if target is None:
+                continue
+            p99 = cell["queue_wait"].quantile(0.99)
+            if p99 is not None and p99 > target:
+                self._breach("queue_wait_p99", value=p99,
+                             target=target, tenant=t)
+        # per-engine throughput vs the rolling baseline, evaluated
+        # once per COMPLETE window (the current window is still
+        # filling and would always read low)
+        cur = int(self._max_ts // self.window_s)
+        ratio = float(self.slo.get("throughput_drop_ratio",
+                                   THROUGHPUT_DROP_RATIO))
+        floor = float(self.slo.get("min_baseline", 1.0))
+        start = (self._evaluated_wkey + 1
+                 if self._evaluated_wkey is not None
+                 else min(self._windows, default=cur))
+        for wkey in range(start, cur):
+            w = self._windows.get(wkey)
+            self._evaluated_wkey = wkey
+            if w is None:
+                continue
+            for engine, prog in w["by_engine"].items():
+                rate = (prog["distinct"] + prog["walks"]
+                        + prog["traces"]) / self.window_s
+                base = self._baselines.get(engine)
+                if base is not None and base >= floor \
+                        and rate < base * ratio:
+                    self._breach("throughput", value=round(rate, 3),
+                                 target=round(base * ratio, 3),
+                                 engine=engine, window=wkey)
+                if rate > 0:
+                    self._baselines[engine] = (
+                        rate if base is None else
+                        (1 - BASELINE_ALPHA) * base
+                        + BASELINE_ALPHA * rate)
+        if self.journal_breaches and self._baselines:
+            self._publish_baselines()
+
+    def _publish_baselines(self):
+        """Write the rolling baselines where other processes can read
+        them.  Publish-only: a restarted aggregator RECOMPUTES from
+        the journals (never loads this file), which is what makes the
+        fold restart-convergent."""
+        os.makedirs(self.telemetry_dir, exist_ok=True)
+        doc = {"schema": TELEMETRY_SCHEMA, "window_s": self.window_s,
+               "as_of_ts": self._max_ts,
+               "engines": {k: round(v, 3)
+                           for k, v in sorted(self._baselines.items())}}
+        tmp = self.baselines_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, self.baselines_path)
+
+    # -- exposition ----------------------------------------------------
+    def snapshot(self):
+        """The ``tpuvsr-telemetry/1`` fold document.  Deterministic:
+        a pure function of the journal bytes ingested so far (no wall
+        clock — ``as_of_ts`` is the max event ts)."""
+        with self._lock:
+            cur = (int(self._max_ts // self.window_s)
+                   if self._max_ts else 0)
+            last = self._windows.get(cur - 1)
+            rates = {"distinct_per_s": 0.0, "walks_per_s": 0.0,
+                     "traces_per_s": 0.0, "faults_per_s": 0.0,
+                     "requeues_per_s": 0.0}
+            if last:
+                rates = {
+                    "distinct_per_s": last["distinct"] / self.window_s,
+                    "walks_per_s": last["walks"] / self.window_s,
+                    "traces_per_s": last["traces"] / self.window_s,
+                    "faults_per_s": last["faults"] / self.window_s,
+                    "requeues_per_s": last["requeues"] / self.window_s,
+                }
+            windows = []
+            for wkey in sorted(self._windows):
+                w = self._windows[wkey]
+                row = {"window": wkey,
+                       "ts0": wkey * self.window_s}
+                row.update({k: w[k] for k in (
+                    "distinct", "generated", "walks", "traces",
+                    "faults", "retries", "degrades", "requeues",
+                    "events")})
+                windows.append(row)
+            tenants = {}
+            for t in sorted(self._tenants):
+                cell = self._tenants[t]
+                tenants[t] = {
+                    "queue_wait": cell["queue_wait"].to_dict(),
+                    "run_time": cell["run_time"].to_dict(),
+                    "sched_decisions": cell["sched_decisions"],
+                    "device_s": round(cell["device_s"], 3),
+                    "weight": cell["weight"],
+                    "deficit": cell["deficit"],
+                    "jobs_done": cell["jobs_done"],
+                    "violations": cell["violations"]}
+            total_dev = sum(c["device_s"]
+                            for c in self._tenants.values()) or None
+            for t, doc in tenants.items():
+                doc["device_share"] = (
+                    round(doc["device_s"] / total_dev, 4)
+                    if total_dev else None)
+            workers = {}
+            for name in sorted(self._workers):
+                c = self._workers[name]
+                life = max(0.0, c["last_ts"] - c["first_ts"])
+                workers[name] = {
+                    "jobs": c["jobs"], "busy_s": round(c["busy_s"], 3),
+                    "respawns": c["respawns"],
+                    "first_ts": c["first_ts"],
+                    "last_ts": c["last_ts"],
+                    "utilization": (round(c["busy_s"] / life, 4)
+                                    if life > 0 else None)}
+            return {
+                "schema": TELEMETRY_SCHEMA,
+                "window_s": self.window_s,
+                "as_of_ts": self._max_ts,
+                "events": self._events,
+                "counters": dict(self._counters),
+                "jobs_by_state": dict(sorted(
+                    self._jobs_by_state.items())),
+                "in_flight": len(self._pending),
+                "tenants": tenants,
+                "workers": workers,
+                "rates": {k: round(v, 3) for k, v in rates.items()},
+                "windows": windows,
+                "slo": {"breaches": self._counters["slo_breaches"],
+                        "baselines": {k: round(v, 3) for k, v in
+                                      sorted(self._baselines.items())},
+                        "config": self.slo or None},
+            }
+
+
+# -- Prometheus text exposition format 0.0.4 --------------------------
+
+def _esc(v):
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _num(v):
+    if v is None:
+        return "NaN"
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _hist_lines(out, name, help_, label_key, cells):
+    out.append(f"# HELP {name} {help_}")
+    out.append(f"# TYPE {name} histogram")
+    for label, h in cells:
+        lbl = f'{label_key}="{_esc(label)}"'
+        cum = 0
+        for i, le in enumerate(BUCKETS):
+            cum += h["buckets"][i]
+            out.append(f'{name}_bucket{{{lbl},le="{_num(le)}"}} {cum}')
+        cum += h["inf"]
+        out.append(f'{name}_bucket{{{lbl},le="+Inf"}} {cum}')
+        out.append(f'{name}_sum{{{lbl}}} {_num(h["sum"])}')
+        out.append(f'{name}_count{{{lbl}}} {h["count"]}')
+
+
+def prometheus_text(snap):
+    """Render a :meth:`TelemetryAggregator.snapshot` document in
+    Prometheus text exposition format 0.0.4."""
+    out = []
+
+    def metric(name, mtype, help_, samples):
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if labels:
+                body = ",".join(f'{k}="{_esc(v)}"'
+                                for k, v in labels)
+                out.append(f"{name}{{{body}}} {_num(value)}")
+            else:
+                out.append(f"{name} {_num(value)}")
+
+    c = snap["counters"]
+    metric("tpuvsr_events_total", "counter",
+           "Journal events folded by the telemetry aggregator.",
+           [((), snap["events"])])
+    metric("tpuvsr_jobs_submitted_total", "counter",
+           "Jobs submitted to the spool.",
+           [((), c["jobs_submitted"])])
+    metric("tpuvsr_jobs_total", "counter",
+           "Jobs finished, by terminal state.",
+           [((("state", s),), n)
+            for s, n in snap["jobs_by_state"].items()] or
+           [((("state", "done"),), 0)])
+    metric("tpuvsr_jobs_in_flight", "gauge",
+           "Jobs submitted but not yet terminal in the fold.",
+           [((), snap["in_flight"])])
+    for key, help_ in (
+            ("sched_decisions", "Fair-share pop decisions."),
+            ("faults", "Injected or real faults observed."),
+            ("retries", "Supervised retry attempts."),
+            ("degrades", "Supervised degrade steps."),
+            ("requeues", "Preempt/requeue transitions."),
+            ("violations", "Invariant/liveness violations observed."),
+            ("worker_respawns", "Dead workers respawned by the pool.")):
+        metric(f"tpuvsr_{key}_total", "counter", help_,
+               [((), c[key])])
+    metric("tpuvsr_slo_breach_total", "counter",
+           "SLO watchdog breaches journaled.",
+           [((), c["slo_breaches"])])
+    for key, help_ in (
+            ("distinct_per_s",
+             "Fleet distinct states/s over the last complete window."),
+            ("walks_per_s",
+             "Fleet random walks/s over the last complete window."),
+            ("traces_per_s",
+             "Fleet validated traces/s over the last complete "
+             "window.")):
+        metric(f"tpuvsr_{key}", "gauge", help_,
+               [((), snap["rates"][key])])
+    tenants = snap["tenants"]
+    if tenants:
+        _hist_lines(out, "tpuvsr_queue_wait_seconds",
+                    "Queue wait per tenant (submit/requeue to start).",
+                    "tenant",
+                    [(t, d["queue_wait"])
+                     for t, d in tenants.items()])
+        _hist_lines(out, "tpuvsr_run_seconds",
+                    "Attempt run time per tenant (start to settle).",
+                    "tenant",
+                    [(t, d["run_time"]) for t, d in tenants.items()])
+        metric("tpuvsr_tenant_device_seconds_total", "counter",
+               "Device-seconds consumed per tenant.",
+               [((("tenant", t),), d["device_s"])
+                for t, d in tenants.items()])
+        metric("tpuvsr_tenant_weight", "gauge",
+               "Fair-share weight last seen per tenant.",
+               [((("tenant", t),), d["weight"])
+                for t, d in tenants.items()
+                if d["weight"] is not None])
+        metric("tpuvsr_tenant_deficit", "gauge",
+               "DRR deficit last seen per tenant.",
+               [((("tenant", t),), d["deficit"])
+                for t, d in tenants.items()
+                if d["deficit"] is not None])
+    workers = snap["workers"]
+    if workers:
+        metric("tpuvsr_worker_busy_seconds_total", "counter",
+               "Seconds each worker spent running attempts.",
+               [((("worker", w),), d["busy_s"])
+                for w, d in workers.items()])
+        metric("tpuvsr_worker_jobs_total", "counter",
+               "Jobs claimed per worker.",
+               [((("worker", w),), d["jobs"])
+                for w, d in workers.items()])
+        metric("tpuvsr_worker_respawns_total", "counter",
+               "Respawns per worker slot.",
+               [((("worker", w),), d["respawns"])
+                for w, d in workers.items()])
+    return "\n".join(out) + "\n"
+
+
+def render_watch(snap):
+    """One human-readable screenful of a snapshot — the body of
+    ``tpuvsr telemetry --watch``."""
+    lines = []
+    c = snap["counters"]
+    lines.append(f"tpuvsr telemetry  (window {snap['window_s']:g}s, "
+                 f"{snap['events']} events folded, as of ts "
+                 f"{snap['as_of_ts']:.1f})")
+    states = " ".join(f"{s}={n}" for s, n in
+                      snap["jobs_by_state"].items()) or "-"
+    lines.append(f"jobs: submitted={c['jobs_submitted']} "
+                 f"in-flight={snap['in_flight']}  terminal: {states}")
+    r = snap["rates"]
+    lines.append(f"fleet: {r['distinct_per_s']:g} distinct/s  "
+                 f"{r['walks_per_s']:g} walks/s  "
+                 f"{r['traces_per_s']:g} traces/s")
+    lines.append(f"resilience: faults={c['faults']} "
+                 f"retries={c['retries']} degrades={c['degrades']} "
+                 f"requeues={c['requeues']} "
+                 f"respawns={c['worker_respawns']}  "
+                 f"slo_breaches={c['slo_breaches']}")
+    if snap["tenants"]:
+        lines.append("tenant        wait_p50   wait_p99    run_p50  "
+                     "dev_s   share  decisions")
+        for t, d in snap["tenants"].items():
+            qw, rt = d["queue_wait"], d["run_time"]
+
+            def q(v):
+                return "-" if v is None else f"{v:g}s"
+            share = ("-" if d["device_share"] is None
+                     else f"{d['device_share']:.0%}")
+            lines.append(
+                f"{t:<12}  {q(qw['p50']):>8}   {q(qw['p99']):>8} "
+                f"  {q(rt['p50']):>8}  {d['device_s']:>5.1f}  "
+                f"{share:>6}  {d['sched_decisions']:>9}")
+    if snap["workers"]:
+        lines.append("worker            jobs   busy_s   util  "
+                     "respawns")
+        for w, d in snap["workers"].items():
+            util = ("-" if d["utilization"] is None
+                    else f"{d['utilization']:.0%}")
+            lines.append(f"{w:<16}  {d['jobs']:>4}  "
+                         f"{d['busy_s']:>7.1f}  {util:>5}  "
+                         f"{d['respawns']:>8}")
+    return "\n".join(lines)

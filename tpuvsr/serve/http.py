@@ -22,6 +22,12 @@ document):
                                  endpoint just tails it over the wire)
     POST /v1/jobs/<id>/cancel    cancel
     GET  /v1/tenants             tenant accounting fold
+    GET  /v1/metrics             fleet telemetry in Prometheus text
+                                 exposition format 0.0.4 (ISSUE 17;
+                                 the shared TelemetryAggregator folds
+                                 the spool's journals live per scrape)
+    GET  /v1/telemetry           the same fold as tpuvsr-telemetry/1
+                                 JSON
     GET  /healthz                queue stats
 
 Exit-code mapping: every job doc carries ``exit_code`` — the unified
@@ -66,7 +72,7 @@ class ServiceHTTP:
     poll tick)."""
 
     def __init__(self, spool, *, host="127.0.0.1", port=0, poll=0.15,
-                 max_stream_s=3600.0, log=None):
+                 max_stream_s=3600.0, log=None, slo=None):
         self.spool = os.path.abspath(spool)
         self.queue = JobQueue(self.spool)
         self.poll = poll
@@ -74,6 +80,12 @@ class ServiceHTTP:
         self.log = log
         self._thread = None
         self._closing = False
+        # the fleet telemetry fold (ISSUE 17), built on first scrape —
+        # one shared aggregator, its own lock, tailed incrementally
+        # per request so /v1/metrics serves live folds while jobs run
+        self._telemetry = None
+        self._telemetry_lock = threading.Lock()
+        self._slo = slo
         svc = self
 
         class Handler(_Handler):
@@ -99,6 +111,17 @@ class ServiceHTTP:
         if self.log:
             self.log(f"http front listening on {self.address}")
         return self
+
+    def telemetry(self):
+        """The shared spool aggregator, polled: every call folds any
+        journal lines appended since the last scrape."""
+        with self._telemetry_lock:
+            if self._telemetry is None:
+                from ..obs.telemetry import TelemetryAggregator
+                self._telemetry = TelemetryAggregator(
+                    self.spool, slo=self._slo)
+        self._telemetry.poll()
+        return self._telemetry
 
     def stop(self):
         self._closing = True
@@ -129,6 +152,17 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass
 
+    def _text(self, code, body, content_type):
+        body = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def _error(self, code, message):
         self._json(code, {"error": message})
 
@@ -148,6 +182,17 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in url.path.split("/") if p]
         q = self.service.queue
         try:
+            # telemetry routes fold journals, not the queue — they
+            # take the aggregator's own lock, never the queue's
+            if parts == ["v1", "metrics"]:
+                from ..obs.telemetry import prometheus_text
+                snap = self.service.telemetry().snapshot()
+                return self._text(
+                    200, prometheus_text(snap),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            if parts == ["v1", "telemetry"]:
+                return self._json(
+                    200, self.service.telemetry().snapshot())
             with q.lock():
                 q.refresh()
                 if parts == ["healthz"]:

@@ -1,4 +1,5 @@
-"""Service CLI verbs: ``serve`` / ``submit`` / ``status`` / ``cancel``.
+"""Service CLI verbs: ``serve`` / ``submit`` / ``status`` /
+``cancel`` / ``telemetry``.
 
 The query surface of the dispatch service is deliberately thin: the
 queue spool IS the database and each job's journal + metrics doc ARE
@@ -10,6 +11,14 @@ its API records — these verbs only fold and print them.
                      [--workers N] [--http PORT] [--tenant-weight T=W]
     python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
     python -m tpuvsr cancel JOB [--spool DIR]
+    python -m tpuvsr telemetry [SPOOL] [--watch] [--json | --prom]
+
+``telemetry`` (ISSUE 17) folds the spool's journals through
+:class:`tpuvsr.obs.telemetry.TelemetryAggregator` and prints the
+fleet view — per-tenant latency histograms, DRR fairness vs actual
+device-seconds, worker utilization, throughput windows, SLO breaches.
+``--watch`` repolls on an interval; ``--prom`` prints the Prometheus
+text exposition the HTTP front serves at ``GET /v1/metrics``.
 
 ``submit`` / ``status`` / ``cancel`` import neither jax nor the
 engines — they are milliseconds against a live spool.  ``serve``
@@ -39,7 +48,7 @@ import time
 from ..exitcodes import EX_USAGE
 from .queue import JobQueue, QueueError
 
-VERBS = ("serve", "submit", "status", "cancel")
+VERBS = ("serve", "submit", "status", "cancel", "telemetry")
 
 
 def default_spool():
@@ -218,6 +227,34 @@ def build_parser():
     ca.add_argument("job_id")
     ca.add_argument("--spool", default=None)
     ca.add_argument("--json", action="store_true")
+
+    te = sub.add_parser("telemetry",
+                        help="fold the spool's journals into the "
+                             "fleet telemetry view (ISSUE 17)")
+    te.add_argument("spool_pos", nargs="?", default=None,
+                    metavar="SPOOL",
+                    help="spool directory (also --spool / "
+                         "TPUVSR_SPOOL)")
+    te.add_argument("--spool", default=None)
+    te.add_argument("--watch", action="store_true",
+                    help="repoll and redraw every --interval seconds "
+                         "until interrupted")
+    te.add_argument("--interval", type=float, default=2.0)
+    te.add_argument("--json", action="store_true",
+                    help="print the tpuvsr-telemetry/1 snapshot "
+                         "document")
+    te.add_argument("--prom", action="store_true",
+                    help="print the Prometheus text exposition "
+                         "(format 0.0.4), as GET /v1/metrics serves")
+    te.add_argument("--window", type=float, default=10.0,
+                    help="fold window seconds (default 10)")
+    te.add_argument("--slo-queue-wait", type=float, default=None,
+                    metavar="SECONDS",
+                    help="SLO watchdog: journal slo_breach when any "
+                         "tenant's p99 queue wait exceeds this")
+    te.add_argument("--no-breach-journal", action="store_true",
+                    help="fold only — never append slo_breach events "
+                         "or publish baselines (pure read)")
     return p
 
 
@@ -463,8 +500,15 @@ def cmd_status(args):
     from ..serve.fairshare import TenantLedger
     tenants = TenantLedger.fold(q.jobs())
     if args.json:
+        # the queue fold plus the fleet telemetry fold in one doc
+        # (ISSUE 17): dashboards scraping `status --json` get the
+        # same tpuvsr-telemetry/1 snapshot /v1/telemetry serves
+        from ..obs.telemetry import TelemetryAggregator
+        agg = TelemetryAggregator(q.spool, journal_breaches=False)
+        agg.poll()
         print(json.dumps({"stats": q.stats(), "jobs": jobs,
-                          "tenants": tenants}, default=str))
+                          "tenants": tenants,
+                          "telemetry": agg.snapshot()}, default=str))
     else:
         st = q.stats()
         print("queue: " + ", ".join(f"{k}={v}" for k, v in st.items()
@@ -498,6 +542,47 @@ def cmd_cancel(args):
                           "note": note}))
     else:
         print(f"{job.job_id}: {note}")
+    return 0
+
+
+def cmd_telemetry(args):
+    """``tpuvsr telemetry [SPOOL] [--watch] [--json | --prom]`` — the
+    CLI face of the fleet telemetry fold.  Imports neither jax nor the
+    engines (the aggregator is pure stdlib), so it is milliseconds
+    against a live spool and safe to leave running beside a serve."""
+    from ..obs.telemetry import (TelemetryAggregator, prometheus_text,
+                                 render_watch)
+    spool = args.spool_pos or args.spool or default_spool()
+    if not os.path.isdir(spool):
+        print(f"telemetry: no spool at {spool!r}", file=sys.stderr)
+        return EX_USAGE
+    slo = {}
+    if args.slo_queue_wait is not None:
+        slo["queue_wait_p99_s"] = args.slo_queue_wait
+    agg = TelemetryAggregator(
+        spool, window_s=args.window, slo=slo,
+        journal_breaches=not args.no_breach_journal)
+
+    def emit():
+        agg.poll()
+        snap = agg.snapshot()
+        if args.prom:
+            print(prometheus_text(snap), end="")
+        elif args.json:
+            print(json.dumps(snap, default=str))
+        else:
+            print(render_watch(snap))
+
+    if not args.watch:
+        emit()
+        return 0
+    try:
+        while True:
+            emit()
+            print("---", flush=True)
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -626,7 +711,8 @@ def main(argv=None):
     parser = build_parser()
     args = parser.parse_args(argv)
     return {"submit": cmd_submit, "status": cmd_status,
-            "cancel": cmd_cancel, "serve": cmd_serve}[args.verb](args)
+            "cancel": cmd_cancel, "serve": cmd_serve,
+            "telemetry": cmd_telemetry}[args.verb](args)
 
 
 if __name__ == "__main__":

@@ -118,6 +118,11 @@ class Job:
     reason: str = None           # why failed/requeued/cancelled
     submitted_ts: float = 0.0
     updated_ts: float = 0.0
+    #: end-to-end correlation id (ISSUE 17): minted at submit, stamped
+    #: on every journal event of the job's whole story across the
+    #: service / worker / engine process hops.  None on records written
+    #: before the telemetry plane existed (old spools fold fine).
+    trace_id: str = None
 
     @property
     def elastic(self):
@@ -138,7 +143,8 @@ class Job:
             "job_id", "spec", "cfg", "engine", "kind", "tenant",
             "flags", "priority", "devices", "devices_min",
             "devices_max", "state", "seq", "attempts", "rescue",
-            "result", "reason", "submitted_ts", "updated_ts")}
+            "result", "reason", "submitted_ts", "updated_ts",
+            "trace_id")}
 
 
 class QueueError(RuntimeError):
@@ -341,21 +347,27 @@ class JobQueue:
         # scheduler rewrites job.devices on shrink/grow requeues; grow
         # decisions compare against what was asked for)
         flags.setdefault("devices_requested", int(devices))
+        from ..obs.journal import new_trace_id, root_span
         job = Job(job_id=job_id, spec=str(spec), cfg=cfg, engine=engine,
                   kind=kind, tenant=tenant, flags=flags,
                   priority=int(priority), devices=int(devices),
                   devices_min=devices_min, devices_max=devices_max,
                   seq=self._seq, submitted_ts=round(time.time(), 3),
-                  updated_ts=round(time.time(), 3))
+                  updated_ts=round(time.time(), 3),
+                  trace_id=new_trace_id())
         _fsync_append(self.log_path, {"op": "submit",
                                       "job": job.to_dict(),
                                       "ts": job.submitted_ts})
         self._jobs[job.job_id] = job
         # a job's journal opens with its submission — the first line
         # of the story every later attempt appends to (obs.journal is
-        # jax-free, so submit stays milliseconds)
+        # jax-free, so submit stays milliseconds).  The trace is minted
+        # HERE: this line carries the correlation id every later event
+        # of the job's lifecycle repeats (ISSUE 17)
         from ..obs import Journal
-        j = Journal(self.journal_path(job.job_id), run_id="svc-submit")
+        j = Journal(self.journal_path(job.job_id), run_id="svc-submit",
+                    trace_id=job.trace_id,
+                    span_id=root_span(job.trace_id))
         try:
             j.write("job_submitted", job_id=job.job_id, spec=job.spec,
                     engine=job.engine, priority=job.priority,
@@ -608,8 +620,12 @@ class JobQueue:
             # requeue (the worker's own requeue path does the same),
             # naming the dead claim's worker/host
             from ..obs import Journal
+            from ..obs.journal import root_span
             jr = Journal(self.journal_path(job.job_id),
-                         run_id="svc-recover")
+                         run_id="svc-recover",
+                         trace_id=job.trace_id,
+                         span_id=(root_span(job.trace_id)
+                                  if job.trace_id else None))
             try:
                 jr.write("job_requeued", job_id=job.job_id,
                          reason="worker-died", rescue=rescue,

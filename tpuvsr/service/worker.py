@@ -47,6 +47,8 @@ import time
 
 from ..exitcodes import EX_RESUMABLE, job_state
 from ..obs import Journal, RunObserver
+from ..obs.journal import (new_span_id, root_span, trace_env,
+                           trace_scope)
 from .scheduler import DevicePool, Scheduler, advise_backend
 
 # NOTE: the serving-tier pieces (fair-share policy, multi-runner) live
@@ -170,6 +172,7 @@ class Worker:
         self.shell_retry_gate = shell_retry_gate
         self._log = log
         self._specs = {}             # job_id -> loaded spec (admission)
+        self._spans = {}             # job_id -> this attempt's span id
         self._current = None
         self._preempt_sent = False
         self._cancelled = False
@@ -206,11 +209,28 @@ class Worker:
     def _release_hold(self, job_id):
         self._held.discard(job_id)
 
+    def _trace_ctx(self, job):
+        """This job's trace context for a service-side journal write:
+        the attempt span while one is open (parented on the service
+        root), the deterministic root span otherwise.  Jobs from a
+        pre-telemetry spool (no trace_id) get no trace keys at all."""
+        tid = getattr(job, "trace_id", None)
+        if not tid:
+            # explicit empty strings so a concurrently exported
+            # trace_scope (another job on this process) can never
+            # leak its env context into THIS job's events
+            return {"trace_id": "", "span_id": "", "parent_span": ""}
+        span = self._spans.get(job.job_id)
+        if span:
+            return {"trace_id": tid, "span_id": span,
+                    "parent_span": root_span(tid)}
+        return {"trace_id": tid, "span_id": root_span(tid)}
+
     def _journal(self, job, event, **fields):
         """Append one job_* event to the JOB'S OWN journal (the same
         file the engine/supervisor attempts write to)."""
         j = Journal(self.queue.journal_path(job.job_id),
-                    run_id=f"svc-{self.owner}")
+                    run_id=f"svc-{self.owner}", **self._trace_ctx(job))
         try:
             j.write(event, job_id=job.job_id,
                     elapsed_s=round(time.time() - job.submitted_ts, 3),
@@ -372,6 +392,11 @@ class Worker:
         self._cancelled = False
         self._requeue_devices = None
         self._requeue_reason = None
+        # one span per ATTEMPT, parented on the service root span:
+        # job_started/job_done/job_requeued of this attempt share it,
+        # and the engine-run segments parent onto it via trace_scope
+        if getattr(job, "trace_id", None):
+            self._spans[job.job_id] = new_span_id()
         try:
             if job.kind == "shell":
                 return self._run_shell(job)
@@ -389,6 +414,7 @@ class Worker:
             self.pool.release(job.job_id)
             self._current = None
             self._specs.pop(job.job_id, None)
+            self._spans.pop(job.job_id, None)
 
     def run_one_light(self, job):
         """Run one LIGHT job (shell / interp validate / lint-only) —
@@ -397,6 +423,8 @@ class Worker:
         concurrently running mesh job; any unexpected error fails the
         JOB, never the thread pool."""
         from .queue import QueueError
+        if getattr(job, "trace_id", None):
+            self._spans[job.job_id] = new_span_id()
         try:
             if job.kind == "shell":
                 self._run_shell(job)
@@ -421,6 +449,7 @@ class Worker:
             self._release_hold(job.job_id)
             self.pool.release(job.job_id)
             self._specs.pop(job.job_id, None)
+            self._spans.pop(job.job_id, None)
 
     # -- light jobs (the multi-runner lane, ISSUE 14) ------------------
     def _run_validate_interp(self, job):
@@ -526,25 +555,33 @@ class Worker:
             injected = flags.get("inject")
             if injected:
                 faults.install(injected)
-            out = run_supervised(
-                spec, engine=kind,
-                checkpoint_path=self.queue.checkpoint_path(job.job_id),
-                journal_path=self.queue.journal_path(job.job_id),
-                metrics_path=self.queue.metrics_path(job.job_id),
-                log=self._log, engine_factory=factory,
-                observer_factory=observer_factory,
-                mesh_devices=(alloc if kind == "sharded" else None),
-                engine_kwargs=(
-                    {"pipeline": int(flags["pipeline"])}
-                    if flags.get("pipeline") and not factory else None),
-                **sup_kw,
-                run_kwargs={
-                    "max_states": flags.get("maxstates"),
-                    "max_depth": flags.get("maxdepth"),
-                    "max_seconds": flags.get("maxseconds"),
-                    "check_deadlock": bool(flags.get("deadlock")),
-                    "resume_from": (job.rescue or {}).get("path"),
-                })
+            # the engine's own journal (RunObserver) runs inside the
+            # attempt span's trace scope, so every run_start /
+            # level_done / fault / run_end of this attempt carries the
+            # job's trace_id with a fresh per-segment span (ISSUE 17)
+            with trace_scope(job.trace_id,
+                             parent_span=self._spans.get(job.job_id)):
+                out = run_supervised(
+                    spec, engine=kind,
+                    checkpoint_path=self.queue.checkpoint_path(
+                        job.job_id),
+                    journal_path=self.queue.journal_path(job.job_id),
+                    metrics_path=self.queue.metrics_path(job.job_id),
+                    log=self._log, engine_factory=factory,
+                    observer_factory=observer_factory,
+                    mesh_devices=(alloc if kind == "sharded" else None),
+                    engine_kwargs=(
+                        {"pipeline": int(flags["pipeline"])}
+                        if flags.get("pipeline") and not factory
+                        else None),
+                    **sup_kw,
+                    run_kwargs={
+                        "max_states": flags.get("maxstates"),
+                        "max_depth": flags.get("maxdepth"),
+                        "max_seconds": flags.get("maxseconds"),
+                        "check_deadlock": bool(flags.get("deadlock")),
+                        "resume_from": (job.rescue or {}).get("path"),
+                    })
         except Exception as e:  # noqa: BLE001 — a job, not the worker
             self._finish(job, "failed",
                          reason=f"job-setup: {type(e).__name__}: {e}")
@@ -655,21 +692,24 @@ class Worker:
                 # flags {"hunt": true} opts into the continuous mode
                 # (runs until cancelled/preempted)
                 num = 10000
-            out = run_hunt_job(
-                spec,
-                checkpoint_path=self.queue.checkpoint_path(job.job_id),
-                journal_path=self.queue.journal_path(job.job_id),
-                metrics_path=self.queue.metrics_path(job.job_id),
-                log=self._log, observer_factory=observer_factory,
-                model_factory=factory, walkers=walkers,
-                n_devices=alloc, depth=depth,
-                seed=int(flags.get("seed") or 0), num=num,
-                max_seconds=flags.get("maxseconds"),
-                max_violations=flags.get("max_violations"),
-                split=split,
-                chunk_steps=int(flags.get("chunk_steps") or 16),
-                pipeline=int(flags.get("pipeline") or 2),
-                resume_from=(job.rescue or {}).get("path"))
+            with trace_scope(job.trace_id,
+                             parent_span=self._spans.get(job.job_id)):
+                out = run_hunt_job(
+                    spec,
+                    checkpoint_path=self.queue.checkpoint_path(
+                        job.job_id),
+                    journal_path=self.queue.journal_path(job.job_id),
+                    metrics_path=self.queue.metrics_path(job.job_id),
+                    log=self._log, observer_factory=observer_factory,
+                    model_factory=factory, walkers=walkers,
+                    n_devices=alloc, depth=depth,
+                    seed=int(flags.get("seed") or 0), num=num,
+                    max_seconds=flags.get("maxseconds"),
+                    max_violations=flags.get("max_violations"),
+                    split=split,
+                    chunk_steps=int(flags.get("chunk_steps") or 16),
+                    pipeline=int(flags.get("pipeline") or 2),
+                    resume_from=(job.rescue or {}).get("path"))
         except Exception as e:  # noqa: BLE001 — a job, not the worker
             self._finish(job, "failed",
                          reason=f"job-setup: {type(e).__name__}: {e}")
@@ -734,18 +774,22 @@ class Worker:
                 # determinism contract is per-trace, so reports are
                 # unchanged either way)
                 batch = max(1, int(flags["batch_per_device"]) * alloc)
-            out = run_validate_job(
-                spec, traces,
-                checkpoint_path=self.queue.checkpoint_path(job.job_id),
-                journal_path=self.queue.journal_path(job.job_id),
-                metrics_path=self.queue.metrics_path(job.job_id),
-                log=self._log, observer_factory=observer_factory,
-                model_factory=factory, batch=batch, n_devices=alloc,
-                cand_cap=int(flags.get("cand_cap") or 4),
-                chunk_steps=int(flags.get("chunk_steps") or 8),
-                pipeline=int(flags.get("pipeline") or 2),
-                max_seconds=flags.get("maxseconds"),
-                resume_from=(job.rescue or {}).get("path"))
+            with trace_scope(job.trace_id,
+                             parent_span=self._spans.get(job.job_id)):
+                out = run_validate_job(
+                    spec, traces,
+                    checkpoint_path=self.queue.checkpoint_path(
+                        job.job_id),
+                    journal_path=self.queue.journal_path(job.job_id),
+                    metrics_path=self.queue.metrics_path(job.job_id),
+                    log=self._log, observer_factory=observer_factory,
+                    model_factory=factory, batch=batch,
+                    n_devices=alloc,
+                    cand_cap=int(flags.get("cand_cap") or 4),
+                    chunk_steps=int(flags.get("chunk_steps") or 8),
+                    pipeline=int(flags.get("pipeline") or 2),
+                    max_seconds=flags.get("maxseconds"),
+                    resume_from=(job.rescue or {}).get("path"))
         except Exception as e:  # noqa: BLE001 — a job, not the worker
             self._finish(job, "failed",
                          reason=f"job-setup: {type(e).__name__}: {e}")
@@ -762,6 +806,16 @@ class Worker:
         timeout = float(flags.get("timeout") or 3600)
         env = dict(os.environ)
         env.update(flags.get("env") or {})
+        # hand THIS job's trace context to the child (and scrub any
+        # scope a sibling job exported on this process): a tpuvsr
+        # child journals with the submitting job's trace_id
+        for k in ("TPUVSR_TRACE_ID", "TPUVSR_SPAN_ID",
+                  "TPUVSR_PARENT_SPAN"):
+            env.pop(k, None)
+        if getattr(job, "trace_id", None):
+            env.update(trace_env(
+                job.trace_id,
+                parent_span=self._spans.get(job.job_id)))
         cwd = flags.get("cwd")
         # shell jobs are LIGHT (ISSUE 14): they spend their life in a
         # subprocess wait, so they hold a zero-device allocation and
