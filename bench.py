@@ -694,7 +694,10 @@ def _embed_telemetry():
     rates, worker utilization) next to the engine headline — every
     BENCH_r*.json from r07 on carries them, and compare_bench's
     ``gate_telemetry`` fold-determinism drill activates on rounds
-    that do."""
+    that do.  Since ISSUE 18 the drill also exercises the serving
+    guard, so the round doc records ``rate_limited`` /
+    ``breaker_trips`` counters and the measured fast-fail rate
+    (``guard_reject_per_s``, gated by ``gate_guard``)."""
     import shutil
     import tempfile
     tmp = tempfile.mkdtemp(prefix="tpuvsr-bench-telemetry-")
@@ -703,11 +706,38 @@ def _embed_telemetry():
         from tpuvsr.service.queue import JobQueue
         from tpuvsr.service.worker import Worker
         q = JobQueue(os.path.join(tmp, "spool"))
-        q.submit("<stub>", engine="device", flags={"stub": True})
+        q.submit("<stub>", engine="device", tenant="bench",
+                 flags={"stub": True})
         Worker(q, devices=1).drain()
+        # guard drill (ISSUE 18): fold one throttled tenant and one
+        # breaker trip into the round doc, and time the fast-fail
+        # path — rejections/sec is serving-tier health (a slow
+        # rejector turns the rate limiter into a DoS amplifier);
+        # scripts/compare_bench.py's gate_guard diffs it between
+        # rounds at matching limiter configs
+        from tpuvsr.serve.guard import Guard, GuardDenied, spec_digest
+        guard = Guard(q.spool, rate=0.001, burst=1.0, breaker_k=1)
+        RESULT["guard_limiter"] = {"rate": 0.001, "burst": 1.0,
+                                   "breaker_k": 1}
+        denials = 0
+        t0g = time.time()
+        for _ in range(200):
+            try:
+                guard.admit_submission("bench", ts=time.time())
+            except GuardDenied:
+                denials += 1
+        reject_s = time.time() - t0g
+        guard.breaker_record("bench", spec_digest("<stub>", None),
+                             False, ts=time.time())
         agg = TelemetryAggregator(q.spool, journal_breaches=False)
         agg.poll()
-        RESULT["telemetry"] = agg.snapshot()
+        snap = agg.snapshot()
+        RESULT["telemetry"] = snap
+        g = snap.get("guard") or {}
+        RESULT["rate_limited"] = g.get("rate_limited")
+        RESULT["breaker_trips"] = g.get("breaker_trips")
+        RESULT["guard_reject_per_s"] = round(
+            denials / max(reject_s, 1e-9), 1)
     except Exception as e:  # noqa: BLE001 — the embed never kills bench
         RESULT["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
     finally:

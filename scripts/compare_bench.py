@@ -439,6 +439,69 @@ def gate_telemetry(base_doc, cand_doc, max_regression):
     return 1
 
 
+def guard_stats(doc):
+    """Front-door health of a document (ISSUE 18):
+    ``(reject_per_s, limiter, counters)`` or ``(None, None, None)``.
+    Reads the round doc's lifted ``guard_reject_per_s`` /
+    ``guard_limiter`` keys plus the ``rate_limited`` /
+    ``breaker_trips`` counters (top-level or inside the embedded
+    telemetry snapshot's ``guard`` section)."""
+    if not isinstance(doc, dict):
+        return None, None, None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    r = doc.get("guard_reject_per_s")
+    t = telemetry_snapshot(doc) or {}
+    g = t.get("guard") if isinstance(t.get("guard"), dict) else {}
+    if r is None and not g:
+        return None, None, None
+    counters = {
+        "rate_limited": (doc.get("rate_limited")
+                         if doc.get("rate_limited") is not None
+                         else g.get("rate_limited")),
+        "breaker_trips": (doc.get("breaker_trips")
+                          if doc.get("breaker_trips") is not None
+                          else g.get("breaker_trips")),
+    }
+    return (float(r) if r is not None else None,
+            doc.get("guard_limiter"), counters)
+
+
+def gate_guard(base_doc, cand_doc, max_regression):
+    """The front-door rejection-rate gate (ISSUE 18): 0
+    ok/advisory/absent, 1 when — at matching limiter configs — the
+    candidate's ``guard_reject_per_s`` DROPPED beyond tolerance.
+    Every 429/fast-fail must stay cheaper than the work it refuses,
+    or the rate limiter becomes a DoS amplifier.  A limiter-config
+    mismatch between the documents (different rate/burst/breaker
+    thresholds) measures a different admission policy — advisory,
+    like pipeline depth.  ``rate_limited`` / ``breaker_trips`` drift
+    prints as context (abuse-drill composition, never a
+    regression)."""
+    base, blim, bc = guard_stats(base_doc)
+    cand, clim, cc = guard_stats(cand_doc)
+    if bc and cc:
+        for k in ("rate_limited", "breaker_trips"):
+            b, c = bc.get(k), cc.get(k)
+            if b or c:
+                print(f"  guard.{k}: {b} -> {c} (advisory — abuse-"
+                      f"drill composition, not a regression)")
+    if base is None or cand is None:
+        return 0
+    print(f"guard_reject_per_s: baseline {base:.1f} -> candidate "
+          f"{cand:.1f}  [{fmt_delta(base, cand)}]")
+    if blim is not None and clim is not None and blim != clim:
+        print(f"  guard_limiter: {blim} -> {clim} (different "
+              f"admission policies — comparison is advisory)")
+        return 0
+    if base > 0 and cand < base * (1.0 - max_regression / 100.0):
+        print(f"compare_bench: guard rejection-rate REGRESSION "
+              f"beyond {max_regression:.1f}% tolerance "
+              f"(fast-fail path slowed down)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def liveness_stats(doc):
     """Liveness-path health of a document (ISSUE 15):
     ``(edges_per_s, check_s, mode, overhead)`` or all-None.  Reads
@@ -649,8 +712,14 @@ def main(argv=None):
     # produce an identical fold — determinism regressions fail,
     # embedded fleet-counter drift is advisory
     tel_rc = gate_telemetry(base_doc, cand_doc, args.max_regression)
+    # the hardened front door likewise (ISSUE 18): the guard's
+    # fast-fail rejection rate drops fail at matching limiter
+    # configs; policy mismatches and abuse-drill counter drift are
+    # advisory
+    grd_rc = gate_guard(base_doc, cand_doc, args.max_regression)
     sim_rc = (sim_rc or val_rc or pack_rc or sym_rc or liv_rc
-              or por_rc or tel_rc or (1 if occ_regressed else 0))
+              or por_rc or tel_rc or grd_rc
+              or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):
         if pipe_mismatch or mesh_mismatch or commit_mismatch:

@@ -95,6 +95,21 @@ reference mount, no TPU, seconds on the CPU backend:
                      it, the survivor resumes the job, and the
                      incremental fold reconverges exactly with a
                      from-scratch fold
+  flood-rate-limit   a flooding tenant hammers the hardened HTTP
+                     front door (ISSUE 18) -> bounded 429s with
+                     Retry-After, every denial journaled; a legit
+                     tenant's job still completes with the exact
+                     stub fixpoint
+  breaker-crash-loop a crash-looping (tenant, spec) trips the
+                     circuit breaker after K failures -> later
+                     submissions fail fast with reason breaker-open
+                     (no subprocess spawned); a clean run after the
+                     cooldown closes it via the half-open probe —
+                     both transitions journaled, telemetry fold
+                     restart-convergent
+  slow-loris-reap    a client that sends half a request line and
+                     stalls is reaped by the per-connection read
+                     timeout; the service stays fully responsive
   kill-liveness-resume  SIGTERM mid-graph-build on a STREAMED temporal
                      run (ISSUE 15: edges flowing out of the fused
                      commit) -> rescue snapshot carrying gid column +
@@ -1263,6 +1278,166 @@ def scenario_kill_liveness_resume(tmp):
     }
 
 
+def scenario_flood_rate_limit(tmp):
+    """ISSUE 18: a flooding tenant hammers the hardened HTTP front
+    door -> the per-tenant token bucket turns the flood into bounded
+    429s carrying Retry-After (every denial journaled as
+    rate_limited), an unauthenticated probe bounces 401, and the
+    legit tenant's job still completes with the EXACT stub
+    fixpoint — abuse never changes a verdict."""
+    import http.client
+    ORACLE = _oracle()
+    from tpuvsr.obs import read_journal
+    from tpuvsr.serve.guard import Guard
+    from tpuvsr.serve.http import ServiceHTTP
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import true_argv
+    spool = os.path.join(tmp, "spool")
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "tokens.json"), "w") as f:
+        json.dump({"legit": "tok-l", "flood": "tok-f"}, f)
+    guard = Guard(spool, rate=0.5, burst=2.0)
+    svc = ServiceHTTP(spool, guard=guard).start()
+
+    def post(token, body):
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        hdrs = {"Content-Type": "application/json"}
+        if token:
+            hdrs["Authorization"] = f"Bearer {token}"
+        conn.request("POST", "/v1/jobs",
+                     body=json.dumps(body).encode(), headers=hdrs)
+        resp = conn.getresponse()
+        doc = json.loads(resp.read() or b"{}")
+        ra = resp.getheader("Retry-After")
+        conn.close()
+        return resp.status, doc, ra
+
+    try:
+        code, doc, _ = post("tok-l", {"spec": "<stub>",
+                                      "engine": "device",
+                                      "flags": {"stub": True}})
+        legit_id = doc.get("job_id")
+        flood = [post("tok-f", {"spec": "SPAM", "kind": "shell",
+                                "flags": {"argv": true_argv()}})
+                 for _ in range(10)]
+        denied = [f for f in flood if f[0] == 429]
+        noauth = post(None, {"spec": "X", "kind": "shell",
+                             "flags": {"argv": true_argv()}})[0]
+        q = JobQueue(spool)
+        Worker(q, devices=1).drain()
+        done = q.get(legit_id)
+    finally:
+        svc.stop()
+    ev = [e["event"]
+          for e in read_journal(os.path.join(spool, "guard.jsonl"))]
+    return {
+        "ok": (code == 200 and done.state == "done"
+               and done.result["distinct"] == ORACLE["distinct"]
+               and done.result["levels"] == ORACLE["levels"]
+               and len(denied) >= 7
+               and all(f[2] is not None for f in denied)
+               and noauth == 401
+               and ev.count("rate_limited") == len(denied)
+               and "auth_denied" in ev),
+        "flood_429s": len(denied), "noauth": noauth,
+        "legit_state": done.state,
+        "distinct": done.result["distinct"],
+    }
+
+
+def scenario_breaker_crash_loop(tmp):
+    """ISSUE 18: a crash-looping (tenant, spec) trips the circuit
+    breaker after K=2 failures -> the next submissions fail FAST with
+    reason breaker-open (no subprocess spawned), a clean run after
+    the cooldown closes it via the half-open probe, both transitions
+    are journaled, and two fresh telemetry folds of the guard journal
+    are identical (restart-convergent)."""
+    import time
+    from tpuvsr.obs import read_journal
+    from tpuvsr.obs.telemetry import TelemetryAggregator
+    from tpuvsr.serve.guard import Guard, spec_digest
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import true_argv
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool)
+    guard = Guard(spool, breaker_k=2, breaker_cooldown=1.0)
+    w = Worker(q, devices=1, light_threads=0, policy=None,
+               owner="w-brk", guard=guard)
+    fail = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    for i in range(4):
+        q.submit("CRASH", kind="shell", tenant="a",
+                 flags={"argv": fail, "timeout": 30}, job_id=f"c{i}")
+    w.drain(idle_exit=True)
+    jobs = {j.job_id: j for j in q.jobs()}
+    digest = spec_digest("CRASH", None)
+    opened = guard.breaker_state("a", digest) == "open"
+    time.sleep(1.2)                # past the cooldown: half-open
+    q.submit("CRASH", kind="shell", tenant="a",
+             flags={"argv": true_argv(), "timeout": 30},
+             job_id="probe")
+    w.drain(idle_exit=True)
+    closed = guard.breaker_state("a", digest) == "closed"
+    ev = [e["event"]
+          for e in read_journal(os.path.join(spool, "guard.jsonl"))]
+    a1 = TelemetryAggregator(spool, journal_breaches=False)
+    a1.poll()
+    a2 = TelemetryAggregator(spool, journal_breaches=False)
+    a2.poll()
+    g1 = a1.snapshot()["guard"]
+    g2 = a2.snapshot()["guard"]
+    return {
+        "ok": (jobs["c0"].reason == "rc=3"
+               and jobs["c1"].reason == "rc=3"
+               and jobs["c2"].reason == "breaker-open"
+               and jobs["c3"].reason == "breaker-open"
+               and opened and closed
+               and q.get("probe").state == "done"
+               and ev.count("breaker_open") == 1
+               and ev.count("breaker_close") == 1
+               and g1 == g2 and g1["breaker_trips"] == 1
+               and g1["breaker_closes"] == 1
+               and g1["open_breakers"] == []),
+        "fast_fail_reasons": [jobs["c2"].reason, jobs["c3"].reason],
+        "probe_state": q.get("probe").state,
+        "fold_reconverged": g1 == g2,
+    }
+
+
+def scenario_slow_loris_reap(tmp):
+    """ISSUE 18: a client that sends half a request line and stalls
+    holds a connection slot until the per-connection read timeout
+    reaps it (server closes; recv returns b''); the service answers
+    the next well-formed request immediately."""
+    import http.client
+    import socket
+    from tpuvsr.serve.http import ServiceHTTP
+    spool = os.path.join(tmp, "spool")
+    os.makedirs(spool, exist_ok=True)
+    svc = ServiceHTTP(spool, request_timeout=0.5).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", svc.port),
+                                     timeout=10)
+        s.sendall(b"POST /v1/jobs HT")      # ...and stall forever
+        s.settimeout(10)
+        try:
+            reaped = s.recv(64) == b""      # server hung up on us
+        except ConnectionError:
+            reaped = True
+        s.close()
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        conn.request("GET", "/healthz")
+        healthy = conn.getresponse().status == 200
+        conn.close()
+    finally:
+        svc.stop()
+    return {"ok": reaped and healthy, "reaped": reaped,
+            "healthz_after": healthy}
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -1289,6 +1464,9 @@ SCENARIOS = [
     ("kill-hunt-resume", scenario_kill_hunt_resume),
     ("kill-validate-resume", scenario_kill_validate_resume),
     ("kill-liveness-resume", scenario_kill_liveness_resume),
+    ("flood-rate-limit", scenario_flood_rate_limit),
+    ("breaker-crash-loop", scenario_breaker_crash_loop),
+    ("slow-loris-reap", scenario_slow_loris_reap),
 ]
 
 

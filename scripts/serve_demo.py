@@ -1,6 +1,7 @@
-"""Serving-tier demo: lifecycle smoke + the ISSUE 14 saturation drill.
+"""Serving-tier demo: lifecycle smoke + the ISSUE 14 saturation drill
++ the ISSUE 18 abuse drill.
 
-Four legs, end to end on the stub harness (no reference mount, CPU
+Five legs, end to end on the stub harness (no reference mount, CPU
 backend), printing one JSON object; exit 0 iff every check holds:
 
   lifecycle   the original ISSUE 6 three-job drill (clean /
@@ -29,6 +30,14 @@ backend), printing one JSON object; exit 0 iff every check holds:
               hunt, shell) drained serially and by 2 concurrent
               workers; results and journals must agree modulo
               timestamps/worker-id (the projection below).
+
+  abuse       the ISSUE 18 hardened-front-door drill: an
+              unauthenticated client (401), a flooding tenant (429
+              with Retry-After off the per-tenant token bucket) and
+              an oversized body (413) are all rejected at the door,
+              every denial is journaled and folded onto /v1/metrics,
+              and the legit tenant's job still completes with the
+              exact stub fixpoint.
 
     python scripts/serve_demo.py
 
@@ -433,13 +442,112 @@ def demo_bit_identity(tmp, out):
     return checks
 
 
+# ---------------------------------------------------------------------
+# leg 5: abuse — the hardened front door (ISSUE 18)
+# ---------------------------------------------------------------------
+def demo_abuse(tmp, out):
+    import http.client
+    from tpuvsr.obs import read_journal
+    from tpuvsr.serve.guard import Guard
+    from tpuvsr.serve.http import ServiceHTTP
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS
+
+    spool = os.path.join(tmp, "spool-abuse")
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "tokens.json"), "w") as f:
+        json.dump({"legit": "tok-legit", "flood": "tok-flood"}, f)
+    guard = Guard(spool, rate=0.5, burst=2.0)
+    svc = ServiceHTTP(spool, guard=guard).start()
+
+    def req(method, path, body=None, token=None, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        hdrs = dict(headers or {})
+        if token:
+            hdrs["Authorization"] = f"Bearer {token}"
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            hdrs["Content-Type"] = "application/json"
+        conn.request(method, path, body=data, headers=hdrs)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            doc = json.loads(raw or b"{}")
+        except ValueError:
+            doc = {"raw": raw.decode(errors="replace")}
+        ra = resp.getheader("Retry-After")
+        conn.close()
+        return resp.status, doc, ra
+
+    checks = {}
+    try:
+        submit = {"spec": "<stub:legit>", "engine": "device",
+                  "flags": {"stub": True}}
+        code, doc, _ = req("POST", "/v1/jobs", body=submit,
+                           token="tok-legit")
+        legit_id = doc.get("job_id")
+        checks["legit_accepted"] = code == 200
+        # an unauthenticated client and an oversized body bounce at
+        # the door — neither ever reaches the queue
+        checks["unauthenticated_401"] = req(
+            "POST", "/v1/jobs", body=submit)[0] == 401
+        checks["oversized_body_413"] = req(
+            "POST", "/v1/jobs", body=submit, token="tok-legit",
+            headers={"Content-Length": str(guard.max_body + 1)}
+        )[0] == 413
+        # the flood: 10 rapid submissions against a 0.5/s budget
+        flood = [req("POST", "/v1/jobs",
+                     body={"spec": f"SPAM-{i}", "kind": "shell",
+                           "flags": {"argv": _true_argv()}},
+                     token="tok-flood")
+                 for i in range(10)]
+        denied = [f for f in flood if f[0] == 429]
+        checks["flood_throttled_429"] = len(denied) >= 7
+        checks["429_carries_retry_after"] = all(f[2] for f in denied)
+        # the legit tenant's verdict is untouched by the abuse
+        q = JobQueue(spool)
+        Worker(q, devices=1).drain()
+        legit = q.get(legit_id)
+        checks["legit_verdict_exact"] = (
+            legit.state == "done"
+            and legit.result["distinct"] == STUB_DISTINCT
+            and legit.result["levels"] == STUB_LEVELS)
+        # every denial journaled AND folded onto /v1/metrics
+        ev = [e["event"] for e in read_journal(
+            os.path.join(spool, "guard.jsonl"))]
+        checks["every_denial_journaled"] = (
+            ev.count("rate_limited") == len(denied)
+            and "auth_denied" in ev)
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        conn.request("GET", "/v1/metrics",
+                     headers={"Authorization": "Bearer tok-legit"})
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        conn.close()
+        checks["denials_on_metrics"] = (
+            resp.status == 200
+            and f"tpuvsr_rate_limited_total {len(denied)}" in text
+            and "tpuvsr_auth_denied_total 1" in text)
+    finally:
+        svc.stop()
+    out["abuse"] = {"flood_429s": len(denied),
+                    "flood_codes": [f[0] for f in flood],
+                    "legit_state": legit.state,
+                    "checks": checks}
+    return checks
+
+
 def main():
     tmp = tempfile.mkdtemp(prefix="tpuvsr-serve-demo-")
     out = {}
     checks = {}
     try:
         for leg in (demo_lifecycle, demo_saturation, demo_scaling,
-                    demo_bit_identity):
+                    demo_bit_identity, demo_abuse):
             for k, v in leg(tmp, out).items():
                 checks[f"{leg.__name__}.{k}"] = v
         out["checks"] = checks

@@ -642,9 +642,9 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 25 scenarios since ISSUE 17 (kill-aggregator-mid-tail +
-    # kill-worker-mid-event)
-    assert out["ok"] and len(out["scenarios"]) == 25
+    # 28 scenarios since ISSUE 18 (flood-rate-limit +
+    # breaker-crash-loop + slow-loris-reap)
+    assert out["ok"] and len(out["scenarios"]) == 28
 
 
 # ---------------------------------------------------------------------

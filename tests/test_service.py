@@ -600,10 +600,12 @@ def test_cli_verb_dispatch_subprocess(tmp_path):
 
 
 def test_serve_demo_smoke(capsys):
-    """The full serving-tier drill under tier-1 (ISSUE 14
+    """The full serving-tier drill under tier-1 (ISSUE 14 + 18
     acceptance): lifecycle, the 3-tenant/4-kind saturation queue over
-    2 worker processes, the >= 1.6x 2-worker scaling gate, and the
-    multi-worker-vs-serial bit-identity oracle."""
+    2 worker processes, the >= 1.6x 2-worker scaling gate, the
+    multi-worker-vs-serial bit-identity oracle, and the abuse drill
+    (401/413/429 at the hardened front door, legit verdicts
+    exact)."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "scripts"))
     import serve_demo
@@ -615,6 +617,8 @@ def test_serve_demo_smoke(capsys):
                                           "validate"]
     assert out["scaling"]["ratio"] >= 1.6
     assert out["bit_identity"]["diffs"] == {}
+    assert out["abuse"]["flood_429s"] >= 7
+    assert out["abuse"]["legit_state"] == "done"
 
 
 # ---------------------------------------------------------------------

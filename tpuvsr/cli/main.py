@@ -250,14 +250,22 @@ tpuvsr/serve — README "Service"):
     python -m tpuvsr submit SPEC.tla [-config F] [--engine E]
                      [--priority N] [--devices N] [--tenant T] ...
     python -m tpuvsr serve  [--spool DIR] [--drain] [--workers N]
-                     [--http PORT] [--tenant-weight T=W] ...
+                     [--http PORT] [--tenant-weight T=W]
+                     [--tls-cert PEM] [--rate N] [--high-water N]
+                     [--breaker-threshold K] ...
     python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
     python -m tpuvsr cancel JOB [--spool DIR]
 
 turn the checker into a long-running verification dispatcher: a
 durable job queue with speclint admission, a mesh scheduler with
 elastic shrink/grow of live sharded runs, and per-job journals +
-metrics docs as the query surface.
+metrics docs as the query surface.  The front door is hardened
+(ISSUE 18, tpuvsr/serve/guard.py — README "Hardening the front
+door"): bearer-token auth off a spool-local tokens.json, optional
+TLS, per-tenant token-bucket rate limits (429 + Retry-After),
+queue-depth backpressure (503), and a per-(tenant, spec) circuit
+breaker that fail-fasts crash-looping submissions before they touch
+a device.
 """
 
 from __future__ import annotations

@@ -95,6 +95,20 @@ EVENT_REQUIRED = {
     # target) — `what` names the gauge, `value` the observed number,
     # `target` the threshold it crossed
     "slo_breach": ("what", "value", "target"),
+    # serving-tier guard (ISSUE 18): every edge rejection and breaker
+    # transition is a first-class event in `<spool>/guard.jsonl`
+    # (run_id "guard") so the telemetry fold counts abuse
+    # restart-convergently.  `auth_denied` covers both 401 (missing /
+    # unknown token) and 403 (valid token acting cross-tenant) —
+    # `reason` says which; `rate_limited` is a 429 with the
+    # refill-derived Retry-After it returned; `backpressure` a 503
+    # past the queue high-water mark; `breaker_open`/`breaker_close`
+    # the per-(tenant, spec-digest) circuit-breaker transitions.
+    "auth_denied": ("reason",),
+    "rate_limited": ("tenant", "retry_after_s"),
+    "backpressure": ("depth", "high_water"),
+    "breaker_open": ("tenant", "digest", "failures"),
+    "breaker_close": ("tenant", "digest"),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

@@ -150,6 +150,9 @@ class TelemetryAggregator:
         self.spool = os.path.abspath(spool)
         self.journals_dir = os.path.join(self.spool, "journals")
         self.pool_journal = os.path.join(self.spool, "pool.jsonl")
+        # the admission guard's journal (ISSUE 18): every edge
+        # rejection and breaker transition, folded like any other
+        self.guard_journal = os.path.join(self.spool, "guard.jsonl")
         self.telemetry_dir = os.path.join(self.spool, "telemetry")
         self.events_path = os.path.join(self.telemetry_dir,
                                         "events.jsonl")
@@ -170,7 +173,11 @@ class TelemetryAggregator:
             "faults": 0, "retries": 0, "degrades": 0,
             "requeues": 0, "violations": 0, "worker_respawns": 0,
             "slo_breaches": 0,
+            # guard counters (ISSUE 18): folded off guard.jsonl
+            "auth_denied": 0, "rate_limited": 0, "backpressure": 0,
+            "breaker_trips": 0, "breaker_closes": 0,
         }
+        self._open_breakers = set()  # (tenant, digest) currently open
         self._jobs_by_state = {}     # terminal state -> count
         self._tenants = {}           # tenant -> fold dict
         self._workers = {}           # worker -> fold dict
@@ -190,7 +197,7 @@ class TelemetryAggregator:
                 "queue_wait": Histogram(), "run_time": Histogram(),
                 "sched_decisions": 0, "device_s": 0.0,
                 "weight": None, "deficit": None,
-                "jobs_done": 0, "violations": 0}
+                "jobs_done": 0, "violations": 0, "rate_limited": 0}
         return cell
 
     def _worker_cell(self, worker, ts):
@@ -260,6 +267,8 @@ class TelemetryAggregator:
                 for line in self._tail(path):
                     n += self._fold_line(line)
             for line in self._tail(self.pool_journal):
+                n += self._fold_line(line)
+            for line in self._tail(self.guard_journal):
                 n += self._fold_line(line)
             # our own breach journal last: a breach written THIS poll
             # is picked up by the NEXT (the counter stays
@@ -458,6 +467,28 @@ class TelemetryAggregator:
         self._breached.add((ev.get("what"), ev.get("tenant"),
                             ev.get("engine"), ev.get("window")))
 
+    # -- guard events (ISSUE 18, off guard.jsonl) ----------------------
+    def _on_auth_denied(self, ev, ts, w):
+        self._counters["auth_denied"] += 1
+
+    def _on_rate_limited(self, ev, ts, w):
+        self._counters["rate_limited"] += 1
+        t = ev.get("tenant")
+        self._tenant_cell(None if t in (None, "-") else t)[
+            "rate_limited"] += 1
+
+    def _on_backpressure(self, ev, ts, w):
+        self._counters["backpressure"] += 1
+
+    def _on_breaker_open(self, ev, ts, w):
+        self._counters["breaker_trips"] += 1
+        self._open_breakers.add((ev.get("tenant"), ev.get("digest")))
+
+    def _on_breaker_close(self, ev, ts, w):
+        self._counters["breaker_closes"] += 1
+        self._open_breakers.discard((ev.get("tenant"),
+                                     ev.get("digest")))
+
     def _prune(self):
         """Bounded memory: drop pending jobs and engine-run cells not
         touched inside the window horizon (measured on the FOLD clock,
@@ -605,7 +636,8 @@ class TelemetryAggregator:
                     "weight": cell["weight"],
                     "deficit": cell["deficit"],
                     "jobs_done": cell["jobs_done"],
-                    "violations": cell["violations"]}
+                    "violations": cell["violations"],
+                    "rate_limited": cell["rate_limited"]}
             total_dev = sum(c["device_s"]
                             for c in self._tenants.values()) or None
             for t, doc in tenants.items():
@@ -640,6 +672,16 @@ class TelemetryAggregator:
                         "baselines": {k: round(v, 3) for k, v in
                                       sorted(self._baselines.items())},
                         "config": self.slo or None},
+                "guard": {
+                    "auth_denied": self._counters["auth_denied"],
+                    "rate_limited": self._counters["rate_limited"],
+                    "backpressure": self._counters["backpressure"],
+                    "breaker_trips": self._counters["breaker_trips"],
+                    "breaker_closes":
+                        self._counters["breaker_closes"],
+                    "open_breakers": sorted(
+                        f"{t or '-'}:{d}"
+                        for t, d in self._open_breakers)},
             }
 
 
@@ -719,6 +761,26 @@ def prometheus_text(snap):
     metric("tpuvsr_slo_breach_total", "counter",
            "SLO watchdog breaches journaled.",
            [((), c["slo_breaches"])])
+    # guard counters + gauges (ISSUE 18): every edge rejection and
+    # breaker transition folded off guard.jsonl
+    for key, help_ in (
+            ("auth_denied", "Requests rejected 401/403 at the edge."),
+            ("rate_limited",
+             "Submissions rejected 429 (token bucket or in-flight "
+             "quota)."),
+            ("backpressure",
+             "Submissions rejected 503 past the queue high-water "
+             "mark."),
+            ("breaker_trips",
+             "Circuit breakers tripped open (per tenant+spec)."),
+            ("breaker_closes",
+             "Circuit breakers closed by a half-open probe.")):
+        metric(f"tpuvsr_{key}_total", "counter", help_,
+               [((), c[key])])
+    guard = snap.get("guard") or {}
+    metric("tpuvsr_breaker_open", "gauge",
+           "Circuit breakers currently open.",
+           [((), len(guard.get("open_breakers") or ()))])
     for key, help_ in (
             ("distinct_per_s",
              "Fleet distinct states/s over the last complete window."),
@@ -754,6 +816,11 @@ def prometheus_text(snap):
                [((("tenant", t),), d["deficit"])
                 for t, d in tenants.items()
                 if d["deficit"] is not None])
+        metric("tpuvsr_tenant_rate_limited_total", "counter",
+               "429 rejections per tenant (token bucket or "
+               "in-flight quota).",
+               [((("tenant", t),), d.get("rate_limited", 0))
+                for t, d in tenants.items()])
     workers = snap["workers"]
     if workers:
         metric("tpuvsr_worker_busy_seconds_total", "counter",
@@ -792,6 +859,16 @@ def render_watch(snap):
                  f"requeues={c['requeues']} "
                  f"respawns={c['worker_respawns']}  "
                  f"slo_breaches={c['slo_breaches']}")
+    guard = snap.get("guard")
+    if guard and any(guard[k] for k in (
+            "auth_denied", "rate_limited", "backpressure",
+            "breaker_trips")):
+        open_b = ",".join(guard["open_breakers"]) or "-"
+        lines.append(f"guard: auth_denied={guard['auth_denied']} "
+                     f"rate_limited={guard['rate_limited']} "
+                     f"backpressure={guard['backpressure']} "
+                     f"breaker_trips={guard['breaker_trips']} "
+                     f"open={open_b}")
     if snap["tenants"]:
         lines.append("tenant        wait_p50   wait_p99    run_p50  "
                      "dev_s   share  decisions")

@@ -1320,9 +1320,10 @@ class ShardedBFS:
                                 f"consecutive times at level {depth} "
                                 f"(retry budget "
                                 f"{self.exchange_retries}); giving up")
-                        backoff = min(
-                            self.exchange_backoff_cap,
-                            self.exchange_backoff * 2 ** (xretry - 1))
+                        from ..resilience.backoff import backoff_delay
+                        backoff = backoff_delay(
+                            xretry, self.exchange_backoff,
+                            self.exchange_backoff_cap)
                         obs.retry(attempt=xretry, backoff_s=backoff,
                                   what="exchange")
                         emit(f"exchange drop at level {depth}: retry "

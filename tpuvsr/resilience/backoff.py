@@ -1,0 +1,55 @@
+"""The one bounded-exponential-backoff formula (ISSUE 18 satellite).
+
+Four subsystems grew hand-rolled copies of the same curve — the
+supervisor's retry ladder (``supervisor._backoff_and_journal``), the
+sharded exchange retry loop (``parallel/sharded_bfs.py``), the worker
+pool's dead-slot respawn (``serve/pool.py``) and now the circuit
+breaker's re-open cooldown (``serve/guard.py``).  They all want the
+same thing: attempt ``n`` (1-based) waits ``base * 2**(n-1)`` seconds,
+capped.  One formula, one clamp discipline (negative bases and
+attempts are floored, a zero cap means "no cap"), so the cooldown
+vocabulary is shared and a test of the curve covers every caller.
+"""
+
+from __future__ import annotations
+
+
+def backoff_delay(attempt, base, cap=None):
+    """Seconds to wait before (1-based) retry ``attempt``:
+    ``min(cap, base * 2**(attempt-1))``.
+
+    ``attempt < 1`` is treated as the first attempt, a non-positive
+    ``base`` waits nothing, and ``cap=None`` (or <= 0) leaves the
+    curve unbounded — exactly the semantics of the four call sites
+    this replaces.
+    """
+    base = max(0.0, float(base))
+    n = max(1, int(attempt))
+    # cap the EXPONENT too: 2**(n-1) overflows to inf-ish floats long
+    # after the cap would have clamped it anyway
+    delay = base * (2.0 ** min(n - 1, 63))
+    if cap is not None and float(cap) > 0:
+        delay = min(float(cap), delay)
+    return delay
+
+
+class BackoffSchedule:
+    """A stateful view of the curve for callers that count their own
+    attempts (the circuit breaker's re-open cooldown): ``next()``
+    returns the delay for the next attempt and advances, ``reset()``
+    rewinds to the first step."""
+
+    def __init__(self, base, cap=None):
+        self.base = float(base)
+        self.cap = cap
+        self.attempt = 0
+
+    def next(self):
+        self.attempt += 1
+        return backoff_delay(self.attempt, self.base, self.cap)
+
+    def peek(self):
+        return backoff_delay(self.attempt + 1, self.base, self.cap)
+
+    def reset(self):
+        self.attempt = 0

@@ -515,8 +515,9 @@ class Supervisor:
                              f"states")
 
     def _backoff_and_journal(self):
-        backoff = min(self.backoff_cap,
-                      self.backoff_base * (2 ** (self.attempts - 1)))
+        from .backoff import backoff_delay
+        backoff = backoff_delay(self.attempts, self.backoff_base,
+                                self.backoff_cap)
         self._jwrite("retry", attempt=self.attempts,
                      backoff_s=round(backoff, 3))
         self.log(f"retry {self.attempts}/{self.max_retries} "

@@ -18,7 +18,11 @@ federated dispatch (arxiv 2606.02019) and streaming trace validation
   per pop; ``TenantLedger`` folds the accounting off the spool);
 * **http.py** — the wire API: ``serve --http PORT`` exposes
   submit/status/cancel/list plus chunked streaming of per-job
-  journals, stdlib ``http.server`` only.
+  journals, stdlib ``http.server`` only;
+* **guard.py** — the hardened front door (ISSUE 18): bearer-token
+  auth, TLS, request bounds, per-tenant token-bucket rate limits,
+  queue-depth backpressure, and the per-(tenant, spec) circuit
+  breaker — every rejection journaled and folded into telemetry.
 
 Imports are lazy (PEP 562) so the jax-free pieces (queue tooling,
 claim racers, shell-only workers) stay milliseconds to import.
@@ -34,6 +38,11 @@ _EXPORTS = {
     "is_light": ("multirunner", "is_light"),
     "ServiceHTTP": ("http", "ServiceHTTP"),
     "WorkerPool": ("pool", "WorkerPool"),
+    "Guard": ("guard", "Guard"),
+    "GuardDenied": ("guard", "GuardDenied"),
+    "TokenBucket": ("guard", "TokenBucket"),
+    "CircuitBreaker": ("guard", "CircuitBreaker"),
+    "spec_digest": ("guard", "spec_digest"),
 }
 
 __all__ = sorted(_EXPORTS)

@@ -264,3 +264,15 @@ class TenantLedger:
                 except (TypeError, ValueError):
                     pass
         return out
+
+    @staticmethod
+    def in_flight(jobs, tenant):
+        """One tenant's unfinished job count (everything not yet
+        terminal) — the number the guard's in-flight quota caps
+        (ISSUE 18), layered ON TOP of the DRR fair share: DRR decides
+        who runs next, the quota decides who may even enqueue more."""
+        key = tenant or "-"
+        return sum(1 for j in jobs
+                   if (j.tenant or "-") == key
+                   and j.state in ("queued", "admitted",
+                                   "preempted-requeued", "running"))

@@ -1,0 +1,525 @@
+"""Edge hardening + overload protection for the serving tier
+(ISSUE 18 tentpole).
+
+The HTTP front (``serve/http.py``) trusted every byte it received:
+no auth, no rate limits, no request bounds, and a crash-looping job
+could burn the pool's restart budget while occupying devices.  This
+module is the one place those policies live; the front and the worker
+only ask questions and map :class:`GuardDenied` onto wire codes:
+
+* **Bearer-token auth** — per-tenant tokens in a spool-local
+  ``tokens.json`` (``{"tenant": "secret", ...}``), verified with a
+  constant-time compare over EVERY entry (no early exit for a wrong
+  tenant, no timing oracle for token length prefixes).  A missing
+  ``tokens.json`` means open mode — exactly the pre-ISSUE-18 trust
+  model, so single-user spools keep working unchanged.  Missing or
+  wrong credentials are 401; a VALID token acting on another tenant's
+  behalf (cross-tenant submit or cancel) is 403.  Both are journaled
+  as ``auth_denied``.
+
+* **Per-tenant token-bucket rate limits** — ``rate`` requests/second
+  refill, ``burst`` capacity.  The bucket state is a **pure fold over
+  journal timestamps**, not wall-clock mutation: accepted submissions
+  are replayed off ``jobs.jsonl`` (the submit records the queue
+  already fsyncs) and denials off ``guard.jsonl``, merged in ts
+  order — so a fresh Guard over the same spool reconverges to the
+  same bucket state (the telemetry-fold discipline, ISSUE 17), and
+  the 429 ``Retry-After`` is computed from the deficit's refill time,
+  not guessed.
+
+* **Queue-depth backpressure** — a spool backlog past ``high_water``
+  means the fleet is saturated: new submissions get 503 with the
+  depth in the body (journaled ``backpressure``) instead of silently
+  growing an unbounded queue.
+
+* **Circuit breaker per (tenant, spec-digest)** — K engine failures
+  inside a rolling window trip the breaker OPEN (journaled
+  ``breaker_open``): further submissions of that same spec fail fast
+  with reason ``"breaker-open"`` before touching a device.  After a
+  cooldown (the shared bounded-exponential curve,
+  ``resilience/backoff.py`` — doubled on every re-trip) the breaker
+  HALF-OPENs: one probe runs; success closes it (journaled
+  ``breaker_close``), failure re-opens with a longer cooldown.
+
+Every rejection is journaled to ``<spool>/guard.jsonl`` (schema
+events ``auth_denied`` / ``rate_limited`` / ``backpressure`` /
+``breaker_open`` / ``breaker_close``), which the telemetry aggregator
+tails — so the abuse counters on ``/v1/metrics`` are journal-derived
+and restart-convergent like every other fold in the system.
+
+jax-free and engine-free: the front stays milliseconds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import math
+import os
+import threading
+
+from ..obs.journal import Journal
+
+#: default request body cap (bytes) — a submit body is a small JSON
+#: object; anything near this size is abuse or a bug
+MAX_BODY = 1 << 20
+
+#: default header/read timeout (seconds) for one HTTP request — the
+#: slow-loris reap: a connection that dribbles bytes slower than this
+#: is closed, not indulged
+REQUEST_TIMEOUT = 10.0
+
+
+class GuardDenied(Exception):
+    """A guarded request was rejected.  ``code`` is the HTTP status
+    the front maps it to (401/403/413/429/503), ``reason`` the
+    journaled/wire explanation, ``retry_after`` the 429 refill hint
+    (seconds, None = no header)."""
+
+    def __init__(self, code, reason, *, tenant=None, retry_after=None,
+                 depth=None):
+        super().__init__(reason)
+        self.code = int(code)
+        self.reason = reason
+        self.tenant = tenant
+        self.retry_after = retry_after
+        self.depth = depth
+
+
+def spec_digest(spec, cfg=None):
+    """The breaker's spec identity: one digest over (spec, cfg), so a
+    crash-looping submission trips its OWN breaker and never a
+    sibling spec's."""
+    h = hashlib.sha1()
+    h.update(str(spec).encode())
+    h.update(b"\x00")
+    h.update(str(cfg or "").encode())
+    return h.hexdigest()[:16]
+
+
+class TokenBucket:
+    """A token bucket advanced by EXPLICIT timestamps (never the wall
+    clock): ``advance(ts)`` refills ``rate`` tokens/second up to
+    ``burst``; ``take(ts)`` consumes one.  Folding the same (ts,
+    take/deny) sequence always lands in the same state — the
+    determinism the restart-convergence battery holds."""
+
+    __slots__ = ("rate", "burst", "tokens", "last_ts")
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self.last_ts = None
+
+    def advance(self, ts):
+        ts = float(ts)
+        if self.last_ts is None:
+            self.last_ts = ts
+            return
+        dt = ts - self.last_ts
+        if dt > 0:
+            self.tokens = min(self.burst, self.tokens + dt * self.rate)
+            self.last_ts = ts
+
+    def take(self, ts):
+        """Advance to ``ts`` and consume one token (flooring at zero —
+        the replay of an accepted submission must never go negative)."""
+        self.advance(ts)
+        self.tokens = max(0.0, self.tokens - 1.0)
+
+    def ok(self, ts):
+        self.advance(ts)
+        return self.tokens >= 1.0
+
+    def retry_after(self):
+        """Seconds until one full token exists — the 429 Retry-After
+        (integer-ceiled on the wire; at least 1)."""
+        if self.rate <= 0:
+            return None
+        need = max(0.0, 1.0 - self.tokens)
+        return need / self.rate
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open -> closed, driven by explicit
+    timestamps.  ``k`` failures inside ``window`` seconds trip it;
+    after a cooldown (bounded-exponential, doubled per re-trip) ONE
+    probe is allowed; probe success closes, probe failure re-opens."""
+
+    __slots__ = ("k", "window", "schedule", "failures", "state",
+                 "opened_ts", "cooldown", "trips", "probing")
+
+    def __init__(self, k=3, window=60.0, cooldown_base=2.0,
+                 cooldown_cap=300.0):
+        from ..resilience.backoff import BackoffSchedule
+        self.k = max(1, int(k))
+        self.window = float(window)
+        self.schedule = BackoffSchedule(cooldown_base, cooldown_cap)
+        self.failures = []       # recent failure timestamps
+        self.state = "closed"
+        self.opened_ts = None
+        self.cooldown = 0.0
+        self.trips = 0
+        self.probing = False
+
+    def allow(self, ts):
+        """May a run of this key proceed at ``ts``?  Half-open grants
+        exactly one in-flight probe per cooldown expiry."""
+        ts = float(ts)
+        if self.state == "closed":
+            return True
+        if self.state == "open" and ts - self.opened_ts >= self.cooldown:
+            self.state = "half-open"
+            self.probing = False
+        if self.state == "half-open" and not self.probing:
+            self.probing = True
+            return True
+        return False
+
+    def record(self, ok, ts):
+        """Fold one run outcome.  Returns ``"open"`` / ``"close"``
+        when this outcome transitioned the breaker (the caller
+        journals it), None otherwise."""
+        ts = float(ts)
+        if ok:
+            if self.state in ("half-open", "open"):
+                # a successful probe (or an out-of-band success)
+                # closes the breaker and resets the cooldown curve
+                self.state = "closed"
+                self.probing = False
+                self.failures = []
+                self.schedule.reset()
+                return "close"
+            self.failures = []
+            return None
+        if self.state == "half-open":
+            # the probe failed: re-open with a LONGER cooldown
+            self.state = "open"
+            self.probing = False
+            self.opened_ts = ts
+            self.cooldown = self.schedule.next()
+            self.trips += 1
+            return "open"
+        if self.state == "open":
+            return None
+        self.failures = [t for t in self.failures
+                         if ts - t <= self.window]
+        self.failures.append(ts)
+        if len(self.failures) >= self.k:
+            self.state = "open"
+            self.opened_ts = ts
+            self.cooldown = self.schedule.next()
+            self.trips += 1
+            self.failures = []
+            return "open"
+        return None
+
+
+class Guard:
+    """The serving tier's admission guard over one spool (see module
+    doc).  Thread-safe: the HTTP front's handler threads and the
+    worker share one instance."""
+
+    def __init__(self, spool, *, tokens_path=None, rate=None,
+                 burst=None, max_inflight=None, high_water=None,
+                 max_body=MAX_BODY, breaker_k=3, breaker_window=60.0,
+                 breaker_cooldown=2.0, breaker_cooldown_cap=300.0,
+                 log=None):
+        self.spool = os.path.abspath(spool)
+        self.tokens_path = (tokens_path if tokens_path is not None
+                            else os.path.join(self.spool,
+                                              "tokens.json"))
+        self.journal_path = os.path.join(self.spool, "guard.jsonl")
+        self.jobs_log = os.path.join(self.spool, "jobs.jsonl")
+        self.rate = None if rate is None else float(rate)
+        self.burst = (float(burst) if burst is not None
+                      else (self.rate if self.rate else 1.0))
+        self.max_inflight = (None if max_inflight is None
+                             else max(1, int(max_inflight)))
+        self.high_water = (None if high_water is None
+                           else max(1, int(high_water)))
+        self.max_body = int(max_body)
+        self.breaker_k = int(breaker_k)
+        self.breaker_window = float(breaker_window)
+        self.breaker_cooldown = float(breaker_cooldown)
+        self.breaker_cooldown_cap = float(breaker_cooldown_cap)
+        self.log = log
+        self._lock = threading.RLock()
+        self._tokens = None          # tenant -> secret
+        self._tokens_mtime = None
+        self._buckets = {}           # tenant -> TokenBucket
+        self._offsets = {}           # path -> consumed byte offset
+        self._breakers = {}          # (tenant, digest) -> CircuitBreaker
+
+    # -- journaling ----------------------------------------------------
+    def _journal(self, event, ts, **fields):
+        """Append one guard event at the DECISION's timestamp (the
+        explicit ``ts`` kwarg overrides the Journal's wall-clock
+        stamp), so the journaled fold replays the exact state the
+        live decision saw."""
+        j = Journal(self.journal_path, run_id="guard",
+                    trace_id="", span_id="", parent_span="")
+        try:
+            j.write(event, ts=round(float(ts), 3), **fields)
+        finally:
+            j.close()
+        # our own append is already folded into the live buckets:
+        # skip it when guard.jsonl is next tailed
+        try:
+            self._offsets[self.journal_path] = \
+                os.path.getsize(self.journal_path)
+        except OSError:
+            pass
+        if self.log:
+            self.log("guard: " + event + " "
+                     + " ".join(f"{k}={v}" for k, v in fields.items()))
+
+    # -- bearer-token auth ---------------------------------------------
+    @property
+    def auth_enabled(self):
+        return bool(self._load_tokens())
+
+    def _load_tokens(self):
+        """``tokens.json`` with an mtime cache — operators rotate
+        tokens by rewriting the file, no restart needed.  Absent or
+        unreadable means open mode."""
+        try:
+            mtime = os.path.getmtime(self.tokens_path)
+        except OSError:
+            self._tokens, self._tokens_mtime = None, None
+            return None
+        with self._lock:
+            if mtime != self._tokens_mtime:
+                try:
+                    with open(self.tokens_path) as f:
+                        doc = json.load(f)
+                    self._tokens = {str(k): str(v)
+                                    for k, v in dict(doc).items()}
+                except (OSError, ValueError, TypeError, AttributeError):
+                    self._tokens = None
+                self._tokens_mtime = mtime
+            return self._tokens
+
+    def authenticate(self, auth_header, *, ts, path=None):
+        """Authorization header -> the token's tenant.  Open mode
+        (no ``tokens.json``) returns None — no tenant is imposed.
+        Every failure journals ``auth_denied`` and raises 401."""
+        tokens = self._load_tokens()
+        if not tokens:
+            return None
+
+        def deny(reason):
+            self._journal("auth_denied", ts, reason=reason,
+                          path=path)
+            raise GuardDenied(401, reason)
+
+        if not auth_header:
+            deny("missing-authorization")
+        parts = str(auth_header).split(None, 1)
+        if len(parts) != 2 or parts[0].lower() != "bearer":
+            deny("not-a-bearer-token")
+        presented = parts[1].strip()
+        # constant-time over EVERY entry: compare_digest for each
+        # tenant, never an early exit on the first mismatch (a timing
+        # oracle would leak which tenant names exist)
+        matched = None
+        for tenant, secret in tokens.items():
+            if hmac.compare_digest(presented.encode(),
+                                   str(secret).encode()):
+                matched = tenant
+        if matched is None:
+            deny("unknown-token")
+        return matched
+
+    def authorize_tenant(self, auth_tenant, claimed, *, ts,
+                         path=None, action="submit"):
+        """The effective tenant of an authenticated request.  Open
+        mode (``auth_tenant`` None) passes ``claimed`` through; with
+        auth on, acting as ANOTHER tenant is a journaled 403 and an
+        unclaimed tenant defaults to the token's own."""
+        if auth_tenant is None:
+            return claimed
+        if claimed is not None and str(claimed) != str(auth_tenant):
+            reason = f"cross-tenant-{action}"
+            self._journal("auth_denied", ts, reason=reason,
+                          tenant=auth_tenant, claimed=str(claimed),
+                          path=path)
+            raise GuardDenied(403, reason, tenant=auth_tenant)
+        return auth_tenant
+
+    # -- the deterministic rate fold -----------------------------------
+    def _bucket(self, tenant):
+        key = tenant or "-"
+        b = self._buckets.get(key)
+        if b is None:
+            b = self._buckets[key] = TokenBucket(self.rate, self.burst)
+        return b
+
+    def _tail(self, path):
+        """Complete new lines of one journal since the last poll
+        (torn tails held back — the spool fold discipline)."""
+        pos = self._offsets.get(path, 0)
+        try:
+            if os.path.getsize(path) <= pos:
+                return
+        except OSError:
+            return
+        try:
+            with open(path) as f:
+                f.seek(pos)
+                while True:
+                    line = f.readline()
+                    if not line or not line.endswith("\n"):
+                        break
+                    self._offsets[path] = f.tell()
+                    if line.strip():
+                        yield line
+        except OSError:
+            return
+
+    def refresh(self):
+        """Fold journal lines appended since the last look into the
+        bucket state: accepted submissions off ``jobs.jsonl``, denials
+        off ``guard.jsonl``, merged in ts order — so the buckets are a
+        pure function of the journals and a fresh Guard reconverges
+        (incremental == fresh == restarted)."""
+        if self.rate is None:
+            return
+        with self._lock:
+            events = []              # (ts, taken?, tenant)
+            for line in self._tail(self.jobs_log):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("op") != "submit":
+                    continue
+                job = rec.get("job") or {}
+                ts = rec.get("ts", job.get("submitted_ts"))
+                if ts is None:
+                    continue
+                events.append((float(ts), True, job.get("tenant")))
+            for line in self._tail(self.journal_path):
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") != "rate_limited":
+                    continue
+                try:
+                    events.append((float(ev["ts"]), False,
+                                   ev.get("tenant")))
+                except (KeyError, TypeError, ValueError):
+                    continue
+            events.sort(key=lambda e: e[0])
+            for ts, taken, tenant in events:
+                b = self._bucket(tenant)
+                if taken:
+                    b.take(ts)
+                else:
+                    b.advance(ts)
+
+    def admit_submission(self, tenant, *, ts, inflight=None):
+        """May ``tenant`` submit at ``ts``?  Token-bucket rate first,
+        then the in-flight quota.  A denial journals ``rate_limited``
+        (advancing the folded clock exactly as a fresh fold would)
+        and raises 429 with the refill-derived Retry-After."""
+        # decisions run on ROUNDED ts — the same precision the journal
+        # records — so a fresh fold replays exactly this bucket state
+        ts = round(float(ts), 3)
+        with self._lock:
+            self.refresh()
+            if self.rate is not None:
+                b = self._bucket(tenant)
+                if not b.ok(ts):
+                    retry = b.retry_after()
+                    retry_s = (None if retry is None
+                               else max(1, int(math.ceil(retry))))
+                    self._journal(
+                        "rate_limited", ts,
+                        tenant=str(tenant or "-"),
+                        retry_after_s=round(retry or 0.0, 3),
+                        reason="rate")
+                    raise GuardDenied(
+                        429, f"rate limit: tenant {tenant or '-'} "
+                             f"over {self.rate:g} submits/s "
+                             f"(burst {self.burst:g})",
+                        tenant=tenant, retry_after=retry_s)
+            if self.max_inflight is not None and inflight is not None \
+                    and inflight >= self.max_inflight:
+                self._journal(
+                    "rate_limited", ts, tenant=str(tenant or "-"),
+                    retry_after_s=0.0, reason="inflight-quota",
+                    inflight=int(inflight))
+                raise GuardDenied(
+                    429, f"in-flight quota: tenant {tenant or '-'} "
+                         f"has {inflight} unfinished job(s) "
+                         f"(max {self.max_inflight})",
+                    tenant=tenant, retry_after=1)
+        # the accepted submission's jobs.jsonl record folds the token
+        # consumption on the next refresh — the bucket state stays
+        # journal-derived even on the accept path
+
+    # -- backpressure --------------------------------------------------
+    def admit_depth(self, depth, *, ts):
+        """503 when the queue backlog is past the high-water mark —
+        the spool must not become an unbounded buffer for a flood."""
+        if self.high_water is None or depth < self.high_water:
+            return
+        self._journal("backpressure", ts, depth=int(depth),
+                      high_water=int(self.high_water))
+        raise GuardDenied(
+            503, f"queue depth {depth} past high water "
+                 f"{self.high_water}", depth=int(depth))
+
+    # -- request bounds ------------------------------------------------
+    def check_body_size(self, length):
+        """413 on an oversized request body (checked off
+        Content-Length BEFORE the body is read — an abusive client
+        never makes the front buffer its payload)."""
+        if length is not None and int(length) > self.max_body:
+            raise GuardDenied(
+                413, f"body of {int(length)} bytes exceeds the "
+                     f"{self.max_body}-byte cap")
+
+    # -- the circuit breaker -------------------------------------------
+    def _breaker(self, tenant, digest):
+        key = (tenant or "-", digest)
+        br = self._breakers.get(key)
+        if br is None:
+            br = self._breakers[key] = CircuitBreaker(
+                self.breaker_k, self.breaker_window,
+                self.breaker_cooldown, self.breaker_cooldown_cap)
+        return br
+
+    def breaker_allow(self, tenant, digest, *, ts):
+        """May a run of (tenant, spec-digest) proceed?  False means
+        the breaker is open — the worker fails the job fast with
+        reason ``"breaker-open"`` before any device time."""
+        with self._lock:
+            return self._breaker(tenant, digest).allow(ts)
+
+    def breaker_record(self, tenant, digest, ok, *, ts):
+        """Fold one run outcome into the breaker; transitions are
+        journaled (``breaker_open`` / ``breaker_close``) so the
+        telemetry fold counts them restart-convergently."""
+        with self._lock:
+            br = self._breaker(tenant, digest)
+            moved = br.record(ok, ts)
+            if moved == "open":
+                self._journal(
+                    "breaker_open", ts, tenant=str(tenant or "-"),
+                    digest=digest, failures=int(self.breaker_k),
+                    cooldown_s=round(br.cooldown, 3),
+                    trips=br.trips)
+            elif moved == "close":
+                self._journal(
+                    "breaker_close", ts, tenant=str(tenant or "-"),
+                    digest=digest)
+            return moved
+
+    def breaker_state(self, tenant, digest):
+        br = self._breakers.get((tenant or "-", digest))
+        return br.state if br is not None else "closed"

@@ -43,6 +43,18 @@ drain loop; every request folds the spool through one shared
 RLock-guarded :class:`JobQueue`, so the front needs no coordination
 with workers beyond the spool itself — kill the front, jobs keep
 running; kill the workers, submissions keep landing.
+
+Hardening (ISSUE 18): every request passes the spool's
+:class:`~tpuvsr.serve.guard.Guard` first — bearer-token auth when
+``tokens.json`` exists (401 missing/unknown token, 403 cross-tenant
+submit/cancel), body-size cap off Content-Length (413, before the
+body is buffered), per-tenant token-bucket + in-flight quota (429
+with a refill-derived ``Retry-After``), and queue-depth backpressure
+(503 with the depth in the body).  ``/healthz`` stays open so load
+balancers can probe.  TLS is one ``ssl.SSLContext`` wrap of the
+listening socket (``--tls-cert/--tls-key``), and a per-connection
+read timeout reaps slow-loris clients — a connection that dribbles
+bytes slower than ``request_timeout`` is closed, not indulged.
 """
 
 from __future__ import annotations
@@ -55,6 +67,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..service.queue import TERMINAL, JobQueue, QueueError
+from .guard import REQUEST_TIMEOUT, Guard, GuardDenied
 
 #: job fields a POST /v1/jobs body may set (everything else is 400 —
 #: a typo'd field must not silently vanish)
@@ -72,7 +85,9 @@ class ServiceHTTP:
     poll tick)."""
 
     def __init__(self, spool, *, host="127.0.0.1", port=0, poll=0.15,
-                 max_stream_s=3600.0, log=None, slo=None):
+                 max_stream_s=3600.0, log=None, slo=None, guard=None,
+                 tls_cert=None, tls_key=None,
+                 request_timeout=REQUEST_TIMEOUT):
         self.spool = os.path.abspath(spool)
         self.queue = JobQueue(self.spool)
         self.poll = poll
@@ -86,13 +101,28 @@ class ServiceHTTP:
         self._telemetry = None
         self._telemetry_lock = threading.Lock()
         self._slo = slo
+        # the admission guard (ISSUE 18): a default Guard still caps
+        # body size and honours a spool-local tokens.json — the
+        # un-configured front is hardened, just not rate-limited
+        self.guard = guard if guard is not None else Guard(self.spool)
         svc = self
 
         class Handler(_Handler):
             service = svc
+            # per-connection read timeout: socketserver applies it to
+            # the socket, and BaseHTTPRequestHandler turns a timeout
+            # mid-request into close_connection — the slow-loris reap
+            timeout = request_timeout
 
         self.server = ThreadingHTTPServer((host, int(port)), Handler)
         self.server.daemon_threads = True
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or None)
+            self.server.socket = ctx.wrap_socket(
+                self.server.socket, server_side=True)
 
     @property
     def port(self):
@@ -101,7 +131,8 @@ class ServiceHTTP:
     @property
     def address(self):
         host, port = self.server.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self):
         self._thread = threading.Thread(
@@ -141,11 +172,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.service.log:
             self.service.log(f"http: {fmt % args}")
 
-    def _json(self, code, obj):
+    def _json(self, code, obj, headers=None):
         body = (json.dumps(obj, default=str) + "\n").encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -166,6 +199,26 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code, message):
         self._json(code, {"error": message})
 
+    def _deny(self, e):
+        """One :class:`GuardDenied` onto the wire: the status it
+        names, a JSON body with the reason, and the 429 refill hint
+        as a real ``Retry-After`` header."""
+        headers = {}
+        if e.retry_after is not None:
+            headers["Retry-After"] = str(int(e.retry_after))
+        doc = {"error": e.reason, "code": e.code}
+        if e.depth is not None:
+            doc["depth"] = e.depth
+        self._json(e.code, doc, headers=headers)
+
+    def _auth(self):
+        """The request's authenticated tenant (None in open mode).
+        Raises :class:`GuardDenied` 401 — already journaled — on a
+        missing or unknown bearer token."""
+        return self.service.guard.authenticate(
+            self.headers.get("Authorization"),
+            ts=round(time.time(), 3), path=self.path)
+
     def _body(self):
         n = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(n) if n else b""
@@ -182,6 +235,11 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in url.path.split("/") if p]
         q = self.service.queue
         try:
+            # /healthz stays unauthenticated (load-balancer probes);
+            # everything else needs a valid bearer token when auth is
+            # on (a 401 here is journaled `auth_denied` by the guard)
+            if parts != ["healthz"]:
+                self._auth()
             # telemetry routes fold journals, not the queue — they
             # take the aggregator's own lock, never the queue's
             if parts == ["v1", "metrics"]:
@@ -223,6 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
                     ("0", "", "false")
                 tail = int((qs.get("tail") or ["0"])[0])
                 return self._stream_events(parts[2], follow, tail)
+        except GuardDenied as e:
+            return self._deny(e)
         except QueueError as e:
             return self._error(404, str(e))
         except (ValueError, TypeError) as e:
@@ -231,9 +291,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — stdlib hook
         from ..service.api import job_doc
+        from .fairshare import TenantLedger
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         q = self.service.queue
+        guard = self.service.guard
+        now = round(time.time(), 3)
+        try:
+            auth_tenant = self._auth()
+            # the body cap is enforced off Content-Length BEFORE the
+            # body is read — an oversized payload is never buffered
+            guard.check_body_size(
+                self.headers.get("Content-Length") or 0)
+        except GuardDenied as e:
+            return self._deny(e)
         try:
             body = self._body()
         except (ValueError, TypeError) as e:
@@ -250,7 +321,21 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._error(
                         400, f"unknown kind {body.get('kind')!r} "
                              f"(one of {list(KINDS)})")
+                # cross-tenant submit is 403; with auth on, an
+                # unclaimed tenant defaults to the token's own
+                tenant = guard.authorize_tenant(
+                    auth_tenant, body.get("tenant"), ts=now,
+                    path=self.path, action="submit")
                 with q.lock():
+                    q.refresh()
+                    # overload checks, cheapest-signal first:
+                    # 503 on backlog past high water, then the
+                    # tenant's token bucket / in-flight quota (429)
+                    guard.admit_depth(q.backlog(), ts=now)
+                    guard.admit_submission(
+                        tenant, ts=now,
+                        inflight=TenantLedger.in_flight(
+                            q.jobs(), tenant))
                     job = q.submit(
                         body["spec"], cfg=body.get("cfg"),
                         engine=body.get("engine", "auto"),
@@ -260,7 +345,7 @@ class _Handler(BaseHTTPRequestHandler):
                         devices=body.get("devices", 1),
                         devices_min=body.get("devices_min"),
                         devices_max=body.get("devices_max"),
-                        tenant=body.get("tenant"),
+                        tenant=tenant,
                         job_id=body.get("job_id"))
                     return self._json(200, job_doc(q, job))
             if len(parts) == 4 and parts[:2] == ["v1", "jobs"] \
@@ -268,11 +353,17 @@ class _Handler(BaseHTTPRequestHandler):
                 with q.lock():
                     q.refresh()
                     job = q.get(parts[2])       # 404 before 409
+                    # cancelling another tenant's job is 403
+                    guard.authorize_tenant(
+                        auth_tenant, job.tenant, ts=now,
+                        path=self.path, action="cancel")
                     try:
                         job = q.cancel(parts[2])
                     except QueueError as e:
                         return self._error(409, str(e))
                     return self._json(200, job_doc(q, job))
+        except GuardDenied as e:
+            return self._deny(e)
         except QueueError as e:
             return self._error(404, str(e))
         except (ValueError, TypeError) as e:

@@ -27,11 +27,15 @@ swept onto the survivors.  Clean exits (rc 0, a finished --drain) are
 never respawned, and a slot that keeps dying stays down once its
 budget is spent.
 
-Device groups are sized, not pinned: each child gets ``--devices
-total//N`` (its DevicePool budget).  On the CPU stub harness every
-process sees its own virtual devices, so groups never collide; real
-multi-host TPU pinning (per-process device lists) is the documented
-residual on ROADMAP item 2.
+Device groups are PINNED, not just sized (ISSUE 18 — closes the PR 14
+residual): :meth:`WorkerPool.device_group` carves the device budget
+into DISJOINT ``(lo, count)`` slices, one per worker slot, and
+``_spawn`` exports the slice to the child as
+``TPUVSR_DEVICE_GROUP="lo:count"`` plus ``TPU_VISIBLE_CHIPS`` (the
+TPU-VM runtime's own visibility list, ``JAX_VISIBLE_DEVICES``-style)
+— so a dying job can only ever poison its own slot's chips, never a
+sibling's mesh.  A respawned slot inherits the same slice: pinning
+survives the crash it exists to contain.
 
 Workers that only ever claim light jobs (shell / interp-validate /
 lint-only) never import jax — a shell-only fleet starts in well under
@@ -94,13 +98,33 @@ class WorkerPool:
         self.respawned = 0         # total respawns this pool lifetime
         self._journal = None
 
+    def device_group(self, i):
+        """Worker slot ``i``'s pinned device slice as ``(lo, count)``
+        — DISJOINT across slots (remainder devices go to the lowest
+        slots), so two workers can never share a chip.  None when the
+        pool is un-sized (``devices=None``) or the slot has no device
+        left (more workers than devices: the extras run unpinned
+        light work)."""
+        if self.devices is None or i >= self.workers:
+            return None
+        total = int(self.devices)
+        if total < 1:
+            return None
+        base, rem = divmod(total, self.workers)
+        count = base + (1 if i < rem else 0)
+        if count < 1:
+            return None
+        lo = i * base + min(i, rem)
+        return (lo, count)
+
     def _cmd(self, i):
         cmd = [self.python, "-m", "tpuvsr", "serve",
                "--spool", self.spool, "--worker-id", f"w{i}"]
         if self.drain:
             cmd.append("--drain")
         if self.devices is not None:
-            per = max(1, int(self.devices) // self.workers)
+            group = self.device_group(i)
+            per = group[1] if group else 1
             cmd += ["--devices", str(per)]
         if self.max_seconds is not None:
             cmd += ["--max-seconds", str(self.max_seconds)]
@@ -108,17 +132,26 @@ class WorkerPool:
             cmd += ["--max-jobs", str(self.max_jobs)]
         return cmd + self.extra_args
 
-    def _env(self):
-        if self.env is not None:
-            return self.env
-        return child_env()
+    def _env(self, i=None):
+        env = dict(self.env) if self.env is not None else child_env()
+        group = None if i is None else self.device_group(i)
+        if group is not None:
+            # the pinning contract (ISSUE 18): the child's DevicePool
+            # budget is the slice SIZE, and the runtime-visible chip
+            # list is the slice MEMBERS — disjoint per slot, so a
+            # crashing job cannot poison a sibling worker's mesh
+            lo, count = group
+            chips = ",".join(str(d) for d in range(lo, lo + count))
+            env["TPUVSR_DEVICE_GROUP"] = f"{lo}:{count}"
+            env["TPU_VISIBLE_CHIPS"] = chips
+        return env
 
     def _spawn(self, i):
         log_path = os.path.join(self.log_dir, f"w{i}.log")
         fh = open(log_path, "ab")
         p = subprocess.Popen(
             self._cmd(i), stdout=fh, stderr=subprocess.STDOUT,
-            env=self._env(), cwd=self.spool)
+            env=self._env(i), cwd=self.spool)
         fh.close()                        # the child holds its own fd
         p._tpuvsr_log = log_path
         return p
@@ -171,8 +204,10 @@ class WorkerPool:
                 continue
             if now < self._next_try.get(i, 0.0):
                 continue
+            from ..resilience.backoff import backoff_delay
             self._restarts[i] = n + 1
-            self._next_try[i] = now + self.restart_backoff * (2 ** n)
+            self._next_try[i] = now + backoff_delay(
+                n + 1, self.restart_backoff)
             self.procs[i] = self._spawn(i)
             self.respawned += 1
             out.append(i)

@@ -215,6 +215,46 @@ def build_parser():
                          "cross-backend throughput advisory "
                          "(default: the repo root)")
     sv.add_argument("--quiet", action="store_true")
+    # front-door hardening (ISSUE 18) — see serve/guard.py: auth is
+    # on whenever <spool>/tokens.json (or --auth-tokens) exists; the
+    # limiter / backpressure / breaker knobs are opt-in
+    sv.add_argument("--tls-cert", default=None, metavar="PEM",
+                    help="serve the HTTP front over TLS with this "
+                         "certificate chain")
+    sv.add_argument("--tls-key", default=None, metavar="PEM",
+                    help="private key for --tls-cert (omit when the "
+                         "key is in the cert file)")
+    sv.add_argument("--auth-tokens", default=None, metavar="JSON",
+                    help="per-tenant bearer tokens file (default "
+                         "<spool>/tokens.json; absent = open mode)")
+    sv.add_argument("--rate", type=float, default=None,
+                    metavar="PER_S",
+                    help="per-tenant token-bucket refill "
+                         "(submissions/second; denials are 429 with "
+                         "Retry-After)")
+    sv.add_argument("--burst", type=float, default=None,
+                    help="token-bucket capacity (default: --rate)")
+    sv.add_argument("--max-inflight", type=int, default=None,
+                    metavar="N",
+                    help="per-tenant cap on unfinished jobs (429 "
+                         "past it)")
+    sv.add_argument("--high-water", type=int, default=None,
+                    metavar="N",
+                    help="queue-depth backpressure: 503 new "
+                         "submissions while the backlog exceeds N")
+    sv.add_argument("--max-body", type=int, default=None,
+                    metavar="BYTES",
+                    help="request body cap (413 past it; default "
+                         "1 MiB)")
+    sv.add_argument("--breaker-threshold", type=int, default=3,
+                    metavar="K",
+                    help="circuit breaker: trip a (tenant, spec) "
+                         "after K failures in --breaker-window "
+                         "seconds (fail-fast 'breaker-open')")
+    sv.add_argument("--breaker-window", type=float, default=60.0)
+    sv.add_argument("--breaker-cooldown", type=float, default=2.0,
+                    help="seconds before a tripped breaker half-opens "
+                         "for one probe (doubles per re-trip)")
 
     st = sub.add_parser("status", help="queue / per-job status")
     st.add_argument("job_id", nargs="?", default=None)
@@ -595,6 +635,25 @@ def _policy_from_args(args):
     return FairSharePolicy(weights=weights, age_every=args.age_every)
 
 
+def _guard_from_args(args, spool):
+    """The serve verb's admission guard (ISSUE 18).  Always built —
+    a default Guard still enforces the body cap and honours a
+    spool-local tokens.json — with the limiter / backpressure /
+    breaker knobs layered on from the flags."""
+    from ..serve.guard import Guard
+    kw = {}
+    if args.auth_tokens is not None:
+        kw["tokens_path"] = args.auth_tokens
+    if args.max_body is not None:
+        kw["max_body"] = args.max_body
+    return Guard(
+        spool, rate=args.rate, burst=args.burst,
+        max_inflight=args.max_inflight, high_water=args.high_water,
+        breaker_k=args.breaker_threshold,
+        breaker_window=args.breaker_window,
+        breaker_cooldown=args.breaker_cooldown, **kw)
+
+
 def _serve_pool(args, q, log, t0, http):
     """``serve --workers N`` (N > 1): spawn N worker subprocesses
     over the spool and stay a thin supervisor — sweep stale claims on
@@ -615,6 +674,11 @@ def _serve_pool(args, q, log, t0, http):
         passthrough += ["--tpu-devices", str(args.tpu_devices)]
     if args.bench_dir is not None:
         passthrough += ["--bench-dir", args.bench_dir]
+    # the breaker runs IN the workers (it guards device time): each
+    # child builds its own guard from the same thresholds
+    passthrough += ["--breaker-threshold", str(args.breaker_threshold),
+                    "--breaker-window", str(args.breaker_window),
+                    "--breaker-cooldown", str(args.breaker_cooldown)]
     if args.quiet:
         passthrough.append("--quiet")
     pool = WorkerPool(
@@ -650,10 +714,13 @@ def cmd_serve(args):
     log = (None if args.quiet
            else lambda m: print(f"[tpuvsr] {m}", file=sys.stderr))
     t0 = time.time()
+    guard = _guard_from_args(args, q.spool)
     http = None
     if args.http is not None:
         from ..serve.http import ServiceHTTP
-        http = ServiceHTTP(q.spool, port=args.http, log=log).start()
+        http = ServiceHTTP(q.spool, port=args.http, log=log,
+                           guard=guard, tls_cert=args.tls_cert,
+                           tls_key=args.tls_key).start()
         print(f"[tpuvsr] http front: {http.address}", file=sys.stderr)
     try:
         if args.workers == 0:
@@ -689,10 +756,21 @@ def cmd_serve(args):
         if tpu is None:
             from .scheduler import detect_tpu_devices
             tpu = detect_tpu_devices()
-        w = Worker(q, devices=args.devices, log=log,
+        devices = args.devices
+        if devices is None:
+            # a pool child with a pinned device group (ISSUE 18):
+            # its DevicePool budget IS the slice size — never count
+            # the whole host's devices from inside a pinned slot
+            group = os.environ.get("TPUVSR_DEVICE_GROUP")
+            if group and ":" in group:
+                try:
+                    devices = max(1, int(group.split(":")[1]))
+                except ValueError:
+                    pass
+        w = Worker(q, devices=devices, log=log,
                    tpu_devices=tpu, bench_dir=args.bench_dir,
                    owner=args.worker_id, policy=policy,
-                   light_threads=args.light_threads)
+                   light_threads=args.light_threads, guard=guard)
         runs = w.drain(max_jobs=args.max_jobs,
                        max_seconds=args.max_seconds,
                        idle_exit=args.drain)

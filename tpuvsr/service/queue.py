@@ -328,6 +328,14 @@ class JobQueue:
         out["total"] = len(self._jobs)
         return out
 
+    def backlog(self):
+        """Jobs waiting for a worker (queued + admitted +
+        preempted-requeued) — the depth the guard's high-water
+        backpressure judges (ISSUE 18).  Running jobs don't count:
+        they hold devices, not queue headroom."""
+        return sum(1 for j in self._jobs.values()
+                   if j.state in ("queued",) or j.state in CLAIMABLE)
+
     def cancel_requested(self, job_id):
         return os.path.exists(self._cancel_marker(job_id))
 

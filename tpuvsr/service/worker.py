@@ -127,8 +127,12 @@ class Worker:
                  log=None, on_level=None, owner=None, poll=0.25,
                  bench_dir=None, tpu_devices=0, shell_retry_gate=None,
                  policy="auto", light_threads=2,
-                 hb_journal_every=30.0):
+                 hb_journal_every=30.0, guard=None):
         self.queue = queue
+        # the serving-tier admission guard (ISSUE 18): when present,
+        # its per-(tenant, spec-digest) circuit breaker is consulted
+        # BEFORE any device allocation and fed every terminal outcome
+        self.guard = guard
         if devices is None:
             import jax
             devices = len(jax.devices())
@@ -398,6 +402,8 @@ class Worker:
         if getattr(job, "trace_id", None):
             self._spans[job.job_id] = new_span_id()
         try:
+            if self._breaker_blocks(job):
+                return None
             if job.kind == "shell":
                 return self._run_shell(job)
             if job.kind == "sim":
@@ -426,6 +432,8 @@ class Worker:
         if getattr(job, "trace_id", None):
             self._spans[job.job_id] = new_span_id()
         try:
+            if self._breaker_blocks(job):
+                return
             if job.kind == "shell":
                 self._run_shell(job)
             elif job.kind == "validate":
@@ -508,11 +516,39 @@ class Worker:
                              "warnings": len(report.warnings)},
                      reason="speclint" if report.exit_code else None)
 
+    def _breaker_blocks(self, job):
+        """Fail a job fast — reason ``"breaker-open"`` — when its
+        (tenant, spec-digest) circuit breaker is open (ISSUE 18): a
+        crash-looping spec must stop consuming device time after K
+        failures.  The check runs BEFORE any scheduler allocation;
+        the half-open probe after cooldown is the one run allowed
+        through to test recovery."""
+        if self.guard is None:
+            return False
+        from ..serve.guard import spec_digest
+        digest = spec_digest(job.spec, job.cfg)
+        if self.guard.breaker_allow(job.tenant, digest,
+                                    ts=time.time()):
+            return False
+        self._finish(job, "failed", reason="breaker-open")
+        return True
+
     def _finish(self, job, state, **kw):
         self.queue.finish(job.job_id, state, **kw)
         self._journal(job, "job_done", state=state,
                       reason=kw.get("reason"))
         self.processed.append((job.job_id, state))
+        # feed the circuit breaker every REAL terminal outcome:
+        # `failed` is a breaker failure, `done`/`violated` successes
+        # (a counterexample is the engine working, not crashing);
+        # breaker-open fast-fails must not re-count as failures or an
+        # open breaker would feed itself
+        if self.guard is not None and kw.get("reason") != "breaker-open" \
+                and state in ("done", "violated", "failed"):
+            from ..serve.guard import spec_digest
+            self.guard.breaker_record(
+                job.tenant, spec_digest(job.spec, job.cfg),
+                state != "failed", ts=time.time())
         self.log(f"job {job.job_id}: {state}"
                  + (f" ({kw.get('reason')})" if kw.get("reason")
                     else ""))
