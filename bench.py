@@ -684,6 +684,7 @@ def _attach_and_lift():
         RESULT["defect_tpu_distinct_per_s"] = dw.get("distinct_per_s")
         RESULT["defect_tpu_vs_cpu_window"] = dw.get("vs_cpu_window_1160")
     _embed_telemetry()
+    _embed_spool()
 
 
 def _embed_telemetry():
@@ -742,6 +743,49 @@ def _embed_telemetry():
         RESULT["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _embed_spool():
+    """Embed spool-driver op rates in the round doc (ISSUE 20): time
+    record appends, claim/release cycles and a full-stream fold on a
+    throwaway spool for each driver (fs / objstore / quorum), so
+    rounds carry the data plane's control-path cost next to the
+    engine headline.  ``scripts/compare_bench.py``'s ``gate_spool``
+    diffs the rates between rounds at MATCHING drivers; cross-driver
+    spreads (quorum pays W-replica fsyncs per append) are expected
+    and advisory only."""
+    import shutil
+    import tempfile
+    out = {}
+    n_app, n_claim = 256, 64
+    for name in ("fs", "objstore", "quorum"):
+        tmp = tempfile.mkdtemp(prefix=f"tpuvsr-bench-spool-{name}-")
+        try:
+            from tpuvsr.service.spooldrv import open_driver
+            drv = open_driver(os.path.join(tmp, "spool"), driver=name)
+            t0 = time.time()
+            for i in range(n_app):
+                drv.append("bench", {"op": "tick", "i": i})
+            t_app = time.time() - t0
+            t0 = time.time()
+            for i in range(n_claim):
+                drv.try_claim(f"j{i:04d}", owner="bench", epoch=1)
+                drv.release_claim(f"j{i:04d}", epoch=1)
+            t_claim = time.time() - t0
+            t0 = time.time()
+            recs, _ = drv.read("bench", None)
+            t_fold = time.time() - t0
+            out[name] = {
+                "appends_per_s": round(n_app / max(t_app, 1e-9), 1),
+                "claims_per_s": round(n_claim / max(t_claim, 1e-9), 1),
+                "fold_ms": round(t_fold * 1000.0, 2),
+                "records_folded": len(recs),
+            }
+        except Exception as e:  # noqa: BLE001 — never kills bench
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    RESULT["spool"] = out
 
 
 def _stub_round(reason):

@@ -502,6 +502,68 @@ def gate_guard(base_doc, cand_doc, max_regression):
     return 0
 
 
+def spool_stats(doc):
+    """Spool data-plane health of a document (ISSUE 20): the
+    per-driver op-rate dict ``{driver: {appends_per_s, claims_per_s,
+    fold_ms}}`` from the round doc's embedded ``spool`` section, or
+    None."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    s = doc.get("spool")
+    if not isinstance(s, dict):
+        return None
+    out = {k: v for k, v in s.items()
+           if isinstance(v, dict) and "appends_per_s" in v}
+    return out or None
+
+
+def gate_spool(base_doc, cand_doc, max_regression):
+    """The spool-driver op-rate gate (ISSUE 20): 0 ok/advisory/
+    absent, 1 when — at a MATCHING driver — the candidate's append
+    or claim rate dropped beyond tolerance, or its fold latency
+    grew beyond it.  The data plane's control path (every job
+    transition is an append; every claim is a conditional put) must
+    not regress underneath the engines.  Drivers present in only one
+    document, and cross-driver spreads (quorum pays W-replica fsyncs
+    per append), are advisory."""
+    base, cand = spool_stats(base_doc), spool_stats(cand_doc)
+    if base is None or cand is None:
+        return 0
+    rc = 0
+    tol = 1.0 - max_regression / 100.0
+    for drv in sorted(base):
+        if drv not in cand:
+            print(f"  spool.{drv}: only in baseline (advisory)")
+            continue
+        b, c = base[drv], cand[drv]
+        for key in ("appends_per_s", "claims_per_s"):
+            bv, cv = b.get(key), c.get(key)
+            if bv is None or cv is None:
+                continue
+            print(f"spool.{drv}.{key}: baseline {bv:.1f} -> "
+                  f"candidate {cv:.1f}  [{fmt_delta(bv, cv)}]")
+            if bv > 0 and cv < bv * tol:
+                print(f"compare_bench: spool {drv} {key} "
+                      f"REGRESSION beyond {max_regression:.1f}% "
+                      f"tolerance (data-plane control path slowed "
+                      f"down)", file=sys.stderr)
+                rc = 1
+        bf, cf = b.get("fold_ms"), c.get("fold_ms")
+        if bf is not None and cf is not None:
+            print(f"spool.{drv}.fold_ms: baseline {bf:.2f} -> "
+                  f"candidate {cf:.2f}")
+            if bf > 0 and cf > bf / max(tol, 1e-9):
+                print(f"compare_bench: spool {drv} fold latency "
+                      f"REGRESSION beyond {max_regression:.1f}% "
+                      f"tolerance", file=sys.stderr)
+                rc = 1
+    for drv in sorted(set(cand) - set(base)):
+        print(f"  spool.{drv}: only in candidate (advisory)")
+    return rc
+
+
 def liveness_stats(doc):
     """Liveness-path health of a document (ISSUE 15):
     ``(edges_per_s, check_s, mode, overhead)`` or all-None.  Reads
@@ -717,8 +779,12 @@ def main(argv=None):
     # configs; policy mismatches and abuse-drill counter drift are
     # advisory
     grd_rc = gate_guard(base_doc, cand_doc, args.max_regression)
+    # the spool data plane likewise (ISSUE 20): append/claim rate
+    # drops and fold-latency growth fail at matching drivers;
+    # cross-driver spreads are advisory
+    spl_rc = gate_spool(base_doc, cand_doc, args.max_regression)
     sim_rc = (sim_rc or val_rc or pack_rc or sym_rc or liv_rc
-              or por_rc or tel_rc or grd_rc
+              or por_rc or tel_rc or grd_rc or spl_rc
               or (1 if occ_regressed else 0))
 
     if base > 0 and cand < base * (1.0 - args.max_regression / 100.0):

@@ -110,6 +110,24 @@ reference mount, no TPU, seconds on the CPU backend:
   slow-loris-reap    a client that sends half a request line and
                      stalls is reaped by the per-connection read
                      timeout; the service stays fully responsive
+  host-death-failover  an ENTIRE host (pool parent + worker, one
+                     process) is SIGKILLed mid-sharded-job and its
+                     local checkpoint dir dies with it (ISSUE 20) ->
+                     the survivor host's recover_stale sweeps the dead
+                     host's claims by its stale LEASE, restores the
+                     rescue from the quorum driver's blob store, and
+                     resumes to a verdict bit-identical to an oracle's
+  spool-replica-loss one replica of the quorum spool deleted
+                     mid-drain (ISSUE 20) -> the service is unaffected
+                     (appends still reach write quorum), replica_lost
+                     journaled; recreating the dir heals via
+                     anti-entropy — replica_rejoin journaled, replica
+                     logs byte-identical
+  zombie-fence       a recovered-then-revived worker tries to commit
+                     its stale terminal state (ISSUE 20) -> the
+                     claim-epoch fence rejects the append
+                     (FencedError, journaled ``fence``); the
+                     successor's verdict stands: exactly-once
   kill-liveness-resume  SIGTERM mid-graph-build on a STREAMED temporal
                      run (ISSUE 15: edges flowing out of the fused
                      commit) -> rescue snapshot carrying gid column +
@@ -1438,6 +1456,233 @@ def scenario_slow_loris_reap(tmp):
             "healthz_after": healthy}
 
 
+def _spool_events(spool):
+    from tpuvsr.obs import read_journal
+    path = os.path.join(spool, "spool.jsonl")
+    return read_journal(path) if os.path.exists(path) else []
+
+
+#: the doomed POOL PARENT (host-death-failover): registers its host's
+#: lease through the spool driver, then runs its worker — and SIGKILLs
+#: the whole process at the depth-2 tick, after the level-1 checkpoint
+#: has landed locally AND the same tick's replicate_snapshot() shipped
+#: it into the driver blob store (fake host identity via TPUVSR_HOST)
+_DOOMED_POOL = """\
+import os, signal, sys
+from tpuvsr.service.queue import JobQueue
+from tpuvsr.service.worker import Worker
+
+q = JobQueue(sys.argv[1])
+q.host_heartbeat()                 # the pool parent's host lease
+
+def on_level(worker, job, depth):
+    if depth >= 2:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+Worker(q, devices=2, owner="poolA-w0",
+       on_level=on_level, light_threads=0).drain(max_jobs=1)
+"""
+
+
+def scenario_host_death_failover(tmp):
+    """ISSUE 20: an ENTIRE HOST dies mid-sharded-job — the pool
+    parent (which wrote host-lease heartbeats through the spool
+    driver) and its worker are one SIGKILLed process, and the host's
+    local checkpoint directory AND its spool replica die with it
+    (the quorum keeps serving on the remaining majority).  The
+    survivor host's
+    ``recover_stale`` judges the dead host by its stale LEASE (claim
+    heartbeats are irrelevant: heartbeat_timeout is an hour), sweeps
+    its claim in one pass, restores the rescue from the DRIVER-HELD
+    snapshot blob, and resumes the sharded job to a verdict
+    bit-identical to an undisturbed oracle job's."""
+    import subprocess
+    import time
+    from tpuvsr.obs import read_journal
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    from tpuvsr.testing import subprocess_env
+    flags = {"stub": True, "inv_x_bound": 2}
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool, driver="quorum", host_lease_timeout=1.0,
+                 heartbeat_timeout=3600.0)
+    doomed = q.submit("<stub:doomed>", engine="sharded", devices=2,
+                      flags=dict(flags))
+    env = subprocess_env({"TPUVSR_HOST": "hostA"})
+    p = subprocess.run([sys.executable, "-c", _DOOMED_POOL, spool],
+                       env=env, capture_output=True, text=True,
+                       timeout=300)
+    killed = p.returncode in (-9, 137)
+    # hostA's disk dies with the host: the job's local checkpoint
+    # directory is gone — only the driver-held blob can seed a rescue
+    shutil.rmtree(q.checkpoint_path(doomed.job_id),
+                  ignore_errors=True)
+    # ... and so does hostA's spool replica: the quorum keeps serving
+    # (and the replicated blob survives) on the remaining majority
+    shutil.rmtree(os.path.join(spool, "replicas", "r0"),
+                  ignore_errors=True)
+    blob_held = q.drv.get_blob(f"ckpt-{doomed.job_id}.tar") is not None
+    time.sleep(1.2)                    # hostA's lease goes stale
+    os.environ["TPUVSR_HOST"] = "hostB"
+    try:
+        qb = JobQueue(spool, host_lease_timeout=1.0,
+                      heartbeat_timeout=3600.0)
+        qb.host_heartbeat()
+        dead = sorted(qb.dead_hosts())
+        recovered = qb.recover_stale()
+        Worker(qb, devices=2, owner="poolB-w0",
+               light_threads=0).drain()
+    finally:
+        os.environ.pop("TPUVSR_HOST", None)
+    jd = qb.get(doomed.job_id)
+    evs = read_journal(qb.journal_path(doomed.job_id))
+    req = [e for e in evs if e["event"] == "job_requeued"]
+    # the undisturbed oracle: the same sharded job on a fresh spool
+    qo = JobQueue(os.path.join(tmp, "oracle"))
+    oj = qo.submit("<stub:oracle>", engine="sharded", devices=2,
+                   flags=dict(flags))
+    Worker(qo, devices=2, light_threads=0).drain()
+    oracle = qo.get(oj.job_id)
+    live = (qb.spool_status()["replicas"] or {}).get("live")
+    ok = (killed and blob_held and dead == ["hostA"]
+          and live == 2
+          and doomed.job_id in recovered
+          and jd.state == "violated" and jd.attempts == 2
+          and len(req) == 1 and req[0].get("dead_host") == "hostA"
+          and (req[0].get("rescue") or {}).get("depth", 0) >= 1
+          and oracle.state == "violated"
+          and jd.result["violated"] == oracle.result["violated"]
+          and jd.result["trace"] == oracle.result["trace"]
+          and jd.result["distinct"] == oracle.result["distinct"])
+    return {
+        "ok": ok, "killed_rc": p.returncode, "blob_held": blob_held,
+        "replicas_live": live,
+        "dead_hosts": dead, "state": jd.state,
+        "attempts": jd.attempts,
+        "dead_host_in_requeue": req[0].get("dead_host") if req
+        else None,
+        "rescue_depth": (req[0].get("rescue") or {}).get("depth")
+        if req else None,
+        "trace_identical": (jd.result or {}).get("trace")
+        == (oracle.result or {}).get("trace"),
+    }
+
+
+def scenario_spool_replica_loss(tmp):
+    """ISSUE 20: one replica of the quorum spool is DELETED mid-drain.
+    The service is unaffected (appends still reach write quorum, jobs
+    keep completing with the exact fixpoint), the loss is journaled as
+    ``replica_lost`` in the spool's own journal, and recreating the
+    replica directory lets anti-entropy heal it back — journaled
+    ``replica_rejoin``, replica log byte-identical to a surviving
+    one's."""
+    ORACLE = _oracle()
+    from tpuvsr.service.queue import JobQueue
+    from tpuvsr.service.worker import Worker
+    spool = os.path.join(tmp, "spool")
+    q = JobQueue(spool, driver="quorum")
+    j1 = q.submit("<stub:1>", engine="device", flags={"stub": True})
+    j2 = q.submit("<stub:2>", engine="device", flags={"stub": True})
+    Worker(q, devices=1, light_threads=0).drain(max_jobs=1)
+    r1 = os.path.join(spool, "replicas", "r1")
+    shutil.rmtree(r1)                  # mid-drain: one replica dies
+    # the ordinary drain loop keeps going — recover_stale inside it
+    # runs the driver's housekeeping, which detects the loss
+    Worker(q, devices=1, light_threads=0).drain()
+    st_lost = q.spool_status()["replicas"]
+    j3 = q.submit("<stub:3>", engine="device", flags={"stub": True})
+    Worker(q, devices=1, light_threads=0).drain()
+    jobs = [q.get(j.job_id) for j in (j1, j2, j3)]
+    # rejoin: the operator recreates the directory; the next sweep's
+    # anti-entropy copies the missing frames back, prefix-preserving
+    os.makedirs(r1)
+    q.recover_stale()
+    st_back = q.spool_status()["replicas"]
+    with open(os.path.join(spool, "replicas", "r0",
+                           "jobs.jsonl"), "rb") as f:
+        b0 = f.read()
+    with open(os.path.join(r1, "jobs.jsonl"), "rb") as f:
+        b1 = f.read()
+    ev = [e["event"] for e in _spool_events(spool)]
+    ok = (st_lost and st_lost["live"] == 2 and st_lost["total"] == 3
+          and all(j.state == "done"
+                  and j.result["distinct"] == ORACLE["distinct"]
+                  and j.result["levels"] == ORACLE["levels"]
+                  for j in jobs)
+          and st_back and st_back["live"] == 3
+          and b0 == b1 and len(b0) > 0
+          and "replica_lost" in ev and "replica_rejoin" in ev)
+    return {
+        "ok": ok, "replicas_after_loss": st_lost,
+        "replicas_after_rejoin": st_back,
+        "jobs_done_through_loss": [j.state for j in jobs],
+        "replica_log_byte_identical": b0 == b1,
+        "spool_events": [e for e in ev
+                         if e in ("replica_lost", "replica_rejoin")],
+    }
+
+
+def scenario_zombie_fence(tmp):
+    """ISSUE 20: a worker that was recovered (its claim swept, the
+    job re-run by a successor) REVIVES and tries to commit its stale
+    outcome.  Claim-epoch fencing rejects the zombie's terminal
+    append — FencedError, a ``fence`` event in the spool journal —
+    so the successor's verdict stands untouched: exactly-once."""
+    ORACLE = _oracle()
+    import time
+    from tpuvsr.service.queue import FencedError, JobQueue
+    from tpuvsr.service.worker import Worker
+    spool = os.path.join(tmp, "spool")
+    q1 = JobQueue(spool, driver="objstore", heartbeat_timeout=0.2)
+    job = q1.submit("<stub>", engine="device", flags={"stub": True})
+    q1.transition(job.job_id, "admitted")
+    # the zombie claims from a "remote" host (a same-host claim would
+    # be judged by its live pid, not by heartbeat staleness)...
+    os.environ["TPUVSR_HOST"] = "hostZ"
+    try:
+        claimed = q1.claim(job.job_id, owner="wZ") is not None
+    finally:
+        os.environ.pop("TPUVSR_HOST", None)
+    time.sleep(0.3)                    # ...then stalls: no heartbeat
+    q2 = JobQueue(spool, heartbeat_timeout=0.2)
+    recovered = q2.recover_stale()
+    # the zombie revives mid-successor-run — the exact danger window
+    # (running -> failed is a LEGAL transition; only the epoch fence
+    # can tell the stale holder from the live one)
+    state = {"fenced": None}
+
+    def on_level(worker, jb, depth):
+        if state["fenced"] is None and depth >= 1:
+            try:
+                q1.finish(job.job_id, "failed",
+                          reason="zombie-says-so")
+                state["fenced"] = False
+            except FencedError:
+                state["fenced"] = True
+
+    Worker(q2, devices=1, owner="wB", on_level=on_level,
+           light_threads=0).drain()
+    done = q2.get(job.job_id)
+    fenced = state["fenced"] is True
+    q2.refresh()
+    final = q2.get(job.job_id)
+    fences = [e for e in _spool_events(spool)
+              if e["event"] == "fence"]
+    ok = (claimed and job.job_id in recovered and fenced
+          and done.state == "done" and done.attempts == 2
+          and final.state == "done"
+          and final.result["distinct"] == ORACLE["distinct"]
+          and final.result["levels"] == ORACLE["levels"]
+          and len(fences) >= 1
+          and fences[0]["job_id"] == job.job_id)
+    return {
+        "ok": ok, "zombie_claimed": claimed,
+        "recovered": recovered, "zombie_fenced": fenced,
+        "final_state": final.state, "attempts": final.attempts,
+        "fence_events": [(e["job_id"], e["epoch"]) for e in fences],
+    }
+
+
 SCENARIOS = [
     ("oom-degrade", scenario_oom_degrade),
     ("oom-paged-fallback", scenario_oom_paged_fallback),
@@ -1467,6 +1712,9 @@ SCENARIOS = [
     ("flood-rate-limit", scenario_flood_rate_limit),
     ("breaker-crash-loop", scenario_breaker_crash_loop),
     ("slow-loris-reap", scenario_slow_loris_reap),
+    ("host-death-failover", scenario_host_death_failover),
+    ("spool-replica-loss", scenario_spool_replica_loss),
+    ("zombie-fence", scenario_zombie_fence),
 ]
 
 

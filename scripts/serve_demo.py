@@ -39,7 +39,12 @@ backend), printing one JSON object; exit 0 iff every check holds:
               and the legit tenant's job still completes with the
               exact stub fixpoint.
 
-    python scripts/serve_demo.py
+    python scripts/serve_demo.py [--spool-driver fs|objstore|quorum]
+
+``--spool-driver`` (ISSUE 20) runs every leg's spool over the named
+spool driver — the acceptance bar is that the saturation leg passes
+UNCHANGED over ``quorum`` (the replicated control log carries the
+same exactly-once story as one filesystem).
 
 Sizes honor TPUVSR_DEMO_SHELL_JOBS / TPUVSR_DEMO_SCALE_JOBS for
 heavier manual runs; the defaults keep the whole demo tier-1 friendly.
@@ -73,6 +78,18 @@ N_SCALE = int(os.environ.get("TPUVSR_DEMO_SCALE_JOBS", "20"))
 #: fsyncs + subprocess spawn), so the ratio reads WORKER parallelism
 SCALE_SLEEP = 0.3
 TENANTS = ("acme", "blue", "cobra")
+
+#: the spool driver every leg runs over (--spool-driver; None = fs).
+#: Only NEW-spool creations pass it; re-opens auto-detect from the
+#: spool's persisted spooldrv.json (the ISSUE 20 contract).
+SPOOL_DRIVER = None
+
+
+def _new_queue(spool, **kw):
+    from tpuvsr.service.queue import JobQueue
+    if SPOOL_DRIVER:
+        kw.setdefault("driver", SPOOL_DRIVER)
+    return JobQueue(spool, **kw)
 
 #: the journal projection for the bit-identity oracle — everything a
 #: run MEANS, nothing about when/where it ran ("journals modulo
@@ -123,7 +140,7 @@ def demo_lifecycle(tmp, out):
     from tpuvsr.service.worker import Worker, result_summary
     from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS
 
-    q = JobQueue(os.path.join(tmp, "spool-life"))
+    q = _new_queue(os.path.join(tmp, "spool-life"))
     clean = q.submit("<stub:clean>", engine="device",
                      flags={"stub": True})
     rejected = q.submit("<stub:rejected>", engine="device",
@@ -194,7 +211,7 @@ def demo_saturation(tmp, out):
     from tpuvsr.validate import save_traces
 
     spool = os.path.join(tmp, "spool-sat")
-    q = JobQueue(spool)
+    q = _new_queue(spool)
     true_argv = _true_argv()
     age_every = 0.5
 
@@ -305,7 +322,7 @@ def _drain_rate(spool, workers):
     from tpuvsr.serve.pool import WorkerPool
     from tpuvsr.service.queue import TERMINAL, JobQueue
     from tpuvsr.testing import subprocess_env
-    q = JobQueue(spool)
+    q = _new_queue(spool)
     n = 0
     for i in range(N_SCALE):
         q.submit(f"sleep-{i:03d}", kind="shell",
@@ -320,20 +337,19 @@ def _drain_rate(spool, workers):
                       extra_args=["--light-threads", "1"]).start()
     rcs = pool.wait(timeout=420)
     t_start, t_end = None, None
-    with open(q.log_path) as f:
-        for line in f:
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("op") != "state":
-                continue
-            if rec["state"] == "running":
-                ts = rec.get("ts")
-                t_start = ts if t_start is None else min(t_start, ts)
-            if rec["state"] in TERMINAL:
-                ts = rec.get("ts")
-                t_end = ts if t_end is None else max(t_end, ts)
+    # read the state records through the spool DRIVER (ISSUE 20), so
+    # the same scan works whether they live in jobs.jsonl or the
+    # quorum replicas
+    recs, _ = q.drv.read("jobs", None)
+    for rec in recs:
+        if rec.get("op") != "state":
+            continue
+        if rec["state"] == "running":
+            ts = rec.get("ts")
+            t_start = ts if t_start is None else min(t_start, ts)
+        if rec["state"] in TERMINAL:
+            ts = rec.get("ts")
+            t_end = ts if t_end is None else max(t_end, ts)
     q.refresh()
     done = sum(1 for j in q.jobs() if j.state == "done")
     if done != n or rcs != [0] * workers or not t_start or not t_end:
@@ -408,13 +424,13 @@ def demo_bit_identity(tmp, out):
     from tpuvsr.service.worker import Worker
 
     serial_spool = os.path.join(tmp, "spool-serial")
-    qs = JobQueue(serial_spool)
+    qs = _new_queue(serial_spool)
     serial_jobs = _submit_identity_set(qs, tmp)
     Worker(qs, devices=2, owner="serial", light_threads=0).drain()
     serial = _outcomes(qs, serial_jobs)
 
     multi_spool = os.path.join(tmp, "spool-multi")
-    qm = JobQueue(multi_spool)
+    qm = _new_queue(multi_spool)
     multi_jobs = _submit_identity_set(qm, tmp)
     workers = [Worker(JobQueue(multi_spool), devices=2,
                       owner=f"w{i}", light_threads=0)
@@ -455,7 +471,7 @@ def demo_abuse(tmp, out):
     from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS
 
     spool = os.path.join(tmp, "spool-abuse")
-    os.makedirs(spool, exist_ok=True)
+    _new_queue(spool)
     with open(os.path.join(spool, "tokens.json"), "w") as f:
         json.dump({"legit": "tok-legit", "flood": "tok-flood"}, f)
     guard = Guard(spool, rate=0.5, burst=2.0)
@@ -541,7 +557,13 @@ def demo_abuse(tmp, out):
     return checks
 
 
-def main():
+def main(argv=()):
+    global SPOOL_DRIVER
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spool-driver", default=None,
+                    choices=("fs", "objstore", "quorum"))
+    SPOOL_DRIVER = ap.parse_args(list(argv)).spool_driver
     tmp = tempfile.mkdtemp(prefix="tpuvsr-serve-demo-")
     out = {}
     checks = {}
@@ -559,4 +581,4 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -642,9 +642,9 @@ def test_fault_matrix_smoke(capsys):
     import fault_matrix
     assert fault_matrix.main([]) == 0
     out = json.loads(capsys.readouterr().out)
-    # 28 scenarios since ISSUE 18 (flood-rate-limit +
-    # breaker-crash-loop + slow-loris-reap)
-    assert out["ok"] and len(out["scenarios"]) == 28
+    # 31 scenarios since ISSUE 20 (host-death-failover +
+    # spool-replica-loss + zombie-fence)
+    assert out["ok"] and len(out["scenarios"]) == 31
 
 
 # ---------------------------------------------------------------------

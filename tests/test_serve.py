@@ -583,6 +583,31 @@ def test_http_tenants_endpoint(tmp_path):
         srv.stop()
 
 
+def test_http_over_quorum_spool(tmp_path):
+    """ISSUE 20 leg: the HTTP front serves a QUORUM spool
+    transparently — the driver is a spool property (persisted in
+    spooldrv.json), not an API one, so submit/status/drain all ride
+    the replicated log unchanged."""
+    spool = str(tmp_path / "spool")
+    JobQueue(spool, driver="quorum")    # configure the spool
+    srv = ServiceHTTP(spool).start()
+    try:
+        st, job = _http(srv.port, "POST", "/v1/jobs", {
+            "spec": "quorum-shell", "kind": "shell",
+            "flags": {"argv": TRUE_ARGV, "timeout": 60}})
+        assert st == 200 and job["state"] == "queued"
+        st, doc = _http(srv.port, "GET", f"/v1/jobs/{job['job_id']}")
+        assert st == 200 and doc["state"] == "queued"
+    finally:
+        srv.stop()
+    # the submission landed in the replicated log: a fresh queue
+    # auto-detects the driver, drains it, and the result folds back
+    q = JobQueue(spool)
+    assert q.drv.name == "quorum"
+    Worker(q, devices=1, light_threads=1).drain()
+    assert q.get(job["job_id"]).state == "done"
+
+
 # ---------------------------------------------------------------------
 # exit-code mapping (satellite: the one contract, extended)
 # ---------------------------------------------------------------------

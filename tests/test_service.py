@@ -640,3 +640,26 @@ def test_job_journal_validates_and_interleaves(tmp_path):
     assert os.path.exists(q.metrics_path(j.job_id))
     with open(q.metrics_path(j.job_id)) as f:
         assert json.load(f)["schema"] == "tpuvsr-metrics/1"
+
+
+# ---------------------------------------------------------------------
+# ISSUE 20: the same durability contract holds over the quorum driver,
+# including with one replica directory destroyed mid-lifecycle
+# ---------------------------------------------------------------------
+def test_queue_durability_over_quorum_driver(tmp_path):
+    import shutil
+
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool, driver="quorum")
+    j = q.submit("X.tla", engine="device", priority=3)
+    q.transition(j.job_id, "admitted")
+    assert q.claim(j.job_id) is not None
+    q.finish(j.job_id, "done", result={"distinct": 7, "ok": True})
+
+    # losing a minority replica must not lose the fold
+    shutil.rmtree(os.path.join(spool, "replicas", "r0"))
+    q2 = JobQueue(spool)                      # auto-detects quorum
+    j2 = q2.get(j.job_id)
+    assert j2.state == "done" and j2.attempts == 1
+    assert j2.result == {"distinct": 7, "ok": True}
+    assert q2.spool_status()["driver"] == "quorum"

@@ -1,0 +1,410 @@
+"""Spool driver conformance battery (ISSUE 20): the durable
+multi-host data plane behind the dispatch service.
+
+One behavioral contract, three drivers — ``fs`` (the PR-6 layout,
+extracted verbatim), ``objstore`` (record-CAS claims, no mtimes) and
+``quorum`` (a replicated log over N directories).  Every battery test
+is parameterized over all three: fold determinism (incremental ==
+fresh == restarted), multi-process claim races exactly-once, claim
+epoch fencing, explicit heartbeat records, snapshot blob round-trips,
+host leases.  Quorum-specific legs cover torn-tail holdback per
+replica, replica loss below/above the write quorum, and anti-entropy
+rejoin.  A PR-18-era spool (no ``spooldrv.json``) must open under
+``fs`` with no migration.
+
+Tier-1: no engines needed except the service drain leg (stub kernel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from tpuvsr.obs import read_journal
+from tpuvsr.service.queue import FencedError, JobQueue, QueueError
+from tpuvsr.service.spooldrv import (CONFIG_NAME, SpoolError,
+                                     open_driver)
+from tpuvsr.testing import STUB_DISTINCT, STUB_LEVELS, claim_race
+
+DRIVERS = ("fs", "objstore", "quorum")
+
+
+@pytest.fixture(params=DRIVERS)
+def drv_name(request):
+    return request.param
+
+
+# ---------------------------------------------------------------------
+# record streams
+# ---------------------------------------------------------------------
+def test_append_read_roundtrip(tmp_path, drv_name):
+    """Incremental cursor reads == one fresh read == a read through a
+    RESTARTED driver instance — the stream fold is a pure function of
+    the appended records on every driver."""
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver=drv_name)
+    seen = []
+    cursor = None
+    for i in range(7):
+        drv.append("jobs", {"op": "tick", "i": i})
+        if i % 3 == 0:          # fold incrementally, mid-stream
+            recs, cursor = drv.read("jobs", cursor)
+            seen.extend(recs)
+    recs, cursor = drv.read("jobs", cursor)
+    seen.extend(recs)
+    fresh, _ = drv.read("jobs", None)
+    restarted, _ = open_driver(spool).read("jobs", None)
+    want = [{"op": "tick", "i": i} for i in range(7)]
+    assert seen == fresh == restarted == want
+    # the cursor is exhausted: nothing new
+    more, _ = drv.read("jobs", cursor)
+    assert more == []
+
+
+def test_queue_fold_determinism(tmp_path, drv_name):
+    """The JobQueue fold over a real lifecycle (submit / admit /
+    claim / requeue / reclaim / finish) is identical whether folded
+    incrementally, by a fresh queue, or after a driver restart."""
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool, driver=drv_name)
+    a = q.submit("A.tla", engine="device", priority=2)
+    b = q.submit("B.tla", engine="device")
+    q.transition(a.job_id, "admitted")
+    q.transition(b.job_id, "admitted")
+    assert q.claim(a.job_id, owner="w1") is not None
+    q.requeue(a.job_id, reason="test",
+              rescue={"path": "p", "depth": 2, "distinct": 6})
+    assert q.claim(a.job_id, owner="w2") is not None
+    q.finish(a.job_id, "done", result={"distinct": 16})
+
+    def fold(queue):
+        return {j.job_id: (j.state, j.attempts, j.rescue)
+                for j in queue.jobs()}
+    incremental = fold(q)
+    fresh = fold(JobQueue(spool))               # auto-detects driver
+    assert incremental == fresh
+    assert fresh[a.job_id][0] == "done"
+    assert fresh[a.job_id][1] == 2
+    assert fresh[b.job_id][0] == "admitted"
+
+
+# ---------------------------------------------------------------------
+# claims: conditional put, races, fencing
+# ---------------------------------------------------------------------
+def test_claim_conditional_put(tmp_path, drv_name):
+    drv = open_driver(str(tmp_path / "spool"), driver=drv_name)
+    assert drv.try_claim("j1", owner="w1", epoch=1)
+    assert not drv.try_claim("j1", owner="w2", epoch=1)   # held
+    info = drv.claim_info("j1")
+    assert info["owner"] == "w1" and info["epoch"] == 1
+    assert info["pid"] == os.getpid()
+    # a zombie's conditional release (wrong epoch) is a no-op ...
+    drv.release_claim("j1", epoch=99)
+    assert drv.claim_info("j1") is not None
+    # ... the holder's (right epoch) and a sweeper's (no epoch) drop it
+    drv.release_claim("j1", epoch=1)
+    assert drv.claim_info("j1") is None
+    assert drv.try_claim("j1", owner="w2", epoch=2)
+
+
+def test_claim_race_exactly_once(tmp_path, drv_name):
+    """ISSUE 20 conformance: three subprocesses race ``claim_next``
+    over one spool — the union covers every job, the owners' claims
+    are disjoint, on every driver (the same harness the fs driver
+    passed at PR 14)."""
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool, driver=drv_name)
+    jobs = []
+    for i in range(9):
+        j = q.submit(f"spec-{i}.tla", engine="device")
+        q.transition(j.job_id, "admitted")
+        jobs.append(j.job_id)
+    got = claim_race(spool, workers=3)
+    claimed = [jid for lst in got.values() for jid in lst]
+    assert sorted(claimed) == sorted(jobs)      # covered, no dupes
+    q.refresh()
+    assert all(q.get(j).state == "done" for j in jobs)
+
+
+def test_epoch_fencing(tmp_path, drv_name):
+    """A recovered claim's epoch fences every later append by the old
+    holder: the zombie's terminal append raises FencedError and is
+    journaled as a ``fence`` event; the successor's appends pass."""
+    drv = open_driver(str(tmp_path / "spool"), driver=drv_name)
+    assert drv.try_claim("j1", owner="w1", epoch=1)
+    drv.append_fenced("jobs", {"op": "x"}, job_id="j1", epoch=1)
+    # recovery: a sweeper releases unconditionally, a successor
+    # claims at the next epoch
+    drv.release_claim("j1")
+    assert drv.try_claim("j1", owner="w2", epoch=2)
+    with pytest.raises(FencedError):
+        drv.append_fenced("jobs", {"op": "zombie"},
+                          job_id="j1", epoch=1)
+    evs = read_journal(drv.journal_path)
+    fences = [e for e in evs if e["event"] == "fence"]
+    assert fences and fences[0]["job_id"] == "j1"
+    assert fences[0]["epoch"] == 1
+    # the live holder is unaffected; after ITS release, even the
+    # right epoch fences (no claim = no license to append)
+    drv.append_fenced("jobs", {"op": "y"}, job_id="j1", epoch=2)
+    drv.release_claim("j1", epoch=2)
+    with pytest.raises(FencedError):
+        drv.append_fenced("jobs", {"op": "late"},
+                          job_id="j1", epoch=2)
+    # the zombie's records never landed
+    recs, _ = drv.read("jobs", None)
+    assert [r["op"] for r in recs] == ["x", "y"]
+
+
+def test_fs_legacy_epochless_claim_exempt_from_fence(tmp_path):
+    """A claim file written before the driver layer (no ``epoch``
+    field) keeps legacy semantics on ``fs``: the fence never fires on
+    it — old spools keep draining bit-for-bit."""
+    drv = open_driver(str(tmp_path / "spool"))          # fs default
+    with open(os.path.join(drv.claims_dir, "j1.claim"), "w") as f:
+        json.dump({"pid": os.getpid(), "owner": "old-worker",
+                   "ts": time.time()}, f)
+    drv.append_fenced("jobs", {"op": "x"}, job_id="j1", epoch=1)
+    recs, _ = drv.read("jobs", None)
+    assert recs == [{"op": "x"}]
+
+
+# ---------------------------------------------------------------------
+# heartbeats (explicit records, not mtimes)
+# ---------------------------------------------------------------------
+def test_heartbeat_records_refresh_claim_age(tmp_path, drv_name):
+    drv = open_driver(str(tmp_path / "spool"), driver=drv_name)
+    assert drv.try_claim("j1", owner="w1", epoch=1)
+    age0 = drv.claim_age("j1")
+    assert age0 is not None and age0 < 5.0
+    time.sleep(0.15)
+    assert drv.claim_age("j1") >= 0.15
+    assert drv.heartbeat("j1")
+    assert drv.claim_age("j1") < 0.15
+    drv.release_claim("j1", epoch=1)
+    assert not drv.heartbeat("j1")              # claim gone: False
+    assert drv.claim_age("j1") is None
+
+
+def test_fs_heartbeat_survives_mtime_vandalism(tmp_path):
+    """The ISSUE 20 fix: ``recover_stale`` freshness comes from the
+    driver's heartbeat record (the ``.hb`` sidecar on fs), so a
+    vandalized claim-file mtime — the thing the old code trusted —
+    no longer makes a LIVE worker look dead."""
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool, heartbeat_timeout=60.0)
+    j = q.submit("X.tla", engine="device")
+    q.transition(j.job_id, "admitted")
+    # a claim from another host whose heartbeat RECORD is fresh
+    dead_pid = 2 ** 22 + 12345
+    claim = os.path.join(q.claims_dir, f"{j.job_id}.claim")
+    with open(claim, "w") as f:
+        json.dump({"pid": dead_pid, "owner": "w-far",
+                   "host": "other-host", "epoch": 1,
+                   "ts": time.time()}, f)
+    q.transition(j.job_id, "running", attempts=1)
+    q.drv.heartbeat(j.job_id)                   # fresh sidecar record
+    os.utime(claim, times=(1.0, 1.0))           # ancient mtime
+    assert q.recover_stale() == []              # record wins: live
+    assert q.get(j.job_id).state == "running"
+    # sidecar gone -> mtime is the legacy fallback -> stale -> swept
+    os.unlink(os.path.join(q.claims_dir, f"{j.job_id}.hb"))
+    assert q.recover_stale() == [j.job_id]
+    assert q.get(j.job_id).state == "preempted-requeued"
+
+
+# ---------------------------------------------------------------------
+# snapshot blobs + cancel markers + host leases
+# ---------------------------------------------------------------------
+def test_snapshot_blob_roundtrip(tmp_path, drv_name):
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver=drv_name)
+    assert drv.get_blob("ckpt-j1.tar") is None
+    payload = os.urandom(4096)
+    drv.put_blob("ckpt-j1.tar", payload)
+    assert drv.get_blob("ckpt-j1.tar") == payload
+    drv.put_blob("ckpt-j1.tar", b"v2")          # overwrite wins
+    assert open_driver(spool).get_blob("ckpt-j1.tar") == b"v2"
+
+
+def test_cancel_marker(tmp_path, drv_name):
+    drv = open_driver(str(tmp_path / "spool"), driver=drv_name)
+    assert not drv.cancel_requested("j1")
+    drv.set_cancel("j1")
+    assert drv.cancel_requested("j1")
+    drv.clear_cancel("j1")
+    assert not drv.cancel_requested("j1")
+
+
+def test_host_lease_fold(tmp_path, drv_name, monkeypatch):
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver=drv_name)
+    monkeypatch.setenv("TPUVSR_HOST", "hostA")
+    drv.host_heartbeat()
+    monkeypatch.setenv("TPUVSR_HOST", "hostB")
+    drv.host_heartbeat()
+    t_b = drv.hosts()["hostB"]["ts"]
+    time.sleep(0.05)
+    drv.host_heartbeat()                        # refresh hostB
+    hosts = open_driver(spool).hosts()          # restart-convergent
+    assert set(hosts) == {"hostA", "hostB"}
+    assert hosts["hostB"]["ts"] > t_b           # latest record wins
+    # a queue sweeping with a tiny lease timeout sees both as dead
+    q = JobQueue(spool, host_lease_timeout=0.0)
+    assert q.dead_hosts() == {"hostA", "hostB"}
+
+
+# ---------------------------------------------------------------------
+# driver selection + legacy spools
+# ---------------------------------------------------------------------
+def test_driver_config_persists_and_mismatch_raises(tmp_path):
+    spool = str(tmp_path / "spool")
+    q = JobQueue(spool, driver="quorum")
+    j = q.submit("X.tla", engine="device")
+    assert json.load(open(os.path.join(spool, CONFIG_NAME)))[
+        "driver"] == "quorum"
+    # a later default open auto-detects quorum ...
+    q2 = JobQueue(spool)
+    assert q2.drv.name == "quorum"
+    assert q2.get(j.job_id).state == "queued"
+    # ... and an EXPLICIT mismatch is refused, not silently migrated
+    with pytest.raises(SpoolError):
+        JobQueue(spool, driver="fs")
+
+
+def test_pr18_era_spool_opens_under_fs_unmigrated(tmp_path):
+    """A spool written before the driver layer: a raw ``jobs.jsonl``
+    + claim file, no ``spooldrv.json``.  It opens under ``fs`` with
+    no migration — same records, same claim, no config written."""
+    spool = str(tmp_path / "spool")
+    claims = os.path.join(spool, "claims")
+    os.makedirs(claims)
+    with open(os.path.join(spool, "jobs.jsonl"), "w") as f:
+        for rec in ({"op": "submit",
+                     "job": {"job_id": "j-old", "spec": "Old.tla",
+                             "engine": "device", "state": "queued",
+                             "seq": 1,
+                             "submitted_ts": time.time()},
+                     "ts": time.time()},
+                    {"op": "state", "job_id": "j-old",
+                     "state": "admitted", "ts": time.time()}):
+            f.write(json.dumps(rec) + "\n")
+    with open(os.path.join(claims, "j-old.claim"), "w") as f:
+        json.dump({"pid": os.getpid(), "owner": "old",
+                   "ts": time.time()}, f)
+    q = JobQueue(spool)
+    assert q.drv.name == "fs"
+    assert q.get("j-old").state == "admitted"
+    assert q.drv.claim_info("j-old")["owner"] == "old"
+    assert not os.path.exists(os.path.join(spool, CONFIG_NAME))
+
+
+# ---------------------------------------------------------------------
+# quorum specifics: torn tails, loss, rejoin
+# ---------------------------------------------------------------------
+def _tear(path, nbytes=7):
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:-nbytes])
+
+
+def test_quorum_torn_tail_heldback_per_replica(tmp_path):
+    """A torn tail on ONE replica is invisible (a sibling's intact
+    copy serves); torn on EVERY replica, only the torn frame is held
+    back — the acked prefix still reads."""
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver="quorum")
+    for i in range(5):
+        drv.append("jobs", {"op": "tick", "i": i})
+    _tear(os.path.join(spool, "replicas", "r2", "jobs.jsonl"))
+    recs, _ = open_driver(spool).read("jobs", None)
+    assert [r["i"] for r in recs] == [0, 1, 2, 3, 4]
+    for r in ("r0", "r1", "r2"):
+        _tear(os.path.join(spool, "replicas", r, "jobs.jsonl"))
+    recs, _ = open_driver(spool).read("jobs", None)
+    assert [r["i"] for r in recs] == [0, 1, 2, 3]
+
+
+def test_quorum_replica_loss_rejoin_anti_entropy(tmp_path):
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver="quorum")
+    for i in range(4):
+        drv.append("jobs", {"op": "tick", "i": i})
+    r1 = os.path.join(spool, "replicas", "r1")
+    shutil.rmtree(r1)
+    # service continues: appends keep acking at W=2
+    for i in range(4, 8):
+        drv.append("jobs", {"op": "tick", "i": i})
+    assert drv.replica_status() == {"total": 3, "live": 2,
+                                    "lost": [1]}
+    recs, _ = open_driver(spool).read("jobs", None)
+    assert [r["i"] for r in recs] == list(range(8))
+    # a restart does NOT recreate the lost dir (an empty dir would
+    # read as rejoined before anti-entropy healed it)
+    assert not os.path.isdir(r1)
+    # rejoin: recreate the dir; maintain() heals it frame-for-frame
+    os.makedirs(r1)
+    drv2 = open_driver(spool)
+    assert "replica_rejoin" in drv2.maintain()
+    assert drv2.replica_status() == {"total": 3, "live": 3,
+                                     "lost": []}
+    with open(os.path.join(spool, "replicas", "r0",
+                           "jobs.jsonl"), "rb") as f:
+        b0 = f.read()
+    with open(os.path.join(r1, "jobs.jsonl"), "rb") as f:
+        b1 = f.read()
+    assert b0 == b1 and len(b0) > 0
+    evs = [e["event"] for e in read_journal(drv.journal_path)]
+    assert "replica_lost" in evs and "replica_rejoin" in evs
+
+
+def test_quorum_append_fails_below_write_quorum(tmp_path):
+    spool = str(tmp_path / "spool")
+    drv = open_driver(spool, driver="quorum")
+    drv.append("jobs", {"i": 0})
+    shutil.rmtree(os.path.join(spool, "replicas", "r1"))
+    shutil.rmtree(os.path.join(spool, "replicas", "r2"))
+    with pytest.raises(SpoolError):
+        drv.append("jobs", {"i": 1})
+    # reads still serve from the surviving replica
+    recs, _ = open_driver(spool).read("jobs", None)
+    assert [r["i"] for r in recs] == [0]
+
+
+# ---------------------------------------------------------------------
+# the service over the quorum driver (the drill path, in miniature)
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+def test_service_drain_over_quorum(tmp_path):
+    """A real stub job drains through the worker over the quorum
+    spool to the exact fixpoint — the serving path is driver-blind."""
+    from tpuvsr.service.worker import Worker
+    q = JobQueue(str(tmp_path / "spool"), driver="quorum")
+    j = q.submit("<stub>", engine="device", flags={"stub": True})
+    Worker(q, devices=1, light_threads=0).drain()
+    done = q.get(j.job_id)
+    assert done.state == "done"
+    assert done.result["distinct"] == STUB_DISTINCT
+    assert done.result["levels"] == STUB_LEVELS
+
+
+def test_spool_selfcheck_script_runs(tmp_path, capsys):
+    """The ISSUE 20 self-check satellite: the demo spool's journal
+    validates against the spool-state spec, and the deliberately
+    corrupted record is flagged at its exact step."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import spool_selfcheck
+    trace_out = str(tmp_path / "TRACE.jsonl")
+    assert spool_selfcheck.main(
+        ["--spool-driver", "objstore", "--trace-out", trace_out]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["accepted"] and out["corrupted_flagged"]
+    assert out["corrupted_diverged_at"] == out["corrupted_step"]
+    assert os.path.exists(trace_out)

@@ -252,7 +252,8 @@ tpuvsr/serve — README "Service"):
     python -m tpuvsr serve  [--spool DIR] [--drain] [--workers N]
                      [--http PORT] [--tenant-weight T=W]
                      [--tls-cert PEM] [--rate N] [--high-water N]
-                     [--breaker-threshold K] ...
+                     [--breaker-threshold K]
+                     [--spool-driver fs|objstore|quorum] ...
     python -m tpuvsr status [JOB] [--spool DIR] [--json] [--tail N]
     python -m tpuvsr cancel JOB [--spool DIR]
 
@@ -265,7 +266,12 @@ door"): bearer-token auth off a spool-local tokens.json, optional
 TLS, per-tenant token-bucket rate limits (429 + Retry-After),
 queue-depth backpressure (503), and a per-(tenant, spec) circuit
 breaker that fail-fasts crash-looping submissions before they touch
-a device.
+a device.  The control plane itself is durable across machines
+(ISSUE 20, tpuvsr/service/spooldrv.py — README "Multi-host data
+plane"): pluggable spool drivers (fs / objstore / quorum) with
+claim-epoch fencing, a quorum-replicated control log that survives
+a lost replica, and host-lease failover that sweeps a dead host's
+claims in one pass.
 """
 
 from __future__ import annotations

@@ -109,6 +109,19 @@ EVENT_REQUIRED = {
     "backpressure": ("depth", "high_water"),
     "breaker_open": ("tenant", "digest", "failures"),
     "breaker_close": ("tenant", "digest"),
+    # spool data plane (ISSUE 20): driver-level events in
+    # `<spool>/spool.jsonl` (run_id "spool").  `fence` is a zombie
+    # worker's terminal append rejected by claim-epoch fencing
+    # (`holder` is the live claim's epoch, None when the claim is
+    # gone); `replica_lost`/`replica_rejoin` the quorum driver's
+    # membership changes (`records` counts anti-entropy-healed
+    # frames); `host_lease` the first lease a driver instance writes
+    # for a host (the machine-read leases are records in the `hosts`
+    # stream; this row is the journal trail).
+    "fence": ("job_id", "epoch"),
+    "replica_lost": ("replica",),
+    "replica_rejoin": ("replica", "records"),
+    "host_lease": ("host",),
 }
 COMMON_REQUIRED = ("event", "ts", "run_id")
 

@@ -153,6 +153,9 @@ class TelemetryAggregator:
         # the admission guard's journal (ISSUE 18): every edge
         # rejection and breaker transition, folded like any other
         self.guard_journal = os.path.join(self.spool, "guard.jsonl")
+        # the spool driver's own journal (ISSUE 20): fence rejections,
+        # quorum replica membership, host leases
+        self.spool_journal = os.path.join(self.spool, "spool.jsonl")
         self.telemetry_dir = os.path.join(self.spool, "telemetry")
         self.events_path = os.path.join(self.telemetry_dir,
                                         "events.jsonl")
@@ -176,8 +179,13 @@ class TelemetryAggregator:
             # guard counters (ISSUE 18): folded off guard.jsonl
             "auth_denied": 0, "rate_limited": 0, "backpressure": 0,
             "breaker_trips": 0, "breaker_closes": 0,
+            # spool data-plane counters (ISSUE 20): folded off
+            # spool.jsonl — zombie fences and quorum membership churn
+            "fences": 0, "replicas_lost": 0, "replica_rejoins": 0,
         }
         self._open_breakers = set()  # (tenant, digest) currently open
+        self._spool_replicas = None  # latest {"live", "total"} seen
+        self._spool_hosts = set()    # hosts that wrote a lease
         self._jobs_by_state = {}     # terminal state -> count
         self._tenants = {}           # tenant -> fold dict
         self._workers = {}           # worker -> fold dict
@@ -269,6 +277,8 @@ class TelemetryAggregator:
             for line in self._tail(self.pool_journal):
                 n += self._fold_line(line)
             for line in self._tail(self.guard_journal):
+                n += self._fold_line(line)
+            for line in self._tail(self.spool_journal):
                 n += self._fold_line(line)
             # our own breach journal last: a breach written THIS poll
             # is picked up by the NEXT (the counter stays
@@ -489,6 +499,27 @@ class TelemetryAggregator:
         self._open_breakers.discard((ev.get("tenant"),
                                      ev.get("digest")))
 
+    # -- spool data-plane events (ISSUE 20, off spool.jsonl) -----------
+    def _membership(self, ev):
+        if ev.get("live") is not None and ev.get("total") is not None:
+            self._spool_replicas = {"live": int(ev["live"]),
+                                    "total": int(ev["total"])}
+
+    def _on_fence(self, ev, ts, w):
+        self._counters["fences"] += 1
+
+    def _on_replica_lost(self, ev, ts, w):
+        self._counters["replicas_lost"] += 1
+        self._membership(ev)
+
+    def _on_replica_rejoin(self, ev, ts, w):
+        self._counters["replica_rejoins"] += 1
+        self._membership(ev)
+
+    def _on_host_lease(self, ev, ts, w):
+        if ev.get("host"):
+            self._spool_hosts.add(str(ev["host"]))
+
     def _prune(self):
         """Bounded memory: drop pending jobs and engine-run cells not
         touched inside the window horizon (measured on the FOLD clock,
@@ -682,6 +713,14 @@ class TelemetryAggregator:
                     "open_breakers": sorted(
                         f"{t or '-'}:{d}"
                         for t, d in self._open_breakers)},
+                "spool": {
+                    "fences": self._counters["fences"],
+                    "replicas_lost": self._counters["replicas_lost"],
+                    "replica_rejoins":
+                        self._counters["replica_rejoins"],
+                    "replicas": (dict(self._spool_replicas)
+                                 if self._spool_replicas else None),
+                    "hosts": sorted(self._spool_hosts)},
             }
 
 
@@ -781,6 +820,27 @@ def prometheus_text(snap):
     metric("tpuvsr_breaker_open", "gauge",
            "Circuit breakers currently open.",
            [((), len(guard.get("open_breakers") or ()))])
+    # spool data-plane counters + gauges (ISSUE 20): folded off the
+    # driver's spool.jsonl
+    spool = snap.get("spool") or {}
+    metric("tpuvsr_spool_fence_total", "counter",
+           "Zombie terminal appends rejected by claim-epoch fencing.",
+           [((), spool.get("fences", 0))])
+    metric("tpuvsr_spool_replica_lost_total", "counter",
+           "Quorum spool replicas marked lost.",
+           [((), spool.get("replicas_lost", 0))])
+    metric("tpuvsr_spool_replica_rejoin_total", "counter",
+           "Quorum spool replicas healed back in by anti-entropy.",
+           [((), spool.get("replica_rejoins", 0))])
+    reps = spool.get("replicas") or {}
+    metric("tpuvsr_spool_replicas", "gauge",
+           "Quorum spool replica census, by membership status.",
+           [((("status", "live"),), reps.get("live")),
+            ((("status", "total"),), reps.get("total"))]
+           if reps else [((("status", "total"),), 0)])
+    metric("tpuvsr_spool_hosts", "gauge",
+           "Hosts that have written a spool host lease.",
+           [((), len(spool.get("hosts") or ()))])
     for key, help_ in (
             ("distinct_per_s",
              "Fleet distinct states/s over the last complete window."),

@@ -233,6 +233,8 @@ class Guard:
                                               "tokens.json"))
         self.journal_path = os.path.join(self.spool, "guard.jsonl")
         self.jobs_log = os.path.join(self.spool, "jobs.jsonl")
+        self._drv = None             # lazy spool driver (ISSUE 20)
+        self._jobs_cursor = None     # driver cursor over "jobs"
         self.rate = None if rate is None else float(rate)
         self.burst = (float(burst) if burst is not None
                       else (self.rate if self.rate else 1.0))
@@ -389,11 +391,16 @@ class Guard:
             return
         with self._lock:
             events = []              # (ts, taken?, tenant)
-            for line in self._tail(self.jobs_log):
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
+            # accepted submissions come off the spool DRIVER's jobs
+            # stream (ISSUE 20) — auto-detected from the spool's
+            # persisted config, so the same fold works whether the
+            # records live in jobs.jsonl or the quorum replicas
+            if self._drv is None:
+                from ..service.spooldrv import open_driver
+                self._drv = open_driver(self.spool)
+            recs, self._jobs_cursor = self._drv.read(
+                "jobs", self._jobs_cursor)
+            for rec in recs:
                 if rec.get("op") != "submit":
                     continue
                 job = rec.get("job") or {}

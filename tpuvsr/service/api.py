@@ -150,10 +150,34 @@ def build_parser():
                     metavar="KEY=VALUE",
                     help="extra job flag (repeatable; JSON values)")
     sp.add_argument("--spool", default=None)
+    sp.add_argument("--spool-driver", default=None,
+                    choices=("fs", "objstore", "quorum"),
+                    help="spool driver for a NEW spool (ISSUE 20); "
+                         "an existing spool's persisted choice always "
+                         "wins, absent config means fs")
+    sp.add_argument("--spool-replicas", type=int, default=None,
+                    metavar="N",
+                    help="quorum driver replica count (default 3)")
     sp.add_argument("--json", action="store_true")
 
     sv = sub.add_parser("serve", help="run the dispatch worker(s)")
     sv.add_argument("--spool", default=None)
+    sv.add_argument("--spool-driver", default=None,
+                    choices=("fs", "objstore", "quorum"),
+                    help="spool driver for a NEW spool (ISSUE 20): "
+                         "fs (single filesystem, the default), "
+                         "objstore (CAS-record claims + epoch "
+                         "fencing), quorum (replicated log over N "
+                         "directories); an existing spool's persisted "
+                         "choice always wins")
+    sv.add_argument("--spool-replicas", type=int, default=None,
+                    metavar="N",
+                    help="quorum driver replica count (default 3)")
+    sv.add_argument("--host-lease-timeout", type=float, default=None,
+                    help="seconds after which a host whose lease "
+                         "record went silent is dead and ALL its "
+                         "claims are swept at once (default: the "
+                         "heartbeat timeout)")
     sv.add_argument("--drain", action="store_true",
                     help="exit when nothing is claimable")
     sv.add_argument("--devices", type=int, default=None,
@@ -299,7 +323,9 @@ def build_parser():
 
 
 def _queue(args):
-    return JobQueue(args.spool or default_spool())
+    return JobQueue(args.spool or default_spool(),
+                    driver=getattr(args, "spool_driver", None),
+                    replicas=getattr(args, "spool_replicas", None))
 
 
 def cmd_submit(args):
@@ -548,12 +574,20 @@ def cmd_status(args):
         agg.poll()
         print(json.dumps({"stats": q.stats(), "jobs": jobs,
                           "tenants": tenants,
+                          "spool": q.spool_status(),
                           "telemetry": agg.snapshot()}, default=str))
     else:
         st = q.stats()
         print("queue: " + ", ".join(f"{k}={v}" for k, v in st.items()
                                     if v and k != "total")
               + f" (total {st['total']})")
+        sp = q.spool_status()
+        if sp["driver"] != "fs" or sp["replicas"]:
+            reps = sp["replicas"]
+            print(f"  spool: driver={sp['driver']}"
+                  + (f" replicas={reps['live']}/{reps['total']} live"
+                     + (f" (lost: {reps['lost']})" if reps["lost"]
+                        else "") if reps else ""))
         for j in jobs:
             print(f"  {j['job_id']:>18} {j['state']:>20} "
                   f"prio={j['priority']} dev={j['devices']} "
@@ -695,6 +729,11 @@ def _serve_pool(args, q, log, t0, http):
         pool.respawn_dead()
         if not pool.alive() and not pool.pending_respawn():
             break
+        # the pool parent IS this host's lease writer (ISSUE 20):
+        # every sweep tick renews the lease a SURVIVOR host judges us
+        # by — if we go silent past --host-lease-timeout, all of our
+        # workers' claims are swept in one pass
+        q.host_heartbeat()
         q.recover_stale(log=log)
         time.sleep(0.5)
     codes = pool.wait()
@@ -709,6 +748,9 @@ def _serve_pool(args, q, log, t0, http):
 
 def cmd_serve(args):
     q = JobQueue(args.spool or default_spool(),
+                 driver=args.spool_driver,
+                 replicas=args.spool_replicas,
+                 host_lease_timeout=args.host_lease_timeout,
                  **({"heartbeat_timeout": args.heartbeat_timeout}
                     if args.heartbeat_timeout is not None else {}))
     log = (None if args.quiet

@@ -1,28 +1,36 @@
-"""Durable on-disk job queue for the verification dispatch service.
+"""Durable job queue for the verification dispatch service.
 
-One spool directory holds the whole queue state, in two pieces chosen
-so that EVERY mutation is crash-safe without a database:
+One spool directory holds the whole queue state, persisted through a
+pluggable **spool driver** (``tpuvsr/service/spooldrv.py``, ROADMAP
+item 2(b)) so the same queue runs over one POSIX filesystem, an
+object-store shape, or a tiny quorum-replicated log:
 
-* ``jobs.jsonl`` — an append-only, fsync-per-line JSONL spool of job
-  records and state transitions.  The queue's in-memory view is a pure
-  fold over this log, so a killed worker (or a killed submitter)
+* the ``jobs`` record stream — an append-only, fsync-per-line spool of
+  job records and state transitions.  The queue's in-memory view is a
+  pure fold over this log, so a killed worker (or a killed submitter)
   leaves a valid prefix and the next ``JobQueue(spool)`` reconstructs
   exactly the surviving state — the same crash contract as the run
   journal (``tpuvsr/obs/journal.py``).
-* ``claims/<job_id>.claim`` — atomic claim files.  A worker takes a
-  job by creating its claim file with ``O_CREAT|O_EXCL`` (the POSIX
-  mutual-exclusion primitive: exactly one creator wins), records its
-  pid, worker-id and host inside, and deletes it when the job leaves
-  ``running``.  The file's **mtime is the worker's heartbeat**
-  (``heartbeat``, touched at every level-boundary tick): liveness is
-  judged pid-first on the claimer's own host and heartbeat-first
-  across hosts — a live worker on another host (fresh mtime, invisible
-  pid) is never mistaken for dead (ISSUE 14 hardening; the old
-  dead-pid check was single-host only).  A dead claim is the tombstone
-  of a killed worker; ``recover_stale`` turns those back into
-  claimable jobs — with the job's latest snapshot attached as a
-  rescue, so the next attempt RESUMES instead of restarting
-  (``checkpoint.snapshot_info``).
+* **claims** — the driver's conditional-put primitive: exactly one
+  claimer wins (``O_CREAT|O_EXCL``-style link dance on ``fs``,
+  compare-and-swap records on ``objstore``/``quorum``).  A claim
+  carries the attempt **epoch**, and while this queue object holds a
+  claim every state append it makes for that job is **fenced** on the
+  epoch — a zombie worker whose claim was recovered (and possibly
+  re-issued) can never commit a terminal state
+  (:class:`~.spooldrv.FencedError`, journaled ``fence``).  Liveness is
+  judged pid-first on the claimer's own host and by the driver's
+  explicit heartbeat records across hosts (mtime is an ``fs``-only
+  legacy fallback); a dead claim is the tombstone of a killed worker,
+  and ``recover_stale`` turns those back into claimable jobs — with
+  the job's latest snapshot attached as a rescue, so the next attempt
+  RESUMES instead of restarting (``checkpoint.snapshot_info``; on
+  replicated drivers the snapshot is also driver-held, so it survives
+  the claiming host's disk).
+* **host leases** — pool parents heartbeat their host identity through
+  the driver (``host_heartbeat``), so a survivor host's
+  ``recover_stale`` sweeps an ENTIRE dead host's claims at once
+  instead of waiting out each claim's own heartbeat window.
 
 Job lifecycle (ISSUE 6; the legal-transition table below is enforced,
 an illegal transition is a bug, not a log line):
@@ -48,20 +56,25 @@ This module deliberately imports neither jax nor the engines, so the
 
 from __future__ import annotations
 
-import json
+import io
 import os
 import socket
+import tarfile
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 
-#: this process's host identity, recorded in claim files so stale-claim
-#: recovery can tell "my host, dead pid" from "another host entirely"
+from .spooldrv import (FencedError, SpoolError,  # noqa: F401 — re-export
+                       current_host, open_driver)
+
+#: this process's DEFAULT host identity (claims actually record
+#: ``spooldrv.current_host()``, which honors the ``TPUVSR_HOST``
+#: override fault drills use to fake a multi-host fleet on one box)
 HOSTNAME = socket.gethostname()
 
-#: a cross-host claim whose heartbeat mtime is older than this is dead
-#: (generous: a worker runs a background heartbeat thread touching
+#: a cross-host claim whose last heartbeat record is older than this is
+#: dead (generous: a worker runs a background heartbeat thread touching
 #: EVERY claim it holds every few seconds — Worker._hb_loop — on top
 #: of the level-boundary ticks, so even a multi-minute compile or a
 #: light job queued behind the multi-runner stays visibly alive)
@@ -151,39 +164,6 @@ class QueueError(RuntimeError):
     """An illegal queue operation (unknown job, illegal transition)."""
 
 
-def _fsync_append(path, rec):
-    """Append one JSON line durably (the jobs.jsonl write primitive).
-
-    Repairs a torn tail first: a writer killed mid-append leaves a
-    partial line with no trailing newline, and appending straight onto
-    it would MERGE two records into one garbage line (losing the valid
-    one).  Terminating the torn fragment turns it into its own
-    invalid, skipped line instead."""
-    data = (json.dumps(rec, sort_keys=True, default=str)
-            + "\n").encode()
-    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
-    try:
-        # torn-tail check via the same fd's file: a crashed writer's
-        # partial record is STATIC (every live writer appends with one
-        # O_APPEND write syscall, which local filesystems apply
-        # atomically — no mid-flight interleaving to race with)
-        try:
-            with open(path, "rb") as rf:
-                rf.seek(0, os.SEEK_END)
-                if rf.tell() > 0:
-                    rf.seek(-1, os.SEEK_END)
-                    if rf.read(1) != b"\n":
-                        os.write(fd, b"\n")
-        except OSError:
-            pass
-        # ONE write syscall: concurrent appenders (submit while serve)
-        # can never interleave inside each other's records
-        os.write(fd, data)
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
 def _pid_alive(pid):
     try:
         os.kill(int(pid), 0)
@@ -196,8 +176,8 @@ def _locked(fn):
     """Serialize a JobQueue method on the instance RLock — the HTTP
     front and the multi-runner's light-job threads share one queue
     object with the drain loop (ISSUE 14), and the in-memory fold must
-    not interleave.  Cross-PROCESS safety is unchanged: the spool's
-    O_APPEND writes and O_EXCL claim files arbitrate that."""
+    not interleave.  Cross-PROCESS safety is unchanged: the driver's
+    append/claim primitives arbitrate that."""
     def wrapper(self, *args, **kwargs):
         with self._lock:
             return fn(self, *args, **kwargs)
@@ -211,24 +191,44 @@ class JobQueue:
 
     All mutators append to the spool BEFORE updating the in-memory
     view, so a crash between the two loses nothing (the next load
-    replays the log).  Claim files are the only non-log state, and
-    they are self-healing via ``recover_stale``."""
+    replays the log).  Claims are the only non-log state on the ``fs``
+    driver (pure record folds everywhere else), and they are
+    self-healing via ``recover_stale``.
 
-    def __init__(self, spool, *, heartbeat_timeout=HEARTBEAT_TIMEOUT):
+    ``driver``/``replicas`` select the spool driver on a NEW spool
+    (``spooldrv.open_driver``); an existing spool's persisted choice
+    always wins, and no choice at all means ``fs`` — which is how
+    every pre-driver spool keeps working with no migration."""
+
+    def __init__(self, spool, *, heartbeat_timeout=HEARTBEAT_TIMEOUT,
+                 driver=None, replicas=None, host_lease_timeout=None):
         self.spool = os.path.abspath(spool)
+        os.makedirs(self.spool, exist_ok=True)
+        self.drv = open_driver(self.spool, driver=driver,
+                               replicas=replicas)
+        #: the fs-layout jobs log; meaningful on the ``fs``/``objstore``
+        #: drivers (tests and legacy tools read it directly), merely
+        #: vestigial under ``quorum`` (the stream lives in the replicas)
         self.log_path = os.path.join(self.spool, "jobs.jsonl")
-        self.claims_dir = os.path.join(self.spool, "claims")
+        self.claims_dir = self.drv.claims_dir
         self.journals_dir = os.path.join(self.spool, "journals")
         self.metrics_dir = os.path.join(self.spool, "metrics")
         self.ckpt_dir = os.path.join(self.spool, "ckpt")
-        for d in (self.spool, self.claims_dir, self.journals_dir,
-                  self.metrics_dir, self.ckpt_dir):
+        for d in (self.journals_dir, self.metrics_dir, self.ckpt_dir):
             os.makedirs(d, exist_ok=True)
         self.heartbeat_timeout = float(heartbeat_timeout)
+        #: a host whose lease record is older than this is dead and
+        #: ALL its claims are swept at once (defaults to the per-claim
+        #: heartbeat window)
+        self.host_lease_timeout = (float(host_lease_timeout)
+                                   if host_lease_timeout is not None
+                                   else float(heartbeat_timeout))
         self._lock = threading.RLock()
         self._jobs = {}
         self._seq = 0
-        self._log_pos = 0
+        self._cursor = None          # driver read cursor over "jobs"
+        self._held = {}              # job_id -> claim epoch WE hold
+        self._blob_depth = {}        # job_id -> last replicated depth
         self.refresh()
 
     def lock(self):
@@ -240,36 +240,16 @@ class JobQueue:
     # -- log fold ------------------------------------------------------
     @_locked
     def refresh(self):
-        """Fold any spool lines appended since the last read — how a
-        long-running worker sees jobs submitted by OTHER processes
-        (the CLI ``submit`` verb against a live ``serve``).  Re-applies
-        this process's own appends too; that is harmless because the
-        fold of a log prefix in order is deterministic.  A torn final
-        line (a writer killed mid-append) is left un-consumed until it
-        is completed."""
-        try:
-            size = os.path.getsize(self.log_path)
-        except OSError:
-            return
-        if size <= self._log_pos:
-            return
-        with open(self.log_path) as f:
-            f.seek(self._log_pos)
-            while True:
-                line = f.readline()
-                if not line:
-                    break
-                if not line.endswith("\n"):
-                    break        # torn tail: re-read next refresh
-                self._log_pos = f.tell()
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                self._apply(rec)
+        """Fold any ``jobs``-stream records appended since the last
+        read — how a long-running worker sees jobs submitted by OTHER
+        processes (the CLI ``submit`` verb against a live ``serve``).
+        Re-applies this process's own appends too; that is harmless
+        because the fold of a log prefix in order is deterministic.  A
+        torn final line (a writer killed mid-append) is held back by
+        the driver until it is completed."""
+        recs, self._cursor = self.drv.read("jobs", self._cursor)
+        for rec in recs:
+            self._apply(rec)
 
     def _apply(self, rec):
         op = rec.get("op")
@@ -299,12 +279,6 @@ class JobQueue:
     def checkpoint_path(self, job_id):
         return os.path.join(self.ckpt_dir, job_id)
 
-    def _claim_path(self, job_id):
-        return os.path.join(self.claims_dir, f"{job_id}.claim")
-
-    def _cancel_marker(self, job_id):
-        return os.path.join(self.claims_dir, f"{job_id}.cancel")
-
     # -- reads (locked too: the drain loop iterates these while the
     # multi-runner's light threads fold new spool lines into _jobs) --
     @_locked
@@ -328,6 +302,13 @@ class JobQueue:
         out["total"] = len(self._jobs)
         return out
 
+    def spool_status(self):
+        """The data plane's own health: driver name plus the quorum
+        driver's replica census (``None`` replicas on single-store
+        drivers) — what ``status`` and the telemetry plane surface."""
+        return {"driver": self.drv.name,
+                "replicas": self.drv.replica_status()}
+
     def backlog(self):
         """Jobs waiting for a worker (queued + admitted +
         preempted-requeued) — the depth the guard's high-water
@@ -337,7 +318,7 @@ class JobQueue:
                    if j.state in ("queued",) or j.state in CLAIMABLE)
 
     def cancel_requested(self, job_id):
-        return os.path.exists(self._cancel_marker(job_id))
+        return self.drv.cancel_requested(job_id)
 
     # -- mutators ------------------------------------------------------
     @_locked
@@ -363,9 +344,8 @@ class JobQueue:
                   seq=self._seq, submitted_ts=round(time.time(), 3),
                   updated_ts=round(time.time(), 3),
                   trace_id=new_trace_id())
-        _fsync_append(self.log_path, {"op": "submit",
-                                      "job": job.to_dict(),
-                                      "ts": job.submitted_ts})
+        self.drv.append("jobs", {"op": "submit", "job": job.to_dict(),
+                                 "ts": job.submitted_ts})
         self._jobs[job.job_id] = job
         # a job's journal opens with its submission — the first line
         # of the story every later attempt appends to (obs.journal is
@@ -388,7 +368,14 @@ class JobQueue:
     def transition(self, job_id, state, **fields):
         """Move a job to `state`, recording extra fields (attempts /
         devices / rescue / result / reason).  Raises QueueError on an
-        illegal move — the state machine is the API contract."""
+        illegal move — the state machine is the API contract.
+
+        While THIS queue object holds the job's claim, the append is
+        **fenced** on the claim epoch: if the claim was recovered (and
+        possibly re-issued) while we were presumed dead, the driver
+        rejects the append with :class:`FencedError` instead of letting
+        a zombie commit — the split-brain hole mtime heartbeats only
+        papered over."""
         self.refresh()
         job = self.get(job_id)
         if state not in STATES:
@@ -400,63 +387,55 @@ class JobQueue:
         rec = {"op": "state", "job_id": job_id, "state": state,
                "ts": round(time.time(), 3)}
         rec.update(fields)
-        _fsync_append(self.log_path, rec)
+        epoch = self._held.get(job_id)
+        if epoch is not None:
+            try:
+                self.drv.append_fenced("jobs", rec, job_id=job_id,
+                                       epoch=epoch)
+            except FencedError:
+                # the claim is no longer ours — drop the hold so later
+                # calls on this object don't keep fencing against it
+                self._held.pop(job_id, None)
+                raise
+        else:
+            self.drv.append("jobs", rec)
         self._apply(rec)
         return job
 
     # -- claims --------------------------------------------------------
     @_locked
     def claim(self, job_id, owner="worker"):
-        """Atomically claim a CLAIMABLE job: O_CREAT|O_EXCL on the
-        claim file decides races; the winner transitions the job to
-        running (attempt count bumped).  Returns the Job, or None on
-        ANY lost race — another holder's claim file, or the job left
-        the claimable states between our look and our claim (a
-        concurrent worker or a ``cancel``).  A lost race is normal
-        multi-worker traffic, never an error.  The claim records
-        pid + worker-id (`owner`) + host, and its mtime is the
-        heartbeat ``recover_stale`` judges cross-host liveness by."""
+        """Atomically claim a CLAIMABLE job: the driver's
+        conditional-put decides races; the winner transitions the job
+        to running (attempt count bumped).  Returns the Job, or None
+        on ANY lost race — another holder's claim, or the job left the
+        claimable states between our look and our claim (a concurrent
+        worker or a ``cancel``).  A lost race is normal multi-worker
+        traffic, never an error.  The claim records pid + worker-id
+        (`owner`) + host + the attempt **epoch** every later append by
+        this holder is fenced on; its explicit heartbeat records are
+        what ``recover_stale`` judges cross-host liveness by."""
         self.refresh()
         job = self.get(job_id)
         if job.state not in CLAIMABLE:
             return None
-        path = self._claim_path(job_id)
-        # write-then-LINK: the claim file appears fully written or not
-        # at all, so a concurrent recover_stale can never read a
-        # half-written (pid-less) claim and mistake it for an orphan.
-        # The tmp name carries pid AND thread id: two Workers hosted
-        # by one process (threads over separate JobQueue instances —
-        # their RLocks don't protect each other) must not share a
-        # staging file, or the loser's os.link sees it already
-        # unlinked (FileNotFoundError, not the race-deciding EEXIST)
-        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-        with open(tmp, "w") as f:
-            json.dump({"pid": os.getpid(), "owner": owner,
-                       "host": HOSTNAME,
-                       "ts": round(time.time(), 3)}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        try:
-            os.link(tmp, path)      # EEXIST decides the race, like O_EXCL
-        except FileExistsError:
+        epoch = job.attempts + 1
+        if not self.drv.try_claim(job_id, owner=owner, epoch=epoch):
             return None
-        finally:
-            os.unlink(tmp)
-        # the claim file is ours; re-read the log before announcing —
-        # a transition that landed while we were writing (e.g. a
-        # cancel, a concurrent worker) wins, and we back out
+        # the claim is ours; re-read the log before announcing — a
+        # transition that landed while we were claiming (e.g. a
+        # cancel, a concurrent worker at another epoch) wins, and we
+        # back out
+        self._held[job_id] = epoch
         self.refresh()
         job = self.get(job_id)
         try:
-            if job.state not in CLAIMABLE:
+            if job.state not in CLAIMABLE or job.attempts + 1 != epoch:
                 raise QueueError("lost the claim race")
-            self.transition(job_id, "running",
-                            attempts=job.attempts + 1)
-        except QueueError:
-            try:
-                os.unlink(path)
-            except FileNotFoundError:
-                pass
+            self.transition(job_id, "running", attempts=epoch)
+        except (QueueError, FencedError):
+            self._held.pop(job_id, None)
+            self.drv.release_claim(job_id, epoch=epoch)
             return None
         return job
 
@@ -483,23 +462,21 @@ class JobQueue:
         return None
 
     def heartbeat(self, job_id):
-        """Touch the claim file's mtime — the liveness signal a worker
-        sends while it holds a job (every level-boundary tick and
-        every shell poll slice).  Returns False when the claim is gone
-        (job finished/requeued under us); cheap enough to call
+        """Record a liveness heartbeat on the claim — the signal a
+        worker sends while it holds a job (every level-boundary tick
+        and every shell poll slice).  Returns False when the claim is
+        gone (job finished/requeued under us); cheap enough to call
         unconditionally."""
-        try:
-            os.utime(self._claim_path(job_id))
-        except OSError:
-            return False
-        return True
+        return self.drv.heartbeat(job_id)
 
     def release(self, job_id):
-        for p in (self._claim_path(job_id), self._cancel_marker(job_id)):
-            try:
-                os.unlink(p)
-            except FileNotFoundError:
-                pass
+        """Drop the claim + cancel marker.  A HOLDER's release is
+        conditional on its own epoch (a zombie's release can never
+        drop a successor's claim); a non-holder's (recover sweeps)
+        is unconditional."""
+        self.drv.release_claim(job_id,
+                               epoch=self._held.pop(job_id, None))
+        self.drv.clear_cancel(job_id)
 
     # -- endings -------------------------------------------------------
     @_locked
@@ -546,67 +523,128 @@ class JobQueue:
             raise QueueError(f"job {job_id} is already terminal "
                              f"({job.state})")
         if job.state == "running" or \
-                os.path.exists(self._claim_path(job_id)):
+                self.drv.claim_info(job_id) is not None:
             # a claim holder (running, or mid-claim in another
             # process) owns this job's transitions — leave a marker
             # it polls instead of yanking the state out from under it
-            marker = self._cancel_marker(job_id)
-            with open(marker, "w") as f:
-                f.write(json.dumps({"ts": round(time.time(), 3)}))
+            self.drv.set_cancel(job_id)
             return job
         return self.finish(job_id, "cancelled", reason="cancelled")
 
+    # -- snapshot handoff ----------------------------------------------
+    def replicate_snapshot(self, job_id):
+        """Ship the job's latest checkpoint into the driver's blob
+        store, so a rescue survives the claiming HOST's disk (the
+        host-death-failover story).  No-op on ``fs`` (the spool IS
+        the only store) and until the snapshot's depth advances past
+        the last shipped copy.  Returns True when a copy shipped."""
+        if self.drv.name == "fs":
+            return False
+        from ..engine.checkpoint import snapshot_info
+        path = self.checkpoint_path(job_id)
+        info = snapshot_info(path)
+        if info is None or self._blob_depth.get(job_id) == \
+                info["depth"]:
+            return False
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for name in sorted(os.listdir(path)):
+                p = os.path.join(path, name)
+                if os.path.isfile(p):
+                    tar.add(p, arcname=name)
+        self.drv.put_blob(f"ckpt-{job_id}.tar", buf.getvalue())
+        self._blob_depth[job_id] = info["depth"]
+        return True
+
+    def _rescue_info(self, job_id):
+        """The rescue handoff for a recovered job: the local snapshot
+        manifest when one is readable, else (on replicated drivers)
+        the driver-held blob restored into the checkpoint path — how
+        a SURVIVOR host resumes a job whose snapshot it never wrote."""
+        from ..engine.checkpoint import snapshot_info
+        path = self.checkpoint_path(job_id)
+        info = snapshot_info(path)
+        if info is not None or self.drv.name == "fs":
+            return info
+        data = self.drv.get_blob(f"ckpt-{job_id}.tar")
+        if data is None:
+            return None
+        os.makedirs(path, exist_ok=True)
+        try:
+            with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+                try:
+                    tar.extractall(path, filter="data")
+                except TypeError:    # pre-3.12 tarfile: no filter=
+                    tar.extractall(path)
+        except (OSError, tarfile.TarError):
+            return None
+        return snapshot_info(path)
+
     # -- crash recovery ------------------------------------------------
-    def _claim_alive(self, path):
-        """Liveness of one claim file: ``(alive, info)``.
+    def host_heartbeat(self, host=None):
+        """Write one host-lease heartbeat through the driver — called
+        from the pool parent's supervision loop, so the whole host's
+        liveness is visible to peers independently of any one claim."""
+        self.drv.host_heartbeat(host)
+
+    def dead_hosts(self, now=None):
+        """Hosts whose lease record has gone stale — every claim from
+        one of these is swept by ``recover_stale`` in one pass.  Hosts
+        that never wrote a lease (legacy pools, bare Workers) are
+        simply absent: their claims fall back to per-claim liveness."""
+        now = time.time() if now is None else now
+        return {h for h, lease in self.drv.hosts().items()
+                if now - lease["ts"] > self.host_lease_timeout}
+
+    def _claim_alive(self, job_id, dead_hosts=()):
+        """Liveness of one claim: ``(alive, info)``.
 
         Same-host claims are judged by their pid (authoritative and
         instant — a dead pid is recovered without waiting out any
-        heartbeat window, exactly the old behavior).  A claim from
-        ANOTHER host has no visible pid, so its heartbeat mtime
-        decides: fresh (< ``heartbeat_timeout``) means a live worker
-        elsewhere holds the job — never steal it; stale means its host
-        died (or lost the shared filesystem) and the job is
-        recoverable.  Before ISSUE 14 the pid check ran
-        unconditionally, so a cross-host worker whose pid happened to
-        be dead *here* was wrongly declared dead."""
-        try:
-            with open(path) as f:
-                info = json.load(f)
-        except (OSError, ValueError):
+        heartbeat window).  A claim from ANOTHER host has no visible
+        pid: if that host's LEASE is stale the claim is dead with the
+        whole host (the one-sweep failover path); otherwise the
+        driver's explicit heartbeat records decide — fresh
+        (< ``heartbeat_timeout``) means a live worker elsewhere holds
+        the job and it is never stolen."""
+        info = self.drv.claim_info(job_id)
+        if info is None:
             return False, {}
         host = info.get("host")
-        if host is None or host == HOSTNAME:
+        if host is None or host == current_host():
             return _pid_alive(info.get("pid")), info
-        try:
-            age = time.time() - os.path.getmtime(path)
-        except OSError:
+        if host in dead_hosts:
+            return False, info
+        age = self.drv.claim_age(job_id)
+        if age is None:
             return False, info
         return age < self.heartbeat_timeout, info
 
     @_locked
     def recover_stale(self, log=None):
-        """Requeue running jobs whose claiming worker died (claim file
+        """Requeue running jobs whose claiming worker died (claim
         missing, or judged dead by ``_claim_alive`` — dead pid on this
-        host, stale heartbeat from another).  The job's latest
-        snapshot — a periodic checkpoint or the rescue the dying
-        worker managed to write — is attached as the rescue handoff,
-        so the next attempt resumes bit-identically instead of
-        restarting (the PR 4/5 equivalence contract)."""
-        from ..engine.checkpoint import snapshot_info
+        host, stale heartbeat or dead host lease from another).  The
+        job's latest snapshot — a periodic checkpoint, the rescue the
+        dying worker managed to write, or the driver-held replica of
+        either — is attached as the rescue handoff, so the next
+        attempt resumes bit-identically instead of restarting (the
+        PR 4/5 equivalence contract).  Also runs the driver's own
+        housekeeping (replica loss detection + anti-entropy heal on
+        ``quorum``)."""
+        self.drv.maintain(log=log)
         self.refresh()
+        dead = self.dead_hosts()
         recovered = []
         for job in list(self._jobs.values()):
-            path = self._claim_path(job.job_id)
-            alive, info = (self._claim_alive(path)
-                           if os.path.exists(path) else (False, {}))
-            if job.state in CLAIMABLE and os.path.exists(path) \
-                    and not alive:
+            alive, info = self._claim_alive(job.job_id,
+                                            dead_hosts=dead)
+            if job.state in CLAIMABLE and info and not alive:
                 # a worker died in the window between creating the
-                # claim file and appending the `running` transition:
-                # the orphan claim would block every future claim()
+                # claim and appending the `running` transition: the
+                # orphan claim would block every future claim()
                 # forever — clear it (the job itself never started)
-                os.unlink(path)
+                self.drv.release_claim(job.job_id)
                 if log:
                     log(f"queue: cleared orphan claim of "
                         f"{job.job_id} (worker died before the "
@@ -616,11 +654,11 @@ class JobQueue:
                 continue
             if alive:
                 continue
-            rescue = snapshot_info(self.checkpoint_path(job.job_id))
+            rescue = self._rescue_info(job.job_id)
             try:
                 self.requeue(job.job_id, reason="worker-died",
                              rescue=rescue)
-            except QueueError:
+            except (QueueError, FencedError):
                 # another recovering worker got there first — a lost
                 # race, same as a lost claim
                 continue
@@ -646,7 +684,7 @@ class JobQueue:
             recovered.append(job.job_id)
             if log:
                 who = info.get("owner") or "worker"
-                where = info.get("host") or HOSTNAME
+                where = info.get("host") or current_host()
                 log(f"queue: job {job.job_id} had a dead claim "
                     f"({who}@{where}); requeued"
                     + (f" with rescue at depth {rescue['depth']}"

@@ -1,0 +1,974 @@
+"""Spool drivers: the durable-storage seam under the job queue.
+
+``JobQueue`` (ISSUE 6) was written against one POSIX filesystem —
+``O_CREAT|O_EXCL`` claim files, mtime heartbeats, fsync'd JSONL on one
+mount.  That story breaks the moment the control plane must survive a
+machine: an object store has no atomic-exclusive create and no
+trustworthy mtime, a lost NFS mount takes the whole queue down, and a
+zombie worker whose claim was recovered on another host can still
+append a terminal transition (the split-brain hole mtime heartbeats
+only papered over).  This module is ROADMAP item 2(b): one small
+driver interface — append-record log, conditional-put claim, explicit
+heartbeat record, snapshot-blob get/put, read-from-cursor — with three
+implementations:
+
+``fs``
+    Today's behavior, extracted verbatim: ``jobs.jsonl`` with
+    fsync-per-line appends and torn-tail repair, link-danced claim
+    files, mtime heartbeats.  A PR-18-era spool opens under this
+    driver with no migration (the driver config file is simply
+    absent); the mtime is consulted only as a FALLBACK for claims
+    that predate the explicit heartbeat sidecar.
+
+``objstore``
+    Claims become versioned compare-and-swap records in a ``claims``
+    record stream — a claim carries an **epoch** (the job's attempt
+    number), heartbeats are appended records (no mtime anywhere), and
+    every terminal-state append is **fenced** on the claim epoch: a
+    zombie worker whose claim was recovered can never commit
+    (:class:`FencedError`, journaled as a ``fence`` event).  The CAS
+    sections run under one advisory file lock, standing in for the
+    conditional-put primitive every real object store provides
+    (If-Match / generation preconditions).
+
+``quorum``
+    A tiny replicated log over N directories standing in for N
+    hosts/disks.  Appends are framed ``{seq, crc, rec}`` lines written
+    to every live replica and acknowledged at a write quorum
+    ``W = floor(N/2) + 1``; reads merge replicas by (seq, CRC),
+    holding back torn tails PER REPLICA; losing one replica leaves
+    the full service running (``replica_lost`` journaled), and a
+    rejoining replica catches up via anti-entropy
+    (:meth:`QuorumDriver.maintain`, ``replica_rejoin`` journaled).
+    Claims/fencing ride the same CAS-record machinery as ``objstore``
+    — over the replicated stream.
+
+Driver selection persists in ``<spool>/spooldrv.json`` (absent means
+``fs``, which is how legacy spools keep working).  Every driver also
+carries **host leases** — a ``hosts`` record stream the pool parents
+heartbeat through — so a survivor host's ``recover_stale`` can sweep
+an entire dead host's claims at once instead of waiting out each
+claim's own heartbeat window (the host-death-failover drill in
+``scripts/fault_matrix.py``).
+
+Driver-plane events (``replica_lost`` / ``replica_rejoin`` /
+``fence`` / ``host_lease``) are journaled to ``<spool>/spool.jsonl``
+(run_id ``spool``) and folded by the PR 17 telemetry plane onto
+``/v1/metrics``.
+
+Deliberately jax-free, like the queue: submit/status stay
+milliseconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+import zlib
+
+#: the driver-selection config file inside a spool directory; absent
+#: means the ``fs`` driver (every pre-driver spool keeps working)
+CONFIG_NAME = "spooldrv.json"
+
+DRIVERS = ("fs", "objstore", "quorum")
+
+#: default replica count for the quorum driver
+DEFAULT_REPLICAS = 3
+
+
+def current_host():
+    """This process's host identity for claims and leases.
+    ``TPUVSR_HOST`` overrides the real hostname so fault drills can
+    fake a multi-host fleet on one box (two pools, two 'hosts', one
+    spool)."""
+    return os.environ.get("TPUVSR_HOST") or socket.gethostname()
+
+
+class SpoolError(RuntimeError):
+    """A driver-level failure (write quorum lost, config mismatch)."""
+
+
+class FencedError(SpoolError):
+    """A fenced append was rejected: the appender's claim epoch is no
+    longer the live claim — its claim was recovered (and possibly
+    re-issued) while it was presumed dead.  The zombie must NOT
+    commit; the rejection is journaled as a ``fence`` event."""
+
+
+def _fsync_append(path, rec):
+    """Append one JSON line durably (the record-stream write
+    primitive, shared by every driver).
+
+    Repairs a torn tail first: a writer killed mid-append leaves a
+    partial line with no trailing newline, and appending straight onto
+    it would MERGE two records into one garbage line (losing the valid
+    one).  Terminating the torn fragment turns it into its own
+    invalid, skipped line instead."""
+    data = (json.dumps(rec, sort_keys=True, default=str)
+            + "\n").encode()
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    try:
+        # torn-tail check via the same fd's file: a crashed writer's
+        # partial record is STATIC (every live writer appends with one
+        # O_APPEND write syscall, which local filesystems apply
+        # atomically — no mid-flight interleaving to race with)
+        try:
+            with open(path, "rb") as rf:
+                rf.seek(0, os.SEEK_END)
+                if rf.tell() > 0:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        os.write(fd, b"\n")
+        except OSError:
+            pass
+        # ONE write syscall: concurrent appenders (submit while serve)
+        # can never interleave inside each other's records
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _read_new_lines(path, pos):
+    """``(complete_lines, new_pos)`` of one record file since byte
+    ``pos`` — a torn final line (a writer killed mid-append, or one we
+    raced) is held back until it is completed.  The one tailing
+    discipline every stream reader shares."""
+    out = []
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return out, pos
+    if size <= pos:
+        return out, pos
+    with open(path) as f:
+        f.seek(pos)
+        while True:
+            line = f.readline()
+            if not line or not line.endswith("\n"):
+                break            # torn tail: re-read next refresh
+            pos = f.tell()
+            line = line.strip()
+            if line:
+                out.append(line)
+    return out, pos
+
+
+def _rec_crc(rec):
+    """CRC32 of a record's canonical JSON — what the quorum frames
+    carry so a merge-read can reject a bit-rotted replica copy."""
+    return zlib.crc32(json.dumps(rec, sort_keys=True,
+                                 default=str).encode()) & 0xFFFFFFFF
+
+
+def _atomic_write(path, data):
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def open_driver(spool, driver=None, replicas=None):
+    """Open (or create) the spool's driver.
+
+    The persisted choice in ``<spool>/spooldrv.json`` wins; asking for
+    a DIFFERENT driver on an existing configured spool is an error
+    (the records are not interchangeable).  A spool with no config is
+    an ``fs`` spool — exactly how every pre-driver spool opens with no
+    migration — and explicit non-``fs`` choices write the config on
+    first open so every later opener (workers, submit, status,
+    telemetry) auto-detects."""
+    spool = os.path.abspath(spool)
+    cfg_path = os.path.join(spool, CONFIG_NAME)
+    existing = None
+    try:
+        with open(cfg_path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = None
+    if existing:
+        cfg_driver = existing.get("driver", "fs")
+        if driver is not None and driver != cfg_driver:
+            raise SpoolError(
+                f"spool {spool} is a {cfg_driver!r} spool; cannot "
+                f"open it with driver {driver!r}")
+        driver = cfg_driver
+        if replicas is None:
+            replicas = existing.get("replicas")
+    if driver is None:
+        driver = "fs"            # legacy / default: no config written
+    if driver not in DRIVERS:
+        raise SpoolError(f"unknown spool driver {driver!r} "
+                         f"(want one of {DRIVERS})")
+    if existing is None and driver != "fs":
+        os.makedirs(spool, exist_ok=True)
+        _atomic_write(cfg_path, json.dumps(
+            {"driver": driver,
+             **({"replicas": int(replicas or DEFAULT_REPLICAS)}
+                if driver == "quorum" else {})},
+            sort_keys=True).encode())
+    if driver == "objstore":
+        return ObjStoreDriver(spool)
+    if driver == "quorum":
+        return QuorumDriver(spool,
+                            replicas=int(replicas or DEFAULT_REPLICAS))
+    return FsDriver(spool)
+
+
+class SpoolDriver:
+    """The driver interface + the pieces every driver shares (cancel
+    markers, the driver-event journal, host leases).
+
+    Streams are named append-only record logs (``jobs`` is the queue's
+    state log, ``hosts`` the lease stream, ``claims`` the CAS-record
+    claim log of the record-claim drivers).  ``read`` takes and
+    returns an opaque cursor (pass ``None`` to start from the
+    beginning) and NEVER yields a torn record."""
+
+    name = None
+
+    def __init__(self, spool):
+        self.spool = os.path.abspath(spool)
+        self.claims_dir = os.path.join(self.spool, "claims")
+        os.makedirs(self.claims_dir, exist_ok=True)
+        self._tlock = threading.RLock()
+        self._flock = threading.local()
+        self._hosts = {}             # host -> {"ts", "pid"}
+        self._hosts_cursor = None
+        self._leased = set()         # hosts THIS instance journaled
+
+    @contextlib.contextmanager
+    def _spool_lock(self):
+        """The spool's cross-process advisory lock (one ``flock`` on
+        ``<spool>/.spool.lock``) — what serializes every conditional
+        section (CAS claims, fenced appends, quorum seq assignment)
+        across processes.  Reentrant PER THREAD via a depth counter:
+        a conditional section may call plain ``append`` underneath
+        itself without self-deadlocking on a second fd's flock."""
+        import fcntl
+        depth = getattr(self._flock, "depth", 0)
+        if depth == 0:
+            fd = os.open(os.path.join(self.spool, ".spool.lock"),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            except OSError:
+                os.close(fd)
+                raise
+            self._flock.fd = fd
+        self._flock.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._flock.depth -= 1
+            if self._flock.depth == 0:
+                fd = self._flock.fd
+                self._flock.fd = None
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                os.close(fd)
+
+    # -- record streams (driver-specific) -----------------------------
+    def append(self, stream, rec):
+        raise NotImplementedError
+
+    def read(self, stream, cursor=None):
+        """``(records, cursor)`` of every complete record appended
+        since ``cursor``."""
+        raise NotImplementedError
+
+    def append_fenced(self, stream, rec, *, job_id, epoch):
+        """Append ``rec`` only if ``epoch`` is still the live claim
+        epoch of ``job_id`` — the zombie fence.  Raises
+        :class:`FencedError` (journaling a ``fence`` event) when the
+        claim is gone or re-issued at a newer epoch."""
+        raise NotImplementedError
+
+    # -- claims (driver-specific) --------------------------------------
+    def try_claim(self, job_id, *, owner, epoch):
+        """Conditionally create the claim ``(job_id, epoch)`` — the
+        exactly-once primitive.  True iff WE created it; False on any
+        existing live claim (a lost race, never an error)."""
+        raise NotImplementedError
+
+    def claim_info(self, job_id):
+        """The live claim's ``{pid, owner, host, epoch, ts}`` or
+        ``None``."""
+        raise NotImplementedError
+
+    def claim_age(self, job_id):
+        """Seconds since the claim's last explicit heartbeat record
+        (``None`` when there is no claim).  Freshness decisions route
+        through THIS — never through file mtimes — so coarse or
+        skewed cross-host timestamps can't fake liveness; only the
+        ``fs`` driver ever consults an mtime, and only as a fallback
+        for claims written before the heartbeat sidecar existed."""
+        raise NotImplementedError
+
+    def heartbeat(self, job_id):
+        """Record a liveness heartbeat for a held claim.  False when
+        the claim is gone (job finished/requeued under us)."""
+        raise NotImplementedError
+
+    def release_claim(self, job_id, *, epoch=None):
+        """Drop the claim (and its heartbeat state).  With ``epoch``,
+        only a claim AT that epoch is released — a conditional delete,
+        so a zombie's release can't drop a successor's claim."""
+        raise NotImplementedError
+
+    # -- cancel markers (shared: advisory flags, no atomicity needed) --
+    def _cancel_path(self, job_id):
+        return os.path.join(self.claims_dir, f"{job_id}.cancel")
+
+    def set_cancel(self, job_id):
+        with open(self._cancel_path(job_id), "w") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3)}))
+
+    def cancel_requested(self, job_id):
+        return os.path.exists(self._cancel_path(job_id))
+
+    def clear_cancel(self, job_id):
+        try:
+            os.unlink(self._cancel_path(job_id))
+        except FileNotFoundError:
+            pass
+
+    # -- snapshot blobs ------------------------------------------------
+    def _blob_dirs(self):
+        return [os.path.join(self.spool, "blobs")]
+
+    def put_blob(self, name, data):
+        """Store an opaque snapshot blob under ``name`` (replicated
+        by the quorum driver)."""
+        for d in self._blob_dirs():
+            os.makedirs(d, exist_ok=True)
+            _atomic_write(os.path.join(d, name), data)
+            _atomic_write(os.path.join(d, name + ".crc"),
+                          str(zlib.crc32(data) & 0xFFFFFFFF).encode())
+
+    def get_blob(self, name):
+        """The blob bytes, from the first replica whose CRC checks
+        out; ``None`` when absent everywhere."""
+        for d in self._blob_dirs():
+            p = os.path.join(d, name)
+            try:
+                with open(p, "rb") as f:
+                    data = f.read()
+                with open(p + ".crc") as f:
+                    want = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+            if (zlib.crc32(data) & 0xFFFFFFFF) == want:
+                return data
+        return None
+
+    # -- host leases ---------------------------------------------------
+    def host_heartbeat(self, host=None, **info):
+        """Append one host-lease heartbeat record — what a pool
+        parent writes every supervision tick, so a surviving host can
+        judge an ENTIRE peer host dead the moment its lease goes stale
+        (not one claim at a time).  The first lease a driver instance
+        writes for a host is journaled as a ``host_lease`` event."""
+        host = host or current_host()
+        self.append("hosts", {"host": host, "pid": os.getpid(),
+                              "ts": round(time.time(), 3), **info})
+        if host not in self._leased:
+            self._leased.add(host)
+            self._event("host_lease", host=host, pid=os.getpid())
+
+    def hosts(self):
+        """The lease fold: ``{host: {"ts", "pid"}}`` with each host's
+        LATEST lease record."""
+        with self._tlock:
+            recs, self._hosts_cursor = self.read("hosts",
+                                                 self._hosts_cursor)
+            for rec in recs:
+                h = rec.get("host")
+                if not h:
+                    continue
+                try:
+                    ts = float(rec.get("ts"))
+                except (TypeError, ValueError):
+                    continue
+                cur = self._hosts.get(h)
+                if cur is None or ts >= cur["ts"]:
+                    self._hosts[h] = {"ts": ts, "pid": rec.get("pid")}
+            return dict(self._hosts)
+
+    # -- replica management (quorum only) ------------------------------
+    def replica_status(self):
+        """``{"total", "live", "lost"}`` for replicated drivers,
+        ``None`` for single-store ones."""
+        return None
+
+    def maintain(self, log=None):
+        """Driver housekeeping (anti-entropy heal, loss detection) —
+        called from ``recover_stale`` sweeps.  Returns the list of
+        journaled event names."""
+        return []
+
+    # -- the driver-event journal --------------------------------------
+    @property
+    def journal_path(self):
+        return os.path.join(self.spool, "spool.jsonl")
+
+    def _event(self, event, **fields):
+        from ..obs import Journal
+        j = Journal(self.journal_path, run_id="spool",
+                    trace_id="", span_id="", parent_span="")
+        try:
+            j.write(event, **fields)
+        finally:
+            j.close()
+
+
+class FsDriver(SpoolDriver):
+    """Today's single-filesystem mechanics, extracted verbatim from
+    ``JobQueue``: fsync-per-line JSONL streams, link-danced ``O_EXCL``
+    claim files, mtime heartbeats — kept bit-for-bit so existing
+    spools work unchanged.  New claims additionally record their
+    epoch and heartbeat through an explicit ``.hb`` sidecar, so
+    freshness decisions stop trusting mtimes except as a legacy
+    fallback, and the fence check works here too (best-effort:
+    check-then-append, not atomic — the historical fs semantics)."""
+
+    name = "fs"
+
+    def _stream_path(self, stream):
+        return os.path.join(self.spool, f"{stream}.jsonl")
+
+    def append(self, stream, rec):
+        _fsync_append(self._stream_path(stream), rec)
+
+    def read(self, stream, cursor=None):
+        lines, cursor = _read_new_lines(self._stream_path(stream),
+                                        cursor or 0)
+        out = []
+        for line in lines:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue         # an invalid line is skipped, forever
+        return out, cursor
+
+    def append_fenced(self, stream, rec, *, job_id, epoch):
+        info = self.claim_info(job_id)
+        held = None if info is None else info.get("epoch")
+        # a claim that predates the driver layer has no epoch — legacy
+        # semantics apply (no fence); otherwise the live claim must be
+        # OURS at OUR epoch or the append is a zombie's
+        if info is None or (held is not None and held != epoch):
+            self._event("fence", job_id=job_id, epoch=epoch,
+                        holder=held)
+            raise FencedError(
+                f"job {job_id}: claim epoch {epoch} is stale "
+                f"(live claim epoch: {held})")
+        self.append(stream, rec)
+
+    # -- claims --------------------------------------------------------
+    def _claim_path(self, job_id):
+        return os.path.join(self.claims_dir, f"{job_id}.claim")
+
+    def _hb_path(self, job_id):
+        return os.path.join(self.claims_dir, f"{job_id}.hb")
+
+    def try_claim(self, job_id, *, owner, epoch):
+        path = self._claim_path(job_id)
+        # write-then-LINK: the claim file appears fully written or not
+        # at all, so a concurrent recover_stale can never read a
+        # half-written (pid-less) claim and mistake it for an orphan.
+        # The tmp name carries pid AND thread id: two Workers hosted
+        # by one process (threads over separate JobQueue instances —
+        # their RLocks don't protect each other) must not share a
+        # staging file, or the loser's os.link sees it already
+        # unlinked (FileNotFoundError, not the race-deciding EEXIST)
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "owner": owner,
+                       "host": current_host(), "epoch": int(epoch),
+                       "ts": round(time.time(), 3)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, path)   # EEXIST decides the race, like O_EXCL
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
+        self.heartbeat(job_id)
+        return True
+
+    def claim_info(self, job_id):
+        try:
+            with open(self._claim_path(job_id)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def claim_age(self, job_id):
+        try:
+            with open(self._hb_path(job_id)) as f:
+                return time.time() - float(json.load(f)["ts"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        # legacy fallback: a claim written before the sidecar existed
+        # (an old spool, or a test planting raw claim files) is judged
+        # by its mtime — the pre-driver behavior, fs-only
+        try:
+            return time.time() - os.path.getmtime(
+                self._claim_path(job_id))
+        except OSError:
+            return None
+
+    def heartbeat(self, job_id):
+        if not os.path.exists(self._claim_path(job_id)):
+            return False
+        _atomic_write(self._hb_path(job_id), json.dumps(
+            {"ts": round(time.time(), 3)}).encode())
+        try:
+            # keep the mtime fresh too: pre-driver readers (and mixed
+            # fleets mid-upgrade) still judge liveness by it
+            os.utime(self._claim_path(job_id))
+        except OSError:
+            return False
+        return True
+
+    def release_claim(self, job_id, *, epoch=None):
+        if epoch is not None:
+            info = self.claim_info(job_id)
+            if info is not None and info.get("epoch") is not None \
+                    and info["epoch"] != epoch:
+                return           # someone else's claim now
+        for p in (self._claim_path(job_id), self._hb_path(job_id)):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+
+
+class _RecordClaimMixin:
+    """Claims as CAS records over the driver's own streams — shared by
+    ``objstore`` and ``quorum``.  The claim state is a pure fold of
+    the ``claims`` record stream (``claim`` / ``hb`` / ``release``
+    ops), and every conditional section (claim, fenced append,
+    conditional release) runs under the spool's advisory lock — the
+    stand-in for a real object store's conditional put."""
+
+    def _claims_init(self):
+        self._claims = {}            # job_id -> claim dict
+        self._claims_cursor = None
+
+    def _refresh_claims(self):
+        with self._tlock:
+            recs, self._claims_cursor = self.read(
+                "claims", self._claims_cursor)
+            for rec in recs:
+                op, jid = rec.get("op"), rec.get("job_id")
+                if not jid:
+                    continue
+                if op == "claim":
+                    self._claims[jid] = {
+                        "pid": rec.get("pid"),
+                        "owner": rec.get("owner"),
+                        "host": rec.get("host"),
+                        "epoch": rec.get("epoch"),
+                        "ts": rec.get("ts"),
+                        "hb_ts": rec.get("ts")}
+                elif op == "hb":
+                    cur = self._claims.get(jid)
+                    if cur is not None:
+                        cur["hb_ts"] = rec.get("ts", cur["hb_ts"])
+                elif op == "release":
+                    cur = self._claims.get(jid)
+                    if cur is not None and (
+                            rec.get("epoch") is None
+                            or rec["epoch"] == cur["epoch"]):
+                        del self._claims[jid]
+
+    def try_claim(self, job_id, *, owner, epoch):
+        with self._spool_lock():
+            self._refresh_claims()
+            if job_id in self._claims:
+                return False
+            self.append("claims", {
+                "op": "claim", "job_id": job_id, "epoch": int(epoch),
+                "owner": owner, "pid": os.getpid(),
+                "host": current_host(), "ts": round(time.time(), 3)})
+            self._refresh_claims()
+            return True
+
+    def claim_info(self, job_id):
+        self._refresh_claims()
+        info = self._claims.get(job_id)
+        return dict(info) if info is not None else None
+
+    def claim_age(self, job_id):
+        self._refresh_claims()
+        info = self._claims.get(job_id)
+        if info is None:
+            return None
+        try:
+            return time.time() - float(info["hb_ts"])
+        except (TypeError, ValueError, KeyError):
+            return None
+
+    def heartbeat(self, job_id):
+        self._refresh_claims()
+        if job_id not in self._claims:
+            return False
+        self.append("claims", {"op": "hb", "job_id": job_id,
+                               "ts": round(time.time(), 3)})
+        return True
+
+    def release_claim(self, job_id, *, epoch=None):
+        with self._spool_lock():
+            self._refresh_claims()
+            cur = self._claims.get(job_id)
+            if cur is None:
+                return
+            if epoch is not None and cur.get("epoch") != epoch:
+                return           # conditional delete lost: not ours
+            self.append("claims", {
+                "op": "release", "job_id": job_id,
+                "epoch": cur.get("epoch"),
+                "ts": round(time.time(), 3)})
+            self._refresh_claims()
+
+    def append_fenced(self, stream, rec, *, job_id, epoch):
+        # the whole fence is ONE conditional section: fold the claim
+        # stream, check the epoch, append — atomic against every other
+        # claim/release/fenced-append in any process
+        with self._spool_lock():
+            self._refresh_claims()
+            cur = self._claims.get(job_id)
+            held = None if cur is None else cur.get("epoch")
+            if cur is None or held != epoch:
+                self._event("fence", job_id=job_id, epoch=epoch,
+                            holder=held)
+                raise FencedError(
+                    f"job {job_id}: claim epoch {epoch} is stale "
+                    f"(live claim epoch: {held})")
+            self.append(stream, rec)
+
+
+class ObjStoreDriver(_RecordClaimMixin, SpoolDriver):
+    """The object-store shape: nothing but record streams and blobs —
+    no exclusive creates, no mtimes.  Stream appends reuse the fs
+    fsync-per-line primitive (an object store's append-or-CAS API maps
+    onto the same torn-tail-tolerant record log), which also means the
+    ``jobs`` stream stays byte-compatible with ``fs`` — only the
+    claim/heartbeat/fence plane differs."""
+
+    name = "objstore"
+
+    def __init__(self, spool):
+        super().__init__(spool)
+        self._claims_init()
+
+    def _stream_path(self, stream):
+        return os.path.join(self.spool, f"{stream}.jsonl")
+
+    def append(self, stream, rec):
+        _fsync_append(self._stream_path(stream), rec)
+
+    def read(self, stream, cursor=None):
+        lines, cursor = _read_new_lines(self._stream_path(stream),
+                                        cursor or 0)
+        out = []
+        for line in lines:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out, cursor
+
+
+class QuorumDriver(_RecordClaimMixin, SpoolDriver):
+    """A tiny replicated record log over N directories standing in
+    for N hosts/disks (see module doc).  Every stream append is
+    assigned a global sequence number under the spool lock (the
+    stand-in for leader serialization), framed as
+    ``{"seq", "crc", "rec"}`` and written+fsynced to every live
+    replica; the append succeeds iff at least ``W = floor(N/2) + 1``
+    replicas took it.  Reads merge the replicas: any CRC-valid copy of
+    a seq serves, torn tails are held back per replica, and the merge
+    is deterministic (same replica set + same bytes -> same records).
+    Quorum intersection does the durability math: an acked record
+    lives on >= W replicas, so after losing any N - W replicas at
+    least ``2W - N >= 1`` copy survives."""
+
+    name = "quorum"
+
+    def __init__(self, spool, replicas=DEFAULT_REPLICAS):
+        super().__init__(spool)
+        self.total = max(1, int(replicas))
+        self.write_quorum = self.total // 2 + 1
+        self.state_path = os.path.join(self.spool, "replicas.json")
+        lost = self._state()
+        fresh = not os.path.isdir(os.path.join(self.spool, "replicas"))
+        for i in range(self.total):
+            # a LOST replica's dir is never recreated here: an empty
+            # directory would read as "rejoined" before anti-entropy
+            # healed it — rejoin is maintain()'s job, on a dir the
+            # operator (or drill) actually brought back
+            if i in lost:
+                continue
+            if fresh or os.path.isdir(self._replica_dir(i)):
+                os.makedirs(self._replica_dir(i), exist_ok=True)
+            else:
+                # a not-lost replica whose dir vanished while no
+                # driver was open (a host died and took its store):
+                # that is a loss DISCOVERED at open — recreating it
+                # empty would count a record-less replica as live
+                self._mark_lost(i, lost)
+        self._claims_init()
+
+    def _replica_dir(self, i):
+        return os.path.join(self.spool, "replicas", f"r{i}")
+
+    def _frame_path(self, i, stream):
+        return os.path.join(self._replica_dir(i), f"{stream}.jsonl")
+
+    # -- replica state -------------------------------------------------
+    def _state(self):
+        try:
+            with open(self.state_path) as f:
+                doc = json.load(f)
+            return set(int(i) for i in doc.get("lost", ()))
+        except (OSError, ValueError, TypeError):
+            return set()
+
+    def _set_state(self, lost):
+        _atomic_write(self.state_path, json.dumps(
+            {"total": self.total, "lost": sorted(lost)},
+            sort_keys=True).encode())
+
+    def _mark_lost(self, i, lost, log=None):
+        lost.add(i)
+        self._set_state(lost)
+        self._event("replica_lost", replica=i,
+                    live=self.total - len(lost), total=self.total)
+        if log:
+            log(f"spool: replica r{i} lost "
+                f"({self.total - len(lost)}/{self.total} live)")
+
+    def replica_status(self):
+        lost = self._state()
+        return {"total": self.total, "live": self.total - len(lost),
+                "lost": sorted(lost)}
+
+    # -- seq assignment ------------------------------------------------
+    def _next_seq(self, stream):
+        p = os.path.join(self.spool, f".seq.{stream}")
+        try:
+            with open(p) as f:
+                n = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            n = 0
+        n += 1
+        _atomic_write(p, str(n).encode())
+        return n
+
+    # -- the replicated log --------------------------------------------
+    def append(self, stream, rec):
+        with self._spool_lock():
+            lost = self._state()
+            seq = self._next_seq(stream)
+            frame = {"seq": seq, "crc": _rec_crc(rec), "rec": rec}
+            acks, took = 0, []
+            for i in range(self.total):
+                if i in lost:
+                    continue     # a lost replica rejoins via heal(),
+                    #              never via fresh appends (it would
+                    #              hold a gapped history)
+                try:
+                    if not os.path.isdir(self._replica_dir(i)):
+                        raise OSError(f"replica r{i} gone")
+                    path = self._frame_path(i, stream)
+                    try:
+                        pre = os.path.getsize(path)
+                    except OSError:
+                        pre = 0
+                    _fsync_append(path, frame)
+                    acks += 1
+                    took.append((path, pre))
+                except OSError:
+                    self._mark_lost(i, lost)
+            if acks < self.write_quorum:
+                # the append FAILED: roll the minority writes back so
+                # the unacknowledged record can never surface on a
+                # later read (the caller was told it did not happen)
+                for path, pre in took:
+                    try:
+                        os.truncate(path, pre)
+                    except OSError:
+                        pass
+                raise SpoolError(
+                    f"write quorum lost: {acks}/{self.total} replicas "
+                    f"acked (need {self.write_quorum})")
+
+    def read(self, stream, cursor=None):
+        """Merge-read: per-replica tails (torn lines held back PER
+        replica), any CRC-valid copy of a seq serves, records are
+        delivered in seq order exactly once per cursor chain.  A seq
+        gap is a crashed un-acked append — skipped, because it was
+        never acknowledged to anyone."""
+        cur = cursor or {"seq": 0, "off": {}}
+        last_seq = int(cur.get("seq", 0))
+        offs = dict(cur.get("off", {}))
+        lost = self._state()
+        fresh = {}                   # seq -> rec
+        for i in range(self.total):
+            if i in lost:
+                continue
+            key = str(i)
+            lines, offs[key] = _read_new_lines(
+                self._frame_path(i, stream), offs.get(key, 0))
+            for line in lines:
+                try:
+                    frame = json.loads(line)
+                    seq = int(frame["seq"])
+                    rec = frame["rec"]
+                    crc = int(frame["crc"])
+                except (ValueError, KeyError, TypeError):
+                    continue
+                if seq <= last_seq or seq in fresh:
+                    continue     # another replica already served it
+                if _rec_crc(rec) != crc:
+                    continue     # bit-rotted copy: try a sibling's
+                fresh[seq] = rec
+        out = [fresh[s] for s in sorted(fresh)]
+        if fresh:
+            last_seq = max(fresh)
+        return out, {"seq": last_seq, "off": offs}
+
+    # -- replicated blobs ----------------------------------------------
+    def _blob_dirs(self):
+        lost = self._state()
+        dirs = [os.path.join(self._replica_dir(i), "blobs")
+                for i in range(self.total) if i not in lost]
+        return dirs or [os.path.join(self.spool, "blobs")]
+
+    # -- anti-entropy --------------------------------------------------
+    def maintain(self, log=None):
+        """Loss detection + anti-entropy heal, under the spool lock.
+
+        A replica whose directory vanished is marked lost (journaled
+        ``replica_lost``) even if no append has tripped over it yet; a
+        LOST replica whose directory exists again is caught up — its
+        surviving valid frame prefix is kept, every missing acked
+        record is re-framed onto its tail, blobs are re-replicated —
+        and unmarked (journaled ``replica_rejoin``)."""
+        events = []
+        with self._spool_lock():
+            lost = self._state()
+            for i in range(self.total):
+                present = os.path.isdir(self._replica_dir(i))
+                if i not in lost and not present:
+                    self._mark_lost(i, lost, log=log)
+                    events.append("replica_lost")
+                elif i in lost and present:
+                    healed = self._heal_one(i)
+                    lost.discard(i)
+                    self._set_state(lost)
+                    self._event("replica_rejoin", replica=i,
+                                records=healed,
+                                live=self.total - len(lost),
+                                total=self.total)
+                    events.append("replica_rejoin")
+                    if log:
+                        log(f"spool: replica r{i} rejoined "
+                            f"(+{healed} records healed, "
+                            f"{self.total - len(lost)}/{self.total} "
+                            f"live)")
+        return events
+
+    def _streams(self):
+        names = set()
+        for i in range(self.total):
+            try:
+                for f in os.listdir(self._replica_dir(i)):
+                    if f.endswith(".jsonl"):
+                        names.add(f[:-len(".jsonl")])
+            except OSError:
+                continue
+        return sorted(names)
+
+    def _heal_one(self, i):
+        """Catch replica ``i`` up from its live siblings.  Appends
+        only the MISSING frames after its surviving valid prefix —
+        never rewrites history, so a reader's byte offset into the
+        rejoined file stays valid."""
+        healed = 0
+        lost = self._state()
+        for stream in self._streams():
+            # the merged view of every OTHER live replica
+            merged = {}
+            for j in range(self.total):
+                if j == i or j in lost:
+                    continue
+                lines, _ = _read_new_lines(
+                    self._frame_path(j, stream), 0)
+                for line in lines:
+                    try:
+                        frame = json.loads(line)
+                        seq = int(frame["seq"])
+                        if _rec_crc(frame["rec"]) != int(frame["crc"]):
+                            continue
+                    except (ValueError, KeyError, TypeError):
+                        continue
+                    merged.setdefault(seq, frame)
+            # the rejoining replica's own surviving valid frames
+            path = self._frame_path(i, stream)
+            have = set()
+            lines, valid_end = _read_new_lines(path, 0)
+            for line in lines:
+                try:
+                    frame = json.loads(line)
+                    if _rec_crc(frame["rec"]) == int(frame["crc"]):
+                        have.add(int(frame["seq"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+            # drop a torn tail so healed frames append onto a clean
+            # line boundary
+            try:
+                if os.path.getsize(path) > valid_end:
+                    with open(path, "r+") as f:
+                        f.truncate(valid_end)
+            except OSError:
+                pass
+            for seq in sorted(merged):
+                if seq in have:
+                    continue
+                _fsync_append(path, merged[seq])
+                healed += 1
+        # blobs: re-replicate whatever the live siblings hold
+        for j in range(self.total):
+            if j == i or j in lost:
+                continue
+            src = os.path.join(self._replica_dir(j), "blobs")
+            dst = os.path.join(self._replica_dir(i), "blobs")
+            try:
+                names = [n for n in os.listdir(src)
+                         if not n.endswith(".crc")]
+            except OSError:
+                continue
+            os.makedirs(dst, exist_ok=True)
+            for n in names:
+                if os.path.exists(os.path.join(dst, n)):
+                    continue
+                try:
+                    with open(os.path.join(src, n), "rb") as f:
+                        data = f.read()
+                    _atomic_write(os.path.join(dst, n), data)
+                    _atomic_write(
+                        os.path.join(dst, n + ".crc"),
+                        str(zlib.crc32(data) & 0xFFFFFFFF).encode())
+                except OSError:
+                    continue
+        return healed

@@ -261,12 +261,12 @@ class Worker:
         before any device time is spent.  A QueueError from any
         transition is a lost race against a concurrent worker (same as
         a lost claim): skip, never crash."""
-        from .queue import QueueError
+        from .queue import FencedError, QueueError
         for job in [j for j in self.queue.jobs()
                     if j.state == "queued"]:
             try:
                 self._admit_one(job)
-            except QueueError:
+            except (QueueError, FencedError):
                 continue
 
     def _admit_one(self, job):
@@ -354,9 +354,16 @@ class Worker:
     # -- the level-boundary tick ---------------------------------------
     def _tick(self, job, depth):
         # heartbeat FIRST, even when a preemption is already pending:
-        # the claim-file mtime is what keeps a cross-host
+        # the claim heartbeat record is what keeps a cross-host
         # recover_stale from declaring this worker dead (ISSUE 14)
         self.queue.heartbeat(job.job_id)
+        try:
+            # on replicated drivers, ship the latest snapshot into the
+            # driver blob store so a rescue survives THIS host's disk
+            # (no-op on fs, and until the snapshot depth advances)
+            self.queue.replicate_snapshot(job.job_id)
+        except Exception:  # noqa: BLE001 — replication is best-effort
+            pass
         if self.hb_journal_every and \
                 time.time() - self._last_hb >= self.hb_journal_every:
             self._last_hb = time.time()
@@ -428,7 +435,7 @@ class Worker:
         preemption fields ``run_one`` owns, so it is safe beside a
         concurrently running mesh job; any unexpected error fails the
         JOB, never the thread pool."""
-        from .queue import QueueError
+        from .queue import FencedError, QueueError
         if getattr(job, "trace_id", None):
             self._spans[job.job_id] = new_span_id()
         try:
@@ -444,14 +451,14 @@ class Worker:
                 self._finish(job, "failed",
                              reason="not-a-light-job (multi-runner "
                                     "dispatch bug)")
-        except QueueError:
+        except (QueueError, FencedError):
             pass                  # lost race against a sibling worker
         except Exception as e:  # noqa: BLE001 — a job, not the worker
             try:
                 self._finish(job, "failed",
                              reason=f"light-runner: "
                                     f"{type(e).__name__}: {e}")
-            except QueueError:
+            except (QueueError, FencedError):
                 pass
         finally:
             self._release_hold(job.job_id)
@@ -534,7 +541,18 @@ class Worker:
         return True
 
     def _finish(self, job, state, **kw):
-        self.queue.finish(job.job_id, state, **kw)
+        from .queue import FencedError
+        try:
+            self.queue.finish(job.job_id, state, **kw)
+        except FencedError as e:
+            # our claim was recovered (and possibly re-issued) while
+            # we were presumed dead — the successor owns this job now.
+            # Drop OUR outcome: committing it too would double-count
+            # the job (the exactly-once story the fence exists for)
+            self.log(f"job {job.job_id}: fenced, dropping {state} "
+                     f"({e})")
+            self.processed.append((job.job_id, "fenced"))
+            return
         self._journal(job, "job_done", state=state,
                       reason=kw.get("reason"))
         self.processed.append((job.job_id, state))
@@ -638,9 +656,18 @@ class Worker:
                 return
             reason = self._requeue_reason or \
                 f"preempted ({(out.rescue or {}).get('signal')})"
-            self.queue.requeue(
-                job.job_id, reason=reason, rescue=out.rescue,
-                devices=self._requeue_devices)
+            from .queue import FencedError
+            try:
+                self.queue.requeue(
+                    job.job_id, reason=reason, rescue=out.rescue,
+                    devices=self._requeue_devices)
+            except FencedError as e:
+                # recovered out from under us mid-run: the successor
+                # already requeued (or re-ran) this job — drop ours
+                self.log(f"job {job.job_id}: fenced, dropping "
+                         f"requeue ({e})")
+                self.processed.append((job.job_id, "fenced"))
+                return
             self._journal(job, "job_requeued", reason=reason,
                           rescue=out.rescue,
                           devices=self._requeue_devices or job.devices)
